@@ -1,0 +1,78 @@
+"""Limb arithmetic vs python big-int oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.ops import limbs as limb_ops
+
+ORDERS = [
+    20_000_000_000_001,
+    2**45,
+    2**96,
+    200_000_000_000_000_000_000_000_000_017,  # Prime F64 B6 M3
+    (2**128 - 159),  # arbitrary large modulus
+]
+
+
+@pytest.mark.parametrize("order", ORDERS)
+def test_roundtrip_ints(order):
+    rng = random.Random(42)
+    values = [rng.randrange(order) for _ in range(64)]
+    n_limb = limb_ops.n_limbs_for_order(order)
+    arr = limb_ops.ints_to_limbs(values, n_limb)
+    assert limb_ops.limbs_to_ints(arr) == values
+
+
+@pytest.mark.parametrize("order", ORDERS)
+def test_bytes_roundtrip(order):
+    rng = random.Random(1)
+    values = [rng.randrange(order) for _ in range(32)]
+    bpn = ((order - 1).bit_length() + 7) // 8
+    n_limb = limb_ops.n_limbs_for_order(order)
+    arr = limb_ops.ints_to_limbs(values, n_limb)
+    wire = limb_ops.limbs_to_bytes_le(arr, bpn)
+    assert wire == b"".join(v.to_bytes(bpn, "little") for v in values)
+    back = limb_ops.bytes_le_to_limbs(wire, 32, bpn)
+    assert limb_ops.limbs_to_ints(back) == values
+
+
+@pytest.mark.parametrize("order", ORDERS)
+def test_mod_add_sub(order):
+    rng = random.Random(7)
+    a = [rng.randrange(order) for _ in range(128)]
+    b = [rng.randrange(order) for _ in range(128)]
+    n_limb = limb_ops.n_limbs_for_order(order)
+    ol = limb_ops.order_limbs_for(order)
+    aa = limb_ops.ints_to_limbs(a, n_limb)
+    bb = limb_ops.ints_to_limbs(b, n_limb)
+
+    s = limb_ops.mod_add(aa, bb, ol)
+    assert limb_ops.limbs_to_ints(s) == [(x + y) % order for x, y in zip(a, b)]
+
+    d = limb_ops.mod_sub(aa, bb, ol)
+    assert limb_ops.limbs_to_ints(d) == [(x - y) % order for x, y in zip(a, b)]
+
+
+@pytest.mark.parametrize("order", ORDERS)
+@pytest.mark.parametrize("k", [1, 2, 3, 8, 17])
+def test_batch_mod_sum(order, k):
+    rng = random.Random(k)
+    n_limb = limb_ops.n_limbs_for_order(order)
+    ol = limb_ops.order_limbs_for(order)
+    rows = [[rng.randrange(order) for _ in range(16)] for _ in range(k)]
+    stack = np.stack([limb_ops.ints_to_limbs(r, n_limb) for r in rows])
+    got = limb_ops.limbs_to_ints(limb_ops.batch_mod_sum(stack, ol))
+    want = [sum(rows[i][j] for i in range(k)) % order for j in range(16)]
+    assert got == want
+
+
+def test_edge_values():
+    order = 2**64 - 59
+    n_limb = limb_ops.n_limbs_for_order(order)
+    ol = limb_ops.order_limbs_for(order)
+    a = limb_ops.ints_to_limbs([order - 1, 0, order - 1], n_limb)
+    b = limb_ops.ints_to_limbs([order - 1, 0, 1], n_limb)
+    assert limb_ops.limbs_to_ints(limb_ops.mod_add(a, b, ol)) == [order - 2, 0, 0]
+    assert limb_ops.limbs_to_ints(limb_ops.mod_sub(b, a, ol)) == [0, 0, 2 % order]

@@ -1,0 +1,199 @@
+"""Masking conformance grid — the protocol's numerical contract.
+
+Ports the reference's macro-generated round-trip test grids
+(rust/xaynet-core/src/mask/masking.rs:444-518, 718-763, 852-942):
+mask -> derive mask from seed -> unmask must recover the model within
+``1/exp_shift`` (or ``n/exp_shift`` after aggregating n models), across the
+full GroupType x DataType x BoundType grid.
+"""
+
+import random
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    Masker,
+    MaskConfig,
+    MaskSeed,
+    Model,
+    ModelType,
+    Scalar,
+)
+
+GROUPS = [GroupType.INTEGER, GroupType.PRIME, GroupType.POWER2]
+DTYPES = [DataType.F32, DataType.F64, DataType.I32, DataType.I64]
+BOUNDS = [BoundType.B0, BoundType.B2, BoundType.B4, BoundType.B6, BoundType.BMAX]
+
+_BOUND_VALUES = {BoundType.B0: 1, BoundType.B2: 100, BoundType.B4: 10_000, BoundType.B6: 1_000_000}
+
+
+def _rand_weights(rng, data_type, bound_type, n):
+    if bound_type is BoundType.BMAX:
+        if data_type is DataType.F32:
+            bound = float(np.finfo(np.float32).max) / 2.1
+        elif data_type is DataType.F64:
+            bound = float(np.finfo(np.float64).max) / 2.1
+        elif data_type is DataType.I32:
+            bound = int(2**31 // 2.1)
+        else:
+            bound = int(2**63 // 2.1)
+    else:
+        bound = _BOUND_VALUES[bound_type]
+    if data_type in (DataType.I32, DataType.I64):
+        return [rng.randint(-int(bound), int(bound)) for _ in range(n)]
+    ws = [rng.uniform(-bound, bound) for _ in range(n)]
+    if data_type is DataType.F32:
+        ws = [float(np.float32(w)) for w in ws]
+    return ws
+
+
+def _config(group, dtype, bound):
+    return MaskConfig(group, dtype, bound, ModelType.M3)
+
+
+@pytest.mark.parametrize("group", GROUPS)
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("bound", BOUNDS)
+def test_masking_roundtrip(group, dtype, bound):
+    config = _config(group, dtype, bound)
+    rng = random.Random(hash((group, dtype, bound)) & 0xFFFF)
+    n = 10
+    weights = _rand_weights(rng, dtype, bound, n)
+    model = Model.from_primitives(weights, dtype)
+
+    seed, masked = Masker(config.pair(), MaskSeed(bytes([rng.randrange(256) for _ in range(32)]))).mask(
+        Scalar.unit(), model
+    )
+    assert len(masked.vect) == n
+    assert masked.is_valid()
+
+    mask = seed.derive_mask(n, config.pair())
+    agg = Aggregation.from_object(masked)
+    agg.validate_unmasking(mask)
+    unmasked = agg.unmask(mask)
+
+    tol = Fraction(1, config.exp_shift)
+    for w, u in zip(model, unmasked):
+        assert abs(w - u) <= tol, (float(w), float(u), group, dtype, bound)
+
+
+@pytest.mark.parametrize("group", GROUPS)
+@pytest.mark.parametrize("dtype", [DataType.F32, DataType.F64])
+@pytest.mark.parametrize("bound", BOUNDS)
+def test_masking_scalar_roundtrip(group, dtype, bound):
+    """Scaled all-ones model must unmask back to ones (scalar correction)."""
+    config = _config(group, dtype, bound)
+    rng = random.Random(hash((group, dtype, bound, "s")) & 0xFFFF)
+    n = 10
+    if bound is BoundType.BMAX:
+        hi = float(np.finfo(np.float32 if dtype is DataType.F32 else np.float64).max) / 2.1
+    else:
+        hi = float(_BOUND_VALUES[bound])
+    scalar = Scalar.from_float(rng.uniform(1e-6, hi))
+    model = Model.from_primitives([1] * n, DataType.I32)
+
+    seed, masked = Masker(config.pair()).mask(scalar, model)
+    assert masked.is_valid()
+    mask = seed.derive_mask(n, config.pair())
+    unmasked = Aggregation.from_object(masked).unmask(mask)
+
+    tol = Fraction(1, config.exp_shift)
+    for u in unmasked:
+        assert abs(u - 1) <= tol, (float(u), group, dtype, bound)
+
+
+@pytest.mark.parametrize("group", GROUPS)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_masking_and_aggregation(group, dtype):
+    """Aggregate 5 masked models + 5 masks; unmask = weighted average."""
+    bound = BoundType.B2
+    config = _config(group, dtype, bound)
+    rng = random.Random(hash((group, dtype)) & 0xFFFF)
+    n, count = 10, 5
+    scalar = Scalar(1, count)
+
+    agg_model = Aggregation(config.pair(), n)
+    agg_mask = Aggregation(config.pair(), n)
+    averaged = [Fraction(0)] * n
+    for _ in range(count):
+        weights = _rand_weights(rng, dtype, bound, n)
+        model = Model.from_primitives(weights, dtype)
+        for i, w in enumerate(model):
+            averaged[i] += scalar.value * w
+
+        seed, masked = Masker(config.pair()).mask(scalar, model)
+        mask = seed.derive_mask(n, config.pair())
+        agg_model.validate_aggregation(masked)
+        agg_model.aggregate(masked)
+        agg_mask.validate_aggregation(mask)
+        agg_mask.aggregate(mask)
+
+    mask_final = agg_mask.object
+    agg_model.validate_unmasking(mask_final)
+    unmasked = agg_model.unmask(mask_final)
+
+    tol = Fraction(count, config.exp_shift)
+    for a, u in zip(averaged, unmasked):
+        assert abs(a - u) <= tol, (float(a), float(u), group, dtype)
+
+
+@pytest.mark.parametrize("group", GROUPS)
+def test_aggregation_validity(group):
+    """Random masked models stay inside the group through aggregation."""
+    config = _config(group, DataType.F32, BoundType.B0)
+    rng = random.Random(3)
+    from xaynet_tpu.core.crypto.prng import uniform_ints
+    from xaynet_tpu.core.mask import MaskObject
+
+    n = 10
+    agg = Aggregation(config.pair(), n)
+    for k in range(1, 6):
+        seed = bytes([rng.randrange(256) for _ in range(32)])
+        ints = uniform_ints(seed, n + 1, config.order)
+        obj = MaskObject.new(config.pair(), ints[1:], ints[0])
+        agg.validate_aggregation(obj)
+        agg.aggregate(obj)
+        assert agg.nb_models == k
+        assert agg.object.is_valid()
+
+
+def test_fast_path_matches_exact():
+    """numpy-f32 fast encode must agree with the exact rational path."""
+    config = _config(GroupType.INTEGER, DataType.F32, BoundType.B0)
+    rng = np.random.default_rng(0)
+    weights32 = rng.uniform(-1, 1, size=256).astype(np.float32)
+    model = Model.from_primitives(weights32.tolist(), DataType.F32)
+    seed = MaskSeed(b"\x11" * 32)
+
+    _, masked_fast = Masker(config.pair(), seed).mask(Scalar.unit(), weights32)
+    _, masked_exact = Masker(config.pair(), seed).mask(Scalar.unit(), model)
+    assert masked_fast == masked_exact
+
+
+def test_batch_aggregation_matches_sequential():
+    config = _config(GroupType.PRIME, DataType.F32, BoundType.B2)
+    rng = np.random.default_rng(1)
+    n, k = 32, 7
+    objs = []
+    for _ in range(k):
+        w = rng.uniform(-100, 100, size=n).astype(np.float32)
+        _, masked = Masker(config.pair()).mask(Scalar(1, k), w)
+        objs.append(masked)
+
+    seq = Aggregation(config.pair(), n)
+    for o in objs:
+        seq.aggregate(o)
+
+    bat = Aggregation(config.pair(), n)
+    stack = np.stack([o.vect.data for o in objs])
+    units = np.stack([o.unit.data for o in objs])
+    bat.aggregate_batch(stack, units)
+
+    assert seq.nb_models == bat.nb_models == k
+    assert seq.object == bat.object

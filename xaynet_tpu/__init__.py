@@ -1,0 +1,22 @@
+"""xaynet_tpu — a TPU-native federated-learning framework (PET protocol).
+
+A ground-up reimplementation of the capability surface of Xaynet
+(masked, privacy-preserving cross-device federated learning) designed for
+TPU hardware: the aggregation hot path (finite-group modular arithmetic over
+multi-limb integer tensors, ChaCha20 mask expansion, unmasking) runs as
+JAX/XLA/Pallas kernels over HBM-resident buffers and shards over a device
+mesh via `jax.sharding`; the coordinator and participant runtimes are
+host-side asyncio services speaking the PET wire protocol.
+
+Layer map (mirrors the reference architecture, reimplemented TPU-first):
+
+- ``xaynet_tpu.core``    — protocol kernel: crypto, masking math, wire format
+- ``xaynet_tpu.ops``     — numpy / JAX / Pallas kernels for the hot loops
+- ``xaynet_tpu.parallel``— device-mesh sharding of the aggregation buffers
+- ``xaynet_tpu.server``  — coordinator: state machine, services, REST API
+- ``xaynet_tpu.storage`` — coordinator/model storage backends
+- ``xaynet_tpu.sdk``     — participant state machine + client
+- ``xaynet_tpu.models``  — baseline model families with JAX local training
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,50 @@
+"""Masking / aggregation math: the PET protocol kernel.
+
+Reference surface: rust/xaynet-core/src/mask/ (config, model, scalar, object,
+seed, masking). TPU-native representation: group elements are fixed-width
+``uint32`` limb tensors; the hot loops (mask expansion, modular aggregation,
+unmasking) have numpy host implementations here and JAX/Pallas device
+implementations in ``xaynet_tpu.ops``.
+"""
+
+from .config import (
+    MASK_CONFIG_LENGTH,
+    BoundType,
+    DataType,
+    GroupType,
+    InvalidMaskConfigError,
+    MaskConfig,
+    MaskConfigPair,
+    ModelType,
+)
+from .masking import Aggregation, AggregationError, Masker, UnmaskingError
+from .model import Model, ModelCastError, PrimitiveCastError, Scalar
+from .object import InvalidMaskObjectError, MaskObject, MaskUnit, MaskVect
+from .seed import ENCRYPTED_MASK_SEED_LENGTH, MASK_SEED_LENGTH, EncryptedMaskSeed, MaskSeed
+
+__all__ = [
+    "MASK_CONFIG_LENGTH",
+    "BoundType",
+    "DataType",
+    "GroupType",
+    "InvalidMaskConfigError",
+    "MaskConfig",
+    "MaskConfigPair",
+    "ModelType",
+    "Aggregation",
+    "AggregationError",
+    "Masker",
+    "UnmaskingError",
+    "Model",
+    "ModelCastError",
+    "PrimitiveCastError",
+    "Scalar",
+    "InvalidMaskObjectError",
+    "MaskObject",
+    "MaskUnit",
+    "MaskVect",
+    "ENCRYPTED_MASK_SEED_LENGTH",
+    "MASK_SEED_LENGTH",
+    "EncryptedMaskSeed",
+    "MaskSeed",
+]

@@ -1,0 +1,177 @@
+"""Masking configurations: finite-group catalogue and fixed-point shifts.
+
+Reimplements the reference's `MaskConfig` surface (reference:
+rust/xaynet-core/src/mask/config/mod.rs:41-231): the
+(GroupType x DataType x BoundType x ModelType) grid, the derived
+``add_shift`` (weight bound), ``exp_shift`` (fixed-point scale),
+``bytes_per_number`` (wire width) and the 240-entry group-order catalogue
+(protocol constants, generated into ``_orders_data.py``).
+
+Wire encoding is 4 bytes: [group, data, bound, model] (reference:
+rust/xaynet-core/src/mask/config/serialization.rs:19-23).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from enum import IntEnum
+from fractions import Fraction
+from functools import cached_property
+
+from ._orders_data import ORDERS
+
+MASK_CONFIG_LENGTH = 4
+
+_F32_MAX = int(2**128 - 2**104)  # f32::MAX is an exact integer
+_F64_MAX = int(2**1024 - 2**971)  # f64::MAX is an exact integer
+
+
+class InvalidMaskConfigError(ValueError):
+    """A serialized masking configuration field is out of range."""
+
+
+class GroupType(IntEnum):
+    INTEGER = 0
+    PRIME = 1
+    POWER2 = 2
+
+
+class DataType(IntEnum):
+    F32 = 0
+    F64 = 1
+    I32 = 2
+    I64 = 3
+
+
+class BoundType(IntEnum):
+    B0 = 0
+    B2 = 2
+    B4 = 4
+    B6 = 6
+    BMAX = 255
+
+
+class ModelType(IntEnum):
+    M3 = 3
+    M6 = 6
+    M9 = 9
+    M12 = 12
+
+    @property
+    def max_nb_models(self) -> int:
+        return 10**int(self)
+
+
+_GROUP_KEY = {GroupType.INTEGER: "Integer", GroupType.PRIME: "Prime", GroupType.POWER2: "Power2"}
+_DATA_KEY = {DataType.F32: "F32", DataType.F64: "F64", DataType.I32: "I32", DataType.I64: "I64"}
+_BOUND_KEY = {
+    BoundType.B0: "B0",
+    BoundType.B2: "B2",
+    BoundType.B4: "B4",
+    BoundType.B6: "B6",
+    BoundType.BMAX: "Bmax",
+}
+_MODEL_KEY = {ModelType.M3: "M3", ModelType.M6: "M6", ModelType.M9: "M9", ModelType.M12: "M12"}
+
+
+@dataclass(frozen=True)
+class MaskConfig:
+    """A masking configuration (hashable, usable as a dict key)."""
+
+    group_type: GroupType
+    data_type: DataType
+    bound_type: BoundType
+    model_type: ModelType
+
+    @cached_property
+    def order(self) -> int:
+        """The finite-group order (protocol constant)."""
+        return ORDERS[
+            (
+                _GROUP_KEY[self.group_type],
+                _DATA_KEY[self.data_type],
+                _BOUND_KEY[self.bound_type],
+                _MODEL_KEY[self.model_type],
+            )
+        ]
+
+    @cached_property
+    def add_shift(self) -> Fraction:
+        """Additive shift bound: weights are clamped to [-add_shift, add_shift]."""
+        if self.bound_type is BoundType.B0:
+            return Fraction(1)
+        if self.bound_type is BoundType.B2:
+            return Fraction(100)
+        if self.bound_type is BoundType.B4:
+            return Fraction(10_000)
+        if self.bound_type is BoundType.B6:
+            return Fraction(1_000_000)
+        # BMAX: the data type's maximum absolute value, exactly
+        if self.data_type is DataType.F32:
+            return Fraction(_F32_MAX)
+        if self.data_type is DataType.F64:
+            return Fraction(_F64_MAX)
+        if self.data_type is DataType.I32:
+            return Fraction(2**31)
+        return Fraction(2**63)
+
+    @cached_property
+    def exp_shift(self) -> int:
+        """Fixed-point scale: weights are quantized to 1/exp_shift steps."""
+        if self.data_type is DataType.F32:
+            return 10**45 if self.bound_type is BoundType.BMAX else 10**10
+        if self.data_type is DataType.F64:
+            return 10**324 if self.bound_type is BoundType.BMAX else 10**20
+        return 10**10
+
+    @cached_property
+    def bytes_per_number(self) -> int:
+        """Fixed wire width of one group element."""
+        return ((self.order - 1).bit_length() + 7) // 8
+
+    @property
+    def max_nb_models(self) -> int:
+        return self.model_type.max_nb_models
+
+    # --- wire format -----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return struct.pack(
+            "BBBB",
+            int(self.group_type),
+            int(self.data_type),
+            int(self.bound_type),
+            int(self.model_type),
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MaskConfig":
+        if len(data) < MASK_CONFIG_LENGTH:
+            raise InvalidMaskConfigError("mask config buffer too short")
+        g, d, b, m = struct.unpack_from("BBBB", data)
+        try:
+            return cls(GroupType(g), DataType(d), BoundType(b), ModelType(m))
+        except ValueError as e:
+            raise InvalidMaskConfigError(str(e)) from e
+
+    def pair(self) -> "MaskConfigPair":
+        return MaskConfigPair(vect=self, unit=self)
+
+
+@dataclass(frozen=True)
+class MaskConfigPair:
+    """Masking configurations for (vector of weights, unit scalar)."""
+
+    vect: MaskConfig
+    unit: MaskConfig
+
+    def to_bytes(self) -> bytes:
+        return self.vect.to_bytes() + self.unit.to_bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MaskConfigPair":
+        return cls(
+            vect=MaskConfig.from_bytes(data[:MASK_CONFIG_LENGTH]),
+            unit=MaskConfig.from_bytes(data[MASK_CONFIG_LENGTH : 2 * MASK_CONFIG_LENGTH]),
+        )

@@ -1,0 +1,142 @@
+"""Fixed-point encode/decode between weights and finite-group elements.
+
+The masking pipeline (reference: rust/xaynet-core/src/mask/masking.rs:358-404)
+maps a weight ``w`` to a group element:
+
+    shifted = floor((clamp(scalar * w, -A, A) + A) * E)
+
+with ``A = add_shift`` and ``E = exp_shift``; unmasking inverts it
+(masking.rs:190-231):
+
+    w = ((n / E) - nb_models * A) / scalar_sum
+
+The reference computes this in exact big-rational arithmetic per weight. Here:
+
+- **fast path** (f32 data, bounded B0-B6 — every practical config): vectorized
+  numpy double-double arithmetic (error ~1e-23 ≪ the 1e-10 protocol
+  tolerance), producing int64 fixed-point values that convert straight into
+  limb tensors;
+- **exact path** (f64 / integer data types, Bmax): python-int / Fraction math,
+  bit-identical to the reference semantics.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+
+from ...ops import dd
+from ...ops import limbs as limb_ops
+from .config import BoundType, DataType, MaskConfig
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def clamp_scalar(scalar: Fraction, unit_config: MaskConfig) -> Fraction:
+    """Clamp the scalar from above by the unit config's add_shift."""
+    a1 = unit_config.add_shift
+    return a1 if scalar > a1 else scalar
+
+
+def has_fast_path(config: MaskConfig) -> bool:
+    return config.data_type is DataType.F32 and config.bound_type is not BoundType.BMAX
+
+
+def encode_unit(scalar_clamped: Fraction, unit_config: MaskConfig) -> int:
+    """Fixed-point encode of the (clamped) scalar — always exact (one value)."""
+    t = scalar_clamped + unit_config.add_shift
+    return (t.numerator * unit_config.exp_shift) // t.denominator
+
+
+def encode_vect_exact(weights, scalar_clamped: Fraction, config: MaskConfig) -> list[int]:
+    """Exact reference-semantics encode (python Fractions)."""
+    a = config.add_shift
+    e = config.exp_shift
+    out = []
+    for w in weights:
+        scaled = scalar_clamped * Fraction(w)
+        c = -a if scaled < -a else (a if scaled > a else scaled)
+        t = c + a
+        out.append((t.numerator * e) // t.denominator)
+    return out
+
+
+def encode_vect_fast(weights: np.ndarray, scalar_clamped: Fraction, config: MaskConfig) -> np.ndarray:
+    """Vectorized double-double encode for bounded-f32 configs -> int64."""
+    assert has_fast_path(config)
+    w = np.asarray(weights, dtype=np.float64)  # f32 -> f64 is exact
+    s_hi, s_lo = dd.from_fraction(scalar_clamped)
+    a = float(int(config.add_shift))  # 1, 100, 1e4, 1e6 — exact
+    e = float(config.exp_shift)  # 1e10 — exact in f64
+
+    hi, lo = dd.mul_f(np.full_like(w, s_hi), np.full_like(w, s_lo), w)
+    # clamp to [-a, a]
+    over = (hi > a) | ((hi == a) & (lo > 0))
+    under = (hi < -a) | ((hi == -a) & (lo < 0))
+    hi = np.where(over, a, np.where(under, -a, hi))
+    lo = np.where(over | under, 0.0, lo)
+    # (c + a) * e, floored
+    hi, lo = dd.add_f(hi, lo, a)
+    hi, lo = dd.mul_f(hi, lo, e)
+    shifted = dd.floor(hi, lo)  # integer-valued f64, <= 2*1e6*1e10 < 2^53
+    return np.maximum(shifted, 0.0).astype(np.int64)
+
+
+def encode_vect_limbs(weights, scalar_clamped: Fraction, config: MaskConfig) -> np.ndarray:
+    """Encode weights into ``uint32[n, L]`` limb tensors (unmasked)."""
+    n_limb = limb_ops.n_limbs_for_order(config.order)
+    if has_fast_path(config) and isinstance(weights, np.ndarray) and weights.dtype in (
+        np.float32,
+        np.float64,
+    ):
+        shifted = encode_vect_fast(weights, scalar_clamped, config)
+        out = np.zeros((shifted.shape[0], n_limb), dtype=np.uint32)
+        out[:, 0] = (shifted & 0xFFFFFFFF).astype(np.uint32)
+        if n_limb > 1:
+            out[:, 1] = (shifted >> 32).astype(np.uint32)
+        return out
+    values = encode_vect_exact(weights, scalar_clamped, config)
+    return limb_ops.ints_to_limbs(values, n_limb)
+
+
+# ---------------------------------------------------------------------------
+# decode (unmask)
+# ---------------------------------------------------------------------------
+
+
+def decode_scalar_sum(unit_value: int, unit_config: MaskConfig, nb_models: int) -> Fraction:
+    """Recover the aggregated scalar sum from the unmasked unit — exact."""
+    return Fraction(unit_value, unit_config.exp_shift) - nb_models * unit_config.add_shift
+
+
+def decode_vect_exact(
+    values: list[int], config: MaskConfig, nb_models: int, scalar_sum: Fraction
+) -> list[Fraction]:
+    a = config.add_shift
+    e = config.exp_shift
+    shift = nb_models * a
+    return [(Fraction(v, e) - shift) / scalar_sum for v in values]
+
+
+def decode_vect_fast(
+    limbs: np.ndarray, config: MaskConfig, nb_models: int, scalar_sum: Fraction
+) -> np.ndarray:
+    """Vectorized double-double decode -> float64 array (f32-accurate+)."""
+    assert has_fast_path(config)
+    # limbs -> double-double value (Horner over limbs, high to low)
+    n, n_limb = limbs.shape
+    hi = np.zeros(n)
+    lo = np.zeros(n)
+    for j in range(n_limb - 1, -1, -1):
+        hi, lo = dd.mul_f(hi, lo, 4294967296.0)
+        hi, lo = dd.add_f(hi, lo, limbs[:, j].astype(np.float64))
+    # subtract nb_models * A * E (exact integer)
+    c_hi, c_lo = dd.from_fraction(nb_models * int(config.add_shift) * config.exp_shift)
+    hi, lo = dd.sub(hi, lo, np.full(n, c_hi), np.full(n, c_lo))
+    # divide by E * scalar_sum
+    d_hi, d_lo = dd.from_fraction(config.exp_shift * scalar_sum)
+    hi, lo = dd.div(hi, lo, np.full(n, d_hi), np.full(n, d_lo))
+    return dd.to_float(hi, lo)

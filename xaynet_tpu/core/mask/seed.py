@@ -1,0 +1,66 @@
+"""Mask seeds and their sealed-box encryption.
+
+Reference: rust/xaynet-core/src/mask/seed.rs:48-136. A 32-byte seed expands
+(via the ChaCha20 rejection sampler) into a full mask object; update
+participants encrypt their seed for every sum participant's ephemeral key.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..crypto.encrypt import DecryptError, PublicEncryptKey, SecretEncryptKey, SEALBYTES
+from ..crypto.prng import StreamSampler
+from .config import MaskConfigPair
+from .object import MaskObject, MaskUnit, MaskVect
+
+MASK_SEED_LENGTH = 32
+ENCRYPTED_MASK_SEED_LENGTH = SEALBYTES + MASK_SEED_LENGTH  # 80
+
+
+@dataclass(frozen=True)
+class MaskSeed:
+    bytes_: bytes
+
+    def __post_init__(self):
+        if len(self.bytes_) != MASK_SEED_LENGTH:
+            raise ValueError("mask seed must be 32 bytes")
+
+    @classmethod
+    def generate(cls) -> "MaskSeed":
+        return cls(os.urandom(MASK_SEED_LENGTH))
+
+    def as_bytes(self) -> bytes:
+        return self.bytes_
+
+    def encrypt(self, pk: PublicEncryptKey) -> "EncryptedMaskSeed":
+        return EncryptedMaskSeed(pk.encrypt(self.bytes_))
+
+    def derive_mask(self, length: int, config: MaskConfigPair) -> MaskObject:
+        """Expand this seed into a mask: 1 unit draw, then ``length`` vector draws."""
+        sampler = StreamSampler(self.bytes_)
+        unit = sampler.draw_limbs(1, config.unit.order)[0]
+        vect = sampler.draw_limbs(length, config.vect.order)
+        return MaskObject(MaskVect(config.vect, vect), MaskUnit(config.unit, unit))
+
+
+@dataclass(frozen=True)
+class EncryptedMaskSeed:
+    bytes_: bytes
+
+    def __post_init__(self):
+        if len(self.bytes_) != ENCRYPTED_MASK_SEED_LENGTH:
+            raise ValueError("encrypted mask seed must be 80 bytes")
+
+    def as_bytes(self) -> bytes:
+        return self.bytes_
+
+    def decrypt(self, sk: SecretEncryptKey, pk: PublicEncryptKey | None = None) -> MaskSeed:
+        try:
+            plain = sk.decrypt(self.bytes_, pk)
+        except DecryptError:
+            raise
+        if len(plain) != MASK_SEED_LENGTH:
+            raise DecryptError("decrypted mask seed has invalid length")
+        return MaskSeed(plain)

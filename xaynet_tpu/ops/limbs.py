@@ -1,0 +1,179 @@
+"""Multi-limb finite-group arithmetic over numpy arrays (host path).
+
+The reference stores masked models as ``Vec<BigUint>`` and aggregates them
+with per-element big-integer modular adds (reference:
+rust/xaynet-core/src/mask/masking.rs:292-316). The TPU-native design instead
+represents a mask object as a fixed-width limb tensor
+
+    ``uint32[n, L]``  (limb 0 = least-significant 32 bits)
+
+so that aggregation is a flat, branch-free, vectorizable elementwise kernel:
+limb add with carry propagation followed by a conditional subtract of the
+group order. This module is the numpy host implementation and the conformance
+oracle for the JAX/Pallas device kernels in ``xaynet_tpu.ops.limbs_jax``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_U32 = np.uint32
+_U64 = np.uint64
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def n_limbs_for_order(order: int) -> int:
+    """Number of 32-bit limbs for elements of the group of this order.
+
+    Matches the wire width: ``bytes_per_number = ceil(bits(order - 1) / 8)``
+    rounded up to whole limbs.
+    """
+    bpn = ((order - 1).bit_length() + 7) // 8
+    return max(1, (bpn + 3) // 4)
+
+
+def order_limbs_for(order: int) -> np.ndarray:
+    """Group order as an L-limb constant for the modular kernels.
+
+    When the order is exactly ``2^(32L)`` (e.g. 2^96 from the catalogue) it
+    does not fit L limbs; the kernels then see all-zero limbs, which is
+    correct: the reduction condition degenerates to the carry bit and the
+    conditional subtract becomes the natural wraparound.
+    """
+    n_limb = n_limbs_for_order(order)
+    if order == 1 << (32 * n_limb):
+        return np.zeros(n_limb, dtype=_U32)
+    return int_to_limbs(order, n_limb)
+
+
+def elements_lt_order(data: np.ndarray, order: int) -> np.ndarray:
+    """Per-row validity ``element < order`` handling the 2^(32L) boundary."""
+    n_limb = n_limbs_for_order(order)
+    if order == 1 << (32 * n_limb):
+        return np.ones(data.shape[:-1], dtype=bool)
+    return lt_const(data, int_to_limbs(order, n_limb))
+
+
+def int_to_limbs(value: int, n_limbs: int) -> np.ndarray:
+    out = np.zeros(n_limbs, dtype=_U32)
+    for i in range(n_limbs):
+        out[i] = (value >> (32 * i)) & 0xFFFFFFFF
+    if value >> (32 * n_limbs):
+        raise OverflowError("value does not fit in the limb width")
+    return out
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    value = 0
+    for i in range(limbs.shape[-1] - 1, -1, -1):
+        value = (value << 32) | int(limbs[..., i])
+    return value
+
+
+def ints_to_limbs(values, n_limbs: int) -> np.ndarray:
+    """Convert an iterable of python ints to a ``uint32[n, L]`` limb array."""
+    values = list(values)
+    out = np.zeros((len(values), n_limbs), dtype=_U32)
+    for i, v in enumerate(values):
+        for j in range(n_limbs):
+            out[i, j] = (v >> (32 * j)) & 0xFFFFFFFF
+        if v >> (32 * n_limbs):
+            raise OverflowError("value does not fit in the limb width")
+    return out
+
+
+def limbs_to_ints(arr: np.ndarray) -> list[int]:
+    arr = np.asarray(arr, dtype=_U32)
+    n, n_limb = arr.shape
+    out = [0] * n
+    for j in range(n_limb - 1, -1, -1):
+        col = arr[:, j]
+        for i in range(n):
+            out[i] = (out[i] << 32) | int(col[i])
+    return out
+
+
+def bytes_le_to_limbs(buf: bytes | np.ndarray, count: int, bytes_per_number: int) -> np.ndarray:
+    """Parse ``count`` fixed-width little-endian integers into ``uint32[count, L]``."""
+    n_limb = max(1, (bytes_per_number + 3) // 4)
+    raw = np.frombuffer(buf, dtype=np.uint8, count=count * bytes_per_number)
+    padded = np.zeros((count, n_limb * 4), dtype=np.uint8)
+    padded[:, :bytes_per_number] = raw.reshape(count, bytes_per_number)
+    return padded.view("<u4").astype(_U32, copy=False)
+
+
+def limbs_to_bytes_le(arr: np.ndarray, bytes_per_number: int) -> bytes:
+    """Serialize ``uint32[n, L]`` limbs as fixed-width little-endian integers."""
+    arr = np.ascontiguousarray(np.asarray(arr, dtype=_U32))
+    n = arr.shape[0]
+    raw = arr.astype("<u4").view(np.uint8).reshape(n, -1)
+    return raw[:, :bytes_per_number].tobytes()
+
+
+def lt_const(a: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
+    """Lexicographic ``a < order`` per element, over the trailing limb axis."""
+    shape = a.shape[:-1]
+    lt = np.zeros(shape, dtype=bool)
+    decided = np.zeros(shape, dtype=bool)
+    for j in range(a.shape[-1] - 1, -1, -1):
+        col = a[..., j]
+        o = order_limbs[j]
+        lt |= (~decided) & (col < o)
+        decided |= col != o
+    return lt
+
+
+def add_limbs(a: np.ndarray, b: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Limbwise ``a + b`` with carry propagation; returns (sum, carry_out)."""
+    n_limb = a.shape[-1]
+    out = np.empty_like(a)
+    carry = np.zeros(a.shape[:-1], dtype=_U64)
+    for j in range(n_limb):
+        s = a[..., j].astype(_U64) + b[..., j].astype(_U64) + carry
+        out[..., j] = (s & _MASK32).astype(_U32)
+        carry = s >> np.uint64(32)
+    return out, carry.astype(_U32)
+
+
+def sub_limbs(a: np.ndarray, b: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Limbwise ``a - b`` with borrow propagation; returns (diff, borrow_out)."""
+    n_limb = a.shape[-1]
+    out = np.empty_like(a)
+    borrow = np.zeros(a.shape[:-1], dtype=_U64)
+    for j in range(n_limb):
+        d = a[..., j].astype(_U64) - b[..., j].astype(_U64) - borrow
+        out[..., j] = (d & _MASK32).astype(_U32)
+        borrow = (d >> np.uint64(63)) & np.uint64(1)  # underflow wraps in u64
+    return out, borrow.astype(_U32)
+
+
+def mod_add(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
+    """``(a + b) mod order`` assuming ``a, b < order`` (branch-free)."""
+    s, carry = add_limbs(a, b)
+    # sum >= order  <=>  carry set (sum overflowed the limb width) or s >= order
+    ge = carry.astype(bool) | ~lt_const(s, order_limbs)
+    d, _ = sub_limbs(s, np.broadcast_to(order_limbs, s.shape))
+    return np.where(ge[..., None], d, s)
+
+
+def mod_sub(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
+    """``(a - b) mod order`` assuming ``a, b < order``."""
+    d, borrow = sub_limbs(a, b)
+    d2, _ = add_limbs(d, np.broadcast_to(order_limbs, d.shape))
+    return np.where(borrow.astype(bool)[..., None], d2, d)
+
+
+def batch_mod_sum(stack: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
+    """Modular sum over axis 0 of ``uint32[K, n, L]`` via pairwise tree reduce.
+
+    Each pairwise step keeps every element ``< order``, so the depth is
+    ``ceil(log2 K)`` and every level is a flat elementwise kernel.
+    """
+    while stack.shape[0] > 1:
+        k = stack.shape[0]
+        half = k // 2
+        merged = mod_add(stack[:half], stack[half : 2 * half], order_limbs)
+        if k % 2:
+            merged = np.concatenate([merged, stack[2 * half :]], axis=0)
+        stack = merged
+    return stack[0]
