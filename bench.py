@@ -1,0 +1,89 @@
+"""Headline benchmark: masked-update aggregation throughput @ 25M params.
+
+North star (BASELINE.json): aggregate 10k masked 25M-parameter updates in
+< 60 s on TPU — i.e. >= 166.7 updates/s. The reference aggregates with a
+sequential per-update big-int loop on one CPU core
+(rust/xaynet-core/src/mask/masking.rs:292-316); here updates are planar
+uint32 limb tensors folded into an HBM-resident accumulator with the
+single-pass lazy-carry kernel (xaynet_tpu/ops/fold_jax.py).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "updates/s", "vs_baseline": N}
+``vs_baseline`` is the speedup over the 166.7 updates/s target.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(x) -> None:
+    # device->host fetch: reliable completion barrier on every backend
+    np.asarray(x[:1, :8])
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
+    from xaynet_tpu.ops import limbs as host_limbs
+    from xaynet_tpu.ops.fold_jax import fold_planar_batch
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    # M6 allows up to 1e6 aggregated models (covers the 10k target)
+    config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    n_limb = host_limbs.n_limbs_for_order(config.order)
+    order = config.order
+
+    model_len = 25_000_000 if on_tpu else 1_000_000
+    k = 16 if on_tpu else 8  # updates per staged batch (HBM budget)
+    n_batches = 24 if on_tpu else 4
+    warmup = 2
+
+    # Synthesize K masked updates host-side in the planar device layout
+    # (uniform group elements are exactly what masked updates look like).
+    rng = np.random.default_rng(0)
+    host_stack = rng.integers(0, 2**32, size=(k, n_limb, model_len), dtype=np.uint32)
+    host_stack[:, n_limb - 1, :] &= np.uint32((1 << 20) - 1)
+    stack = jax.device_put(host_stack)
+    del host_stack
+
+    acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+    acc = fold_planar_batch(acc, stack, order)  # compile
+    _sync(acc)
+
+    for _ in range(warmup):
+        acc = fold_planar_batch(acc, stack, order)
+    _sync(acc)
+
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        acc = fold_planar_batch(acc, stack, order)
+    _sync(acc)
+    dt = time.perf_counter() - t0
+
+    updates = k * n_batches
+    ups = updates / dt
+    # scale CPU smoke runs to the 25M-param metric so the number is comparable
+    scaled_ups = ups * (model_len / 25_000_000)
+    baseline = 10_000 / 60.0  # north-star floor: 10k updates in 60s
+    print(
+        json.dumps(
+            {
+                "metric": "masked-update aggregation throughput @25M params (PET update phase)",
+                "value": round(scaled_ups, 2),
+                "unit": "updates/s",
+                "vs_baseline": round(scaled_ups / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,14 +3,22 @@
 Sharding tests run on a virtual 8-device CPU mesh
 (``--xla_force_host_platform_device_count``); benchmarks use real TPU
 hardware separately via ``bench.py``.
+
+The image's sitecustomize registers the axon TPU backend and overrides
+``jax_platforms``, so forcing the env var alone is not enough — the config
+must be re-set after import and before first backend use.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,161 @@
+"""Device kernels vs numpy host oracle (run on a virtual 8-device CPU mesh)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto.prng import StreamSampler, uniform_ints
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    Masker,
+    MaskConfig,
+    MaskSeed,
+    ModelType,
+    Scalar,
+)
+from xaynet_tpu.ops import chacha_jax, limbs as host_limbs, limbs_jax, masking_jax
+
+CFG = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+ORDERS = [20_000_000_000_001, 2**45, 2**96, 200_000_000_000_000_000_000_000_000_017]
+
+
+@pytest.mark.parametrize("order", ORDERS)
+def test_mod_add_sub_device(order):
+    rng = random.Random(11)
+    n_limb = host_limbs.n_limbs_for_order(order)
+    ol = host_limbs.order_limbs_for(order)
+    a = [rng.randrange(order) for _ in range(64)]
+    b = [rng.randrange(order) for _ in range(64)]
+    aa = host_limbs.ints_to_limbs(a, n_limb)
+    bb = host_limbs.ints_to_limbs(b, n_limb)
+
+    got_add = np.asarray(limbs_jax.mod_add(aa, bb, ol))
+    assert np.array_equal(got_add, host_limbs.mod_add(aa, bb, ol))
+    got_sub = np.asarray(limbs_jax.mod_sub(aa, bb, ol))
+    assert np.array_equal(got_sub, host_limbs.mod_sub(aa, bb, ol))
+
+
+@pytest.mark.parametrize("k", [1, 2, 5, 16, 33])
+def test_batch_mod_sum_device(k):
+    order = ORDERS[0]
+    rng = random.Random(k)
+    n_limb = host_limbs.n_limbs_for_order(order)
+    ol = host_limbs.order_limbs_for(order)
+    stack = np.stack(
+        [host_limbs.ints_to_limbs([rng.randrange(order) for _ in range(24)], n_limb) for _ in range(k)]
+    )
+    got = np.asarray(limbs_jax.batch_mod_sum(stack, ol))
+    assert np.array_equal(got, host_limbs.batch_mod_sum(stack, ol))
+
+
+def test_device_keystream_matches_host():
+    from xaynet_tpu.core.crypto.chacha import keystream_blocks
+    import jax.numpy as jnp
+
+    key = bytes(range(32))
+    words = chacha_jax.keystream_words(jnp.asarray(np.frombuffer(key, dtype="<u4")), 0, 8)
+    host = np.frombuffer(bytes(keystream_blocks(key, 0, 8)), dtype="<u4").reshape(8, 16)
+    assert np.array_equal(np.asarray(words), host)
+
+
+@pytest.mark.parametrize("order", ORDERS)
+def test_device_sampler_matches_host(order):
+    seed = b"\x05" * 32
+    got = host_limbs.limbs_to_ints(np.asarray(chacha_jax.derive_uniform_limbs(seed, 200, order)))
+    assert got == uniform_ints(seed, 200, order)
+
+
+def test_device_sampler_with_offset():
+    seed = b"\x09" * 32
+    order = CFG.order
+    sampler = StreamSampler(seed)
+    sampler.draw_limbs(1, MaskConfig(GroupType.PRIME, DataType.F64, BoundType.B2, ModelType.M3).order)
+    offset = sampler.consumed_bytes
+    expected = host_limbs.limbs_to_ints(sampler.draw_limbs(50, order))
+    got = host_limbs.limbs_to_ints(
+        np.asarray(chacha_jax.derive_uniform_limbs(seed, 50, order, byte_offset=offset))
+    )
+    assert got == expected
+
+
+def test_derive_mask_device_matches_host():
+    seed = MaskSeed(b"\x21" * 32)
+    mask_host = seed.derive_mask(100, CFG.pair())
+    unit, vect = masking_jax.derive_mask_limbs(seed.as_bytes(), 100, CFG.pair())
+    assert np.array_equal(unit, mask_host.unit.data)
+    assert np.array_equal(np.asarray(vect), mask_host.vect.data)
+
+
+def test_sharded_aggregator_full_round():
+    """Masked updates -> sharded aggregation -> unmask == host Aggregation."""
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+    n, k = 103, 9  # deliberately not divisible by 8 devices
+    rng = np.random.default_rng(2)
+    cfg = CFG
+    agg_host = Aggregation(cfg.pair(), n)
+    mask_agg = Aggregation(cfg.pair(), n)
+    stacks = []
+    for _ in range(k):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        seed, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
+        mask = seed.derive_mask(n, cfg.pair())
+        agg_host.aggregate(masked)
+        mask_agg.aggregate(mask)
+        stacks.append(masked.vect.data)
+
+    dev = ShardedAggregator(cfg, n)
+    dev.add_batch(np.stack(stacks[:4]))
+    dev.add_batch(np.stack(stacks[4:]))
+    assert dev.nb_models == k
+    assert np.array_equal(dev.snapshot(), agg_host.object.vect.data)
+
+    unmasked_limbs = dev.unmask_limbs(mask_agg.object.vect.data)
+    host_limbs_ref, _ = agg_host._unmasked_limbs(mask_agg.object)
+    assert np.array_equal(unmasked_limbs, host_limbs_ref)
+
+
+def test_sum_masks_device():
+    seeds = [bytes([i]) * 32 for i in range(1, 6)]
+    n = 40
+    got_unit, got_vect = masking_jax.sum_masks(seeds, n, CFG.pair())
+
+    agg = Aggregation(CFG.pair(), n)
+    for s in seeds:
+        agg.aggregate(MaskSeed(s).derive_mask(n, CFG.pair()))
+    assert np.array_equal(got_unit, agg.object.unit.data)
+    assert np.array_equal(np.asarray(got_vect), agg.object.vect.data)
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6),
+        MaskConfig(GroupType.POWER2, DataType.I32, BoundType.BMAX, ModelType.M9),  # 2^96
+        MaskConfig(GroupType.PRIME, DataType.F64, BoundType.B6, ModelType.M3),
+    ],
+)
+@pytest.mark.parametrize("k", [1, 2, 13, 64])
+def test_fold_planar_batch(cfg, k):
+    """Single-pass lazy-carry fold == python big-int oracle."""
+    import jax.numpy as jnp
+
+    from xaynet_tpu.ops.fold_jax import fold_planar_batch, wire_to_planar
+
+    order = cfg.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    rng = random.Random(k)
+    n = 50
+    rows = [[rng.randrange(order) for _ in range(n)] for _ in range(k)]
+    stack = np.stack([host_limbs.ints_to_limbs(r, n_limb) for r in rows])
+    acc0 = [rng.randrange(order) for _ in range(n)]
+    acc = jnp.asarray(wire_to_planar(host_limbs.ints_to_limbs(acc0, n_limb)))
+
+    out = fold_planar_batch(acc, jnp.asarray(wire_to_planar(stack)), order)
+    got = host_limbs.limbs_to_ints(np.ascontiguousarray(np.asarray(out).T))
+    want = [(acc0[j] + sum(rows[i][j] for i in range(k))) % order for j in range(n)]
+    assert got == want

@@ -46,6 +46,20 @@ class StreamSampler:
         self._block = 0
         self._leftover = np.zeros(0, dtype=np.uint8)
 
+    @property
+    def consumed_bytes(self) -> int:
+        """Bytes of keystream consumed so far (for device-kernel handoff)."""
+        return self._block * BLOCK_BYTES - len(self._leftover)
+
+    def skip_bytes(self, n: int) -> None:
+        """Advance the stream cursor by ``n`` bytes without drawing."""
+        while n > 0:
+            if len(self._leftover) == 0:
+                self._leftover = self._more_keystream(n)
+            take = min(n, len(self._leftover))
+            self._leftover = self._leftover[take:]
+            n -= take
+
     def _more_keystream(self, nbytes: int) -> np.ndarray:
         nblocks = max(4, -(-nbytes // BLOCK_BYTES))
         ks = keystream_blocks(self._seed, self._block, nblocks)

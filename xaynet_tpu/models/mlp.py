@@ -1,0 +1,71 @@
+"""Regression MLP — baseline config #1 (keras house-prices analogue).
+
+The reference ships a 2-layer Keras MLP example trained by 10 federated
+participants (reference: bindings/python/examples/keras_house_prices/). This
+is the JAX/flax equivalent with a jittable local-training step; participants
+run it inside ``train_round`` and hand the flattened weight vector to the
+masking pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class MLP(nn.Module):
+    """2-hidden-layer regression MLP (house-prices baseline)."""
+
+    features: Sequence[int] = (64, 32)
+
+    @nn.compact
+    def __call__(self, x):
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(1)(x)
+
+
+def init_params(rng, input_dim: int, features: Sequence[int] = (64, 32)):
+    model = MLP(features)
+    return model.init(rng, jnp.zeros((1, input_dim)))
+
+
+def make_train_step(features: Sequence[int] = (64, 32), learning_rate: float = 1e-3):
+    """Returns (jittable) ``step(params, opt_state, x, y) -> (params, opt_state, loss)``."""
+    model = MLP(features)
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, x, y):
+        pred = model.apply(params, x)
+        return jnp.mean((pred.squeeze(-1) - y) ** 2)
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return model, tx, step
+
+
+def flatten_params(params) -> np.ndarray:
+    """Flatten a pytree of weights into one f32 vector (masking order)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return np.concatenate([np.asarray(l).ravel() for l in leaves]).astype(np.float32)
+
+
+def unflatten_params(template, flat: np.ndarray):
+    """Inverse of ``flatten_params`` against a template pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = []
+    pos = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        out.append(jnp.asarray(flat[pos : pos + n], dtype=leaf.dtype).reshape(leaf.shape))
+        pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,183 @@
+"""ChaCha20 keystream and mask expansion as JAX device kernels.
+
+Device counterpart of ``xaynet_tpu.core.crypto.chacha`` /
+``prng.StreamSampler``. ChaCha20 is pure 32-bit add/xor/rotate — ideal VPU
+work — and mask derivation (seed -> ``len`` uniform group elements,
+reference: rust/xaynet-core/src/mask/seed.rs:61-78) becomes:
+
+1. generate a statically over-provisioned batch of keystream blocks
+   (all blocks in parallel: lanes = blocks);
+2. chop into fixed-width little-endian candidates;
+3. rejection-filter (candidate < order) with a scatter compaction instead of
+   a data-dependent loop, keeping shapes static under jit.
+
+The over-provisioning factor is chosen so the probability of producing fewer
+than ``count`` accepted candidates is < 2^-60; the (astronomically rare)
+shortfall is detected by the caller and falls back to the host sampler,
+preserving bit-exactness unconditionally.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+_CONSTANTS = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _rotl(x, n):
+    return (x << _U32(n)) | (x >> _U32(32 - n))
+
+
+def _quarter(s, a, b, c, d):
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 7)
+    return s
+
+
+@partial(jax.jit, static_argnames=("nblocks", "block_start"))
+def keystream_words(key_words: jax.Array, block_start: int, nblocks: int) -> jax.Array:
+    """ChaCha20 keystream as ``uint32[nblocks, 16]`` little-endian words."""
+    # 64-bit block counter in words 12-13; counters stay below 2^32 here
+    # (2^32 blocks = 256 GiB of keystream per seed), so word 13 is constant.
+    if block_start + nblocks > 0xFFFFFFFF:
+        raise ValueError("keystream longer than 2^32 blocks is not supported on device")
+    counters = _U32(block_start) + jnp.arange(nblocks, dtype=_U32)
+    state = [jnp.broadcast_to(_U32(c), (nblocks,)) for c in _CONSTANTS]
+    state += [jnp.broadcast_to(key_words[i], (nblocks,)) for i in range(8)]
+    state.append(counters)
+    state += [jnp.zeros(nblocks, dtype=_U32)] * 3
+
+    w = list(state)
+    for _ in range(10):
+        w = _quarter(w, 0, 4, 8, 12)
+        w = _quarter(w, 1, 5, 9, 13)
+        w = _quarter(w, 2, 6, 10, 14)
+        w = _quarter(w, 3, 7, 11, 15)
+        w = _quarter(w, 0, 5, 10, 15)
+        w = _quarter(w, 1, 6, 11, 12)
+        w = _quarter(w, 2, 7, 8, 13)
+        w = _quarter(w, 3, 4, 9, 14)
+    out = [wi + si for wi, si in zip(w, state)]
+    return jnp.stack(out, axis=-1)  # [nblocks, 16]
+
+
+def _words_to_bytes(words: jax.Array) -> jax.Array:
+    """uint32[..., W] little-endian words -> uint8[..., W*4]."""
+    b0 = (words & _U32(0xFF)).astype(jnp.uint8)
+    b1 = ((words >> _U32(8)) & _U32(0xFF)).astype(jnp.uint8)
+    b2 = ((words >> _U32(16)) & _U32(0xFF)).astype(jnp.uint8)
+    b3 = ((words >> _U32(24)) & _U32(0xFF)).astype(jnp.uint8)
+    return jnp.stack([b0, b1, b2, b3], axis=-1).reshape(*words.shape[:-1], -1)
+
+
+def provision_candidates(count: int, order: int) -> int:
+    """Candidates to draw so that P(accepted < count) < ~2^-60."""
+    bpn = (order.bit_length() + 7) // 8
+    p = order / float(1 << (8 * bpn)) if order.bit_length() <= 1000 else 1.0
+    p = max(min(p, 1.0), 1e-9)
+    # Chernoff: need C with C*p - 7*sqrt(C*p*(1-p)) >= count
+    c = count / p
+    c += 7.0 * math.sqrt(max(c * (1 - p), 1.0)) / p + 64
+    return int(c)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("count", "n_cand", "bpn", "out_limbs", "order_tuple", "byte_offset"),
+)
+def _derive_kernel(
+    key_words: jax.Array,
+    count: int,
+    n_cand: int,
+    bpn: int,
+    out_limbs: int,
+    order_tuple: tuple[int, ...],
+    byte_offset: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Keystream -> candidates -> compacted accepted limbs (static shapes).
+
+    ``byte_offset`` skips keystream bytes already consumed by earlier draws
+    on the same stream (e.g. the unit draw preceding the vector draws).
+    """
+    nbytes = n_cand * bpn
+    block_start = byte_offset // 64
+    intra = byte_offset % 64
+    nblocks = -(-(intra + nbytes) // 64)
+    words = keystream_words(key_words, block_start, nblocks)
+    stream = _words_to_bytes(words).reshape(-1)[intra : intra + nbytes]
+
+    cand_limbs = max(1, (bpn + 3) // 4)
+    padded = jnp.zeros((n_cand, cand_limbs * 4), dtype=jnp.uint8)
+    padded = padded.at[:, :bpn].set(stream.reshape(n_cand, bpn))
+    # little-endian bytes -> uint32 limbs
+    quads = padded.reshape(n_cand, cand_limbs, 4).astype(_U32)
+    cand = (
+        quads[..., 0]
+        | (quads[..., 1] << _U32(8))
+        | (quads[..., 2] << _U32(16))
+        | (quads[..., 3] << _U32(24))
+    )
+
+    # acceptance: lexicographic candidate < order
+    order_arr = np.asarray(order_tuple, dtype=np.uint32)
+    lt = jnp.zeros(n_cand, dtype=bool)
+    decided = jnp.zeros(n_cand, dtype=bool)
+    for j in range(cand_limbs - 1, -1, -1):
+        col = cand[:, j]
+        o = _U32(int(order_arr[j]))
+        lt = lt | (~decided & (col < o))
+        decided = decided | (col != o)
+
+    # compaction: accepted candidate i goes to slot rank(i); drop overflow
+    rank = jnp.cumsum(lt.astype(jnp.int32)) - 1
+    slot = jnp.where(lt, rank, count)  # rejected -> out-of-range slot
+    out = jnp.zeros((count + 1, cand_limbs), dtype=_U32)
+    out = out.at[slot].set(cand, mode="drop")
+    n_accepted = rank[-1] + 1
+    return out[:count, :out_limbs], n_accepted
+
+
+def derive_uniform_limbs(
+    seed: bytes, count: int, order: int, byte_offset: int = 0
+) -> jax.Array:
+    """Device mask expansion: ``count`` uniform elements below ``order``.
+
+    Bit-identical to the host ``StreamSampler`` (same keystream, same
+    rejection rule). Falls back to the host sampler on the ~2^-60 shortfall.
+    """
+    from ..core.crypto import prng as host_prng
+    from . import limbs as host_limbs
+
+    bpn = (order.bit_length() + 7) // 8
+    cand_limbs = max(1, (bpn + 3) // 4)
+    out_limbs = host_limbs.n_limbs_for_order(order)
+    order_cl = host_limbs.int_to_limbs(order, cand_limbs)
+    n_cand = provision_candidates(count, order)
+    key_words = jnp.asarray(np.frombuffer(seed, dtype="<u4"))
+    out, n_accepted = _derive_kernel(
+        key_words,
+        count,
+        n_cand,
+        bpn,
+        out_limbs,
+        tuple(int(x) for x in order_cl),
+        byte_offset,
+    )
+    if int(n_accepted) < count:  # pragma: no cover — probability < 2^-60
+        sampler = host_prng.StreamSampler(seed)
+        if byte_offset:
+            sampler.skip_bytes(byte_offset)
+        return jnp.asarray(sampler.draw_limbs(count, order))
+    return out

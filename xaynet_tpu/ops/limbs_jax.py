@@ -1,0 +1,125 @@
+"""Finite-group limb arithmetic as JAX/XLA device kernels.
+
+Device counterpart of ``xaynet_tpu.ops.limbs`` (the numpy oracle): masked
+models are ``uint32[n, L]`` limb tensors; modular addition is a carry chain
+(statically unrolled over the small limb count) plus a conditional subtract
+of the group order — flat, branch-free elementwise code that XLA fuses into
+a single memory-bound kernel. The batch reducer pads to a power of two and
+tree-halves, so aggregating K updates costs ``ceil(log2 K)`` fused
+elementwise passes over HBM.
+
+These kernels implement the coordinator hot loop the reference runs as
+sequential big-int loops (reference: rust/xaynet-core/src/mask/masking.rs:292-316).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+
+def _as_order(order_limbs) -> np.ndarray:
+    return np.asarray(order_limbs, dtype=np.uint32)
+
+
+def add_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Limbwise ``a + b`` with carry propagation; returns (sum, carry_out)."""
+    n_limb = a.shape[-1]
+    outs = []
+    carry = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for j in range(n_limb):
+        s1 = a[..., j] + b[..., j]  # wraps mod 2^32
+        c1 = (s1 < a[..., j]).astype(_U32)
+        s2 = s1 + carry
+        c2 = (s2 < s1).astype(_U32)
+        outs.append(s2)
+        carry = c1 | c2
+    return jnp.stack(outs, axis=-1), carry
+
+
+def sub_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Limbwise ``a - b`` with borrow propagation; returns (diff, borrow_out)."""
+    n_limb = a.shape[-1]
+    outs = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for j in range(n_limb):
+        d1 = a[..., j] - b[..., j]
+        b1 = (a[..., j] < b[..., j]).astype(_U32)
+        d2 = d1 - borrow
+        b2 = (d1 < borrow).astype(_U32)
+        outs.append(d2)
+        borrow = b1 | b2
+    return jnp.stack(outs, axis=-1), borrow
+
+
+def lt_const(a: jax.Array, order_limbs: np.ndarray) -> jax.Array:
+    """Lexicographic ``a < order`` over the trailing limb axis."""
+    order_limbs = _as_order(order_limbs)
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    decided = jnp.zeros(a.shape[:-1], dtype=bool)
+    for j in range(a.shape[-1] - 1, -1, -1):
+        col = a[..., j]
+        o = _U32(int(order_limbs[j]))
+        lt = lt | (~decided & (col < o))
+        decided = decided | (col != o)
+    return lt
+
+
+def mod_add(a: jax.Array, b: jax.Array, order_limbs: np.ndarray) -> jax.Array:
+    """``(a + b) mod order`` assuming ``a, b < order`` (branch-free).
+
+    Works for the ``order == 2^(32L)`` boundary case too: the order limbs are
+    all zero there, so ``lt_const`` is always false and the subtract of zero
+    is the identity — reduction degenerates to the natural wraparound.
+    """
+    order_limbs = _as_order(order_limbs)
+    s, carry = add_limbs(a, b)
+    ge = (carry != 0) | ~lt_const(s, order_limbs)
+    o = jnp.asarray(order_limbs, dtype=_U32)
+    d, _ = sub_limbs(s, jnp.broadcast_to(o, s.shape))
+    return jnp.where(ge[..., None], d, s)
+
+
+def mod_sub(a: jax.Array, b: jax.Array, order_limbs: np.ndarray) -> jax.Array:
+    """``(a - b) mod order`` assuming ``a, b < order``."""
+    order_limbs = _as_order(order_limbs)
+    d, borrow = sub_limbs(a, b)
+    o = jnp.asarray(order_limbs, dtype=_U32)
+    d2, _ = add_limbs(d, jnp.broadcast_to(o, d.shape))
+    return jnp.where((borrow != 0)[..., None], d2, d)
+
+
+def batch_mod_sum(stack: jax.Array, order_limbs: np.ndarray) -> jax.Array:
+    """Modular sum over axis 0 of ``uint32[K, n, L]`` via pow2 tree reduce.
+
+    Zero rows are valid group elements, so padding K to a power of two with
+    zeros keeps every level exact; shapes stay static for jit.
+    """
+    k = stack.shape[0]
+    if k == 0:
+        raise ValueError("empty batch")
+    k2 = 1 << (k - 1).bit_length()
+    if k2 != k:
+        pad = jnp.zeros((k2 - k, *stack.shape[1:]), dtype=stack.dtype)
+        stack = jnp.concatenate([stack, pad], axis=0)
+    while stack.shape[0] > 1:
+        half = stack.shape[0] // 2
+        stack = mod_add(stack[:half], stack[half:], order_limbs)
+    return stack[0]
+
+
+@partial(jax.jit, static_argnames=("order_tuple",), donate_argnums=(0,))
+def _aggregate_batch_kernel(acc: jax.Array, stack: jax.Array, order_tuple: tuple[int, ...]) -> jax.Array:
+    order_limbs = np.asarray(order_tuple, dtype=np.uint32)
+    batch = batch_mod_sum(stack, order_limbs)
+    return mod_add(acc, batch, order_limbs)
+
+
+def aggregate_batch(acc: jax.Array, stack: jax.Array, order_limbs: np.ndarray) -> jax.Array:
+    """Fold ``uint32[K, n, L]`` updates into the running accumulator (jitted)."""
+    return _aggregate_batch_kernel(acc, stack, tuple(int(x) for x in _as_order(order_limbs)))

@@ -1,0 +1,67 @@
+"""Device-side masking operations: mask expansion, aggregation, unmask.
+
+Composes the ChaCha20 and limb kernels into the protocol-level device ops the
+coordinator and sum participants run:
+
+- ``derive_mask_limbs``: seed -> (unit element, vector limb tensor), the
+  device version of ``MaskSeed.derive_mask`` (bit-identical keystream
+  consumption: one unit draw on the host cursor, vector draws on device from
+  the handed-off byte offset);
+- ``unmask_vect_limbs``: modular subtract of the aggregated mask from the
+  aggregated masked model (the Unmask-phase kernel);
+- ``sum_masks``: aggregate many seed-derived masks (the Sum2 participant hot
+  loop: #updates x model_length group elements).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.crypto.prng import StreamSampler
+from ..core.mask.config import MaskConfigPair
+from . import chacha_jax, limbs as host_limbs, limbs_jax
+
+
+def derive_mask_limbs(
+    seed: bytes, length: int, config: MaskConfigPair
+) -> tuple[np.ndarray, jax.Array]:
+    """Expand a 32-byte seed into (unit limbs [L1], vector limbs [length, L])."""
+    sampler = StreamSampler(seed)
+    unit = sampler.draw_limbs(1, config.unit.order)[0]
+    offset = sampler.consumed_bytes
+    vect = chacha_jax.derive_uniform_limbs(seed, length, config.vect.order, byte_offset=offset)
+    return unit, vect
+
+
+def unmask_vect_limbs(
+    masked: jax.Array, mask: jax.Array, order: int
+) -> jax.Array:
+    """``(masked - mask) mod order`` elementwise over limb tensors."""
+    return limbs_jax.mod_sub(masked, mask, host_limbs.order_limbs_for(order))
+
+
+def sum_masks(
+    seeds: list[bytes], length: int, config: MaskConfigPair
+) -> tuple[np.ndarray, jax.Array]:
+    """Derive and modularly sum the masks of many seeds (Sum2 hot loop).
+
+    Returns (unit limbs, vector limbs) of the aggregated mask.
+    """
+    if not seeds:
+        raise ValueError("no seeds to aggregate")
+    order_limbs_u = host_limbs.order_limbs_for(config.unit.order)
+    order_limbs_v = host_limbs.order_limbs_for(config.vect.order)
+
+    unit_acc: np.ndarray | None = None
+    vect_acc: jax.Array | None = None
+    for seed in seeds:
+        unit, vect = derive_mask_limbs(seed, length, config)
+        if vect_acc is None:
+            unit_acc, vect_acc = unit, vect
+        else:
+            unit_acc = host_limbs.mod_add(unit_acc[None, :], unit[None, :], order_limbs_u)[0]
+            vect_acc = limbs_jax.mod_add(vect_acc, vect, order_limbs_v)
+    assert unit_acc is not None and vect_acc is not None
+    return unit_acc, vect_acc

@@ -1,0 +1,100 @@
+"""Sharded device-resident aggregation of masked updates.
+
+The coordinator-side hot path (reference analogue:
+rust/xaynet-server/src/state_machine/phases/update.rs:119-152, which does one
+sequential big-int pass per accepted update). Here the running aggregate is
+an HBM-resident **planar** ``uint32[L, padded_len]`` buffer sharded over the
+model-length axis of a device mesh; incoming masked updates are staged into
+``[K, L, padded_len]`` batches and folded in with the single-pass lazy-carry
+kernel (``ops.fold_jax``) — one full read of the batch plus a handful of
+tiny passes, no collectives (the length axis is embarrassingly parallel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.mask.config import MaskConfig
+from ..ops import limbs as host_limbs
+from ..ops.fold_jax import MAX_LAZY_BATCH, fold_planar_batch, p_mod_sub, wire_to_planar
+from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple
+
+_unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
+
+
+class ShardedAggregator:
+    """Accumulates masked updates on-device, sharded over the model axis."""
+
+    def __init__(self, config: MaskConfig, model_length: int, mesh=None):
+        self.config = config
+        self.model_length = model_length
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        self.padded_length = pad_to_multiple(model_length, n_dev)
+        self.n_limbs = host_limbs.n_limbs_for_order(config.order)
+        self.order = config.order
+        # planar shardings: model axis is the innermost (lane) dimension
+        self._acc_sharding = NamedSharding(self.mesh, P(None, MODEL_AXIS))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, None, MODEL_AXIS))
+        self.acc = jax.device_put(
+            jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding
+        )
+        self.nb_models = 0
+
+    def _to_planar_padded(self, stack: np.ndarray) -> np.ndarray:
+        """Wire ``[K, n, L]`` -> planar padded ``[K, L, padded_len]`` (host)."""
+        planar = wire_to_planar(stack)
+        if self.padded_length != planar.shape[2]:
+            planar = np.pad(planar, ((0, 0), (0, 0), (0, self.padded_length - planar.shape[2])))
+        return planar
+
+    def add_batch(self, stack) -> None:
+        """Fold wire-layout ``uint32[K, model_len, L]`` updates into the aggregate.
+
+        Zero padding columns are valid group elements, so padding never
+        affects the real slice.
+        """
+        stack = np.asarray(stack, dtype=np.uint32)
+        if stack.ndim != 3 or stack.shape[2] != self.n_limbs:
+            raise ValueError("expected uint32[K, model_len, L]")
+        if stack.shape[1] != self.model_length:
+            raise ValueError("model length mismatch")
+        if stack.shape[0] > MAX_LAZY_BATCH:
+            raise ValueError("batch too large for lazy-carry fold")
+        staged = jax.device_put(self._to_planar_padded(stack), self._batch_sharding)
+        self.acc = fold_planar_batch(self.acc, staged, self.order)
+        self.nb_models += stack.shape[0]
+
+    def add_planar_batch(self, stack_planar: jax.Array) -> None:
+        """Fold an already device-resident planar ``[K, L, padded_len]`` batch."""
+        self.acc = fold_planar_batch(self.acc, stack_planar, self.order)
+        self.nb_models += stack_planar.shape[0]
+
+    def unmask_limbs(self, mask_vect) -> np.ndarray:
+        """Subtract the aggregated mask; returns host wire ``uint32[model_len, L]``."""
+        mask = np.asarray(mask_vect, dtype=np.uint32)
+        planar = wire_to_planar(mask) if mask.shape == (self.model_length, self.n_limbs) else mask
+        if planar.shape[1] != self.padded_length:
+            planar = np.pad(planar, ((0, 0), (0, self.padded_length - planar.shape[1])))
+        mask_dev = jax.device_put(jnp.asarray(planar), self._acc_sharding)
+        out = _unmask_kernel(self.acc, mask_dev, self.order)
+        return np.ascontiguousarray(np.asarray(out)[:, : self.model_length].T)
+
+    def snapshot(self) -> np.ndarray:
+        """Host wire-layout copy of the aggregate (checkpoints / tests)."""
+        return np.ascontiguousarray(np.asarray(self.acc)[:, : self.model_length].T)
+
+    def restore(self, wire: np.ndarray, nb_models: int) -> None:
+        """Restore from a host wire-layout snapshot."""
+        planar = self._to_planar_padded(wire[None, :, :])[0]
+        self.acc = jax.device_put(jnp.asarray(planar), self._acc_sharding)
+        self.nb_models = nb_models
+
+    def reset(self) -> None:
+        self.acc = jax.device_put(
+            jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding
+        )
+        self.nb_models = 0

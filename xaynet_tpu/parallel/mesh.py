@@ -1,0 +1,45 @@
+"""Device meshes and shardings for the aggregation buffers.
+
+The reference scales by a single-threaded bignum loop on one CPU core; the
+TPU-native design shards the ``uint32[model_len, L]`` aggregation buffer over
+the model-length axis of a 1-D device mesh (``NamedSharding``). Modular
+aggregation and unmasking are purely elementwise over that axis, so the
+sharded kernels run with zero collectives — each device owns a contiguous
+slice of the model and the full round needs only the initial host->device
+scatter and the final gather. Multi-host pods extend the same mesh over
+ICI/DCN without code changes (jax.sharding handles placement).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices, named for the model axis."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (MODEL_AXIS,))
+
+
+def model_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``[model_len, L]`` limb buffers: split the length axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def batch_model_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``[K, model_len, L]`` staging batches: split the length axis."""
+    return NamedSharding(mesh, P(None, MODEL_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Model length padded so every device holds an equal slice."""
+    return -(-n // k) * k
