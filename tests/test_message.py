@@ -1,0 +1,171 @@
+"""Wire-format round-trip tests (reference test strategy §4.2)."""
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto import EncryptKeyPair, SigningKeyPair
+from xaynet_tpu.core.crypto.prng import uniform_ints
+from xaynet_tpu.core.mask import (
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    MaskObject,
+    MaskSeed,
+    ModelType,
+)
+from xaynet_tpu.core.mask.serialization import (
+    parse_mask_object,
+    serialize_mask_object,
+    serialized_object_length,
+)
+from xaynet_tpu.core.message import (
+    HEADER_LENGTH,
+    Chunk,
+    DecodeError,
+    Message,
+    Sum,
+    Sum2,
+    Tag,
+    Update,
+    parse_local_seed_dict,
+    serialize_local_seed_dict,
+)
+from xaynet_tpu.core.message.encoder import MessageBuilder, MessageEncoder
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+
+
+def _mask_object(n=5, seed=7):
+    ints = uniform_ints(bytes([seed]) * 32, n + 1, CFG.order)
+    return MaskObject.new(CFG.pair(), ints[1:], ints[0])
+
+
+def _keys():
+    return SigningKeyPair.derive_from_seed(b"\x03" * 32)
+
+
+def test_mask_object_roundtrip():
+    obj = _mask_object()
+    wire = serialize_mask_object(obj)
+    assert len(wire) == serialized_object_length(obj.config, len(obj))
+    # config(4) + count(4) + 5 elements * 6 bytes + config(4) + 6 bytes
+    assert len(wire) == 4 + 4 + 5 * 6 + 4 + 6
+    back, consumed = parse_mask_object(wire)
+    assert consumed == len(wire)
+    assert back == obj
+
+
+def test_mask_object_rejects_invalid_elements():
+    obj = _mask_object()
+    wire = bytearray(serialize_mask_object(obj))
+    # corrupt first element to be >= order (set all element bytes to 0xff)
+    for i in range(8, 14):
+        wire[i] = 0xFF
+    with pytest.raises(DecodeError):
+        parse_mask_object(bytes(wire))
+
+
+def test_seed_dict_roundtrip():
+    ephm = EncryptKeyPair.generate()
+    seed = MaskSeed.generate()
+    d = {bytes([i]) * 32: seed.encrypt(ephm.public) for i in range(3)}
+    wire = serialize_local_seed_dict(d)
+    assert len(wire) == 4 + 3 * 112
+    back, consumed = parse_local_seed_dict(wire)
+    assert consumed == len(wire)
+    assert back.keys() == d.keys()
+    assert all(back[k] == d[k] for k in d)
+
+
+@pytest.mark.parametrize("kind", ["sum", "update", "sum2"])
+def test_message_roundtrip(kind):
+    keys = _keys()
+    coord_pk = b"\x09" * 32
+    if kind == "sum":
+        payload = Sum(sum_signature=b"\x01" * 64, ephm_pk=b"\x02" * 32)
+        tag = Tag.SUM
+    elif kind == "update":
+        ephm = EncryptKeyPair.generate()
+        payload = Update(
+            sum_signature=b"\x01" * 64,
+            update_signature=b"\x05" * 64,
+            masked_model=_mask_object(),
+            local_seed_dict={bytes([9]) * 32: MaskSeed.generate().encrypt(ephm.public)},
+        )
+        tag = Tag.UPDATE
+    else:
+        payload = Sum2(sum_signature=b"\x01" * 64, model_mask=_mask_object())
+        tag = Tag.SUM2
+
+    msg = Message(participant_pk=keys.public, coordinator_pk=coord_pk, payload=payload)
+    assert msg.tag == tag
+    wire = msg.to_bytes(keys.secret)
+    assert len(wire) == msg.serialized_length()
+
+    back = Message.from_bytes(wire)
+    assert back.tag == tag
+    assert back.participant_pk == keys.public
+    assert back.coordinator_pk == coord_pk
+    assert back.payload.to_bytes() == payload.to_bytes()
+
+
+def test_message_rejects_bad_signature():
+    keys = _keys()
+    msg = Message(
+        participant_pk=keys.public,
+        coordinator_pk=b"\x09" * 32,
+        payload=Sum(sum_signature=b"\x01" * 64, ephm_pk=b"\x02" * 32),
+    )
+    wire = bytearray(msg.to_bytes(keys.secret))
+    wire[HEADER_LENGTH] ^= 0xFF  # flip payload byte
+    with pytest.raises(DecodeError):
+        Message.from_bytes(bytes(wire))
+
+
+def test_chunk_roundtrip():
+    c = Chunk(id=3, message_id=700, last=True, data=b"hello world", tag=Tag.UPDATE)
+    wire = c.to_bytes()
+    back = Chunk.from_bytes(wire, tag=Tag.UPDATE)
+    assert (back.id, back.message_id, back.last, back.data) == (3, 700, True, b"hello world")
+
+
+def test_multipart_encode_reassemble():
+    """Large update -> chunked signed messages -> reassembly -> same payload."""
+    keys = _keys()
+    ephm = EncryptKeyPair.generate()
+    payload = Update(
+        sum_signature=b"\x01" * 64,
+        update_signature=b"\x05" * 64,
+        masked_model=_mask_object(n=500),
+        local_seed_dict={bytes([i]) * 32: MaskSeed.generate().encrypt(ephm.public) for i in range(10)},
+    )
+    msg = Message(participant_pk=keys.public, coordinator_pk=b"\x09" * 32, payload=payload)
+    parts = list(MessageEncoder(msg, keys.secret, max_message_size=512))
+    assert len(parts) > 3
+    assert all(len(p) <= 512 for p in parts)
+
+    builder = MessageBuilder()
+    done = False
+    # deliver out of order
+    order = list(range(len(parts)))
+    order.reverse()
+    for i in order:
+        m = Message.from_bytes(parts[i])
+        assert m.is_multipart and m.tag == Tag.UPDATE
+        done = builder.add(m.payload)
+    assert done
+    reassembled = Update.from_bytes(builder.payload_bytes())
+    assert reassembled.to_bytes() == payload.to_bytes()
+
+
+def test_small_message_not_chunked():
+    keys = _keys()
+    msg = Message(
+        participant_pk=keys.public,
+        coordinator_pk=b"\x09" * 32,
+        payload=Sum(sum_signature=b"\x01" * 64, ephm_pk=b"\x02" * 32),
+    )
+    parts = list(MessageEncoder(msg, keys.secret, max_message_size=4096))
+    assert len(parts) == 1
+    assert not Message.from_bytes(parts[0]).is_multipart

@@ -1,0 +1,106 @@
+"""Wire serialization of mask objects.
+
+Layouts (reference: rust/xaynet-core/src/mask/object/serialization/):
+
+- ``MaskVect``: config(4) ‖ count(u32 BE) ‖ count fixed-width little-endian
+  integers of ``bytes_per_number`` each (vect.rs:24-80);
+- ``MaskUnit``: config(4) ‖ one fixed-width little-endian integer (unit.rs);
+- ``MaskObject``: vect ‖ unit (mod.rs).
+
+The element block converts directly between wire bytes and the uint32 limb
+tensors (a vectorized numpy pad/view — no per-element loop), which is what
+makes parsing a 25M-element update a memcpy-class operation.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ...ops import limbs as limb_ops
+from .config import MASK_CONFIG_LENGTH, MaskConfig
+from .object import MaskObject, MaskUnit, MaskVect
+
+
+class DecodeError(ValueError):
+    """Malformed wire bytes."""
+
+
+def serialized_vect_length(config: MaskConfig, count: int) -> int:
+    return MASK_CONFIG_LENGTH + 4 + count * config.bytes_per_number
+
+
+def serialize_mask_vect(vect: MaskVect) -> bytes:
+    bpn = vect.config.bytes_per_number
+    return (
+        vect.config.to_bytes()
+        + struct.pack(">I", len(vect))
+        + limb_ops.limbs_to_bytes_le(vect.data, bpn)
+    )
+
+
+def parse_mask_vect(data: bytes, offset: int = 0) -> tuple[MaskVect, int]:
+    """Parse a MaskVect at ``offset``; returns (vect, bytes consumed)."""
+    if len(data) - offset < MASK_CONFIG_LENGTH + 4:
+        raise DecodeError("mask vector buffer too short")
+    try:
+        config = MaskConfig.from_bytes(data[offset : offset + MASK_CONFIG_LENGTH])
+    except ValueError as e:
+        raise DecodeError(f"invalid mask config: {e}") from e
+    (count,) = struct.unpack_from(">I", data, offset + MASK_CONFIG_LENGTH)
+    bpn = config.bytes_per_number
+    start = offset + MASK_CONFIG_LENGTH + 4
+    end = start + count * bpn
+    if len(data) < end:
+        raise DecodeError("mask vector data truncated")
+    limbs = limb_ops.bytes_le_to_limbs(
+        np.frombuffer(data, dtype=np.uint8, count=count * bpn, offset=start), count, bpn
+    )
+    vect = MaskVect(config, limbs)
+    if not vect.is_valid():
+        raise DecodeError("mask vector element >= group order")
+    return vect, end - offset
+
+
+def serialize_mask_unit(unit: MaskUnit) -> bytes:
+    bpn = unit.config.bytes_per_number
+    return unit.config.to_bytes() + limb_ops.limbs_to_bytes_le(unit.data[None, :], bpn)
+
+
+def parse_mask_unit(data: bytes, offset: int = 0) -> tuple[MaskUnit, int]:
+    if len(data) - offset < MASK_CONFIG_LENGTH:
+        raise DecodeError("mask unit buffer too short")
+    try:
+        config = MaskConfig.from_bytes(data[offset : offset + MASK_CONFIG_LENGTH])
+    except ValueError as e:
+        raise DecodeError(f"invalid mask config: {e}") from e
+    bpn = config.bytes_per_number
+    start = offset + MASK_CONFIG_LENGTH
+    if len(data) < start + bpn:
+        raise DecodeError("mask unit data truncated")
+    limbs = limb_ops.bytes_le_to_limbs(
+        np.frombuffer(data, dtype=np.uint8, count=bpn, offset=start), 1, bpn
+    )
+    unit = MaskUnit(config, limbs[0])
+    if not unit.is_valid():
+        raise DecodeError("mask unit element >= group order")
+    return unit, MASK_CONFIG_LENGTH + bpn
+
+
+def serialize_mask_object(obj: MaskObject) -> bytes:
+    return serialize_mask_vect(obj.vect) + serialize_mask_unit(obj.unit)
+
+
+def parse_mask_object(data: bytes, offset: int = 0) -> tuple[MaskObject, int]:
+    vect, n1 = parse_mask_vect(data, offset)
+    unit, n2 = parse_mask_unit(data, offset + n1)
+    return MaskObject(vect, unit), n1 + n2
+
+
+def serialized_object_length(config, count: int) -> int:
+    return (
+        serialized_vect_length(config.vect, count)
+        + MASK_CONFIG_LENGTH
+        + config.unit.bytes_per_number
+    )

@@ -1,0 +1,101 @@
+"""Message encoding with multipart chunking.
+
+Reference behavior (rust/xaynet-sdk/src/message_encoder/encoder.rs:14-180):
+a payload larger than ``max_payload_size`` is split into signed ``Chunk``
+messages (8-byte chunk header, shared random ``message_id``, ascending
+chunk ids, LAST_CHUNK flag on the final part); each part is an
+independently signed PET message carrying the original tag with the
+MULTIPART flag set. The receiver reassembles by (participant_pk,
+message_id) and re-parses the concatenated payload.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from .message import HEADER_LENGTH, Message, Tag
+from .payloads import CHUNK_HEADER_LENGTH, Chunk, Payload
+
+# minimum sensible ceiling: header + chunk header + 1 byte of progress
+MIN_PAYLOAD_SIZE = CHUNK_HEADER_LENGTH + 1
+DEFAULT_MAX_MESSAGE_SIZE = 4096
+
+
+def max_payload_size(max_message_size: int) -> int:
+    return max_message_size - HEADER_LENGTH
+
+
+class MessageEncoder:
+    """Encodes (and signs) a message, chunking it when oversized."""
+
+    def __init__(
+        self,
+        message: Message,
+        secret_signing_key: bytes,
+        max_message_size: int | None = DEFAULT_MAX_MESSAGE_SIZE,
+    ):
+        self.message = message
+        self.secret_signing_key = secret_signing_key
+        self.max_message_size = max_message_size
+
+    def __iter__(self) -> Iterator[bytes]:
+        payload_bytes = self.message.payload.to_bytes()
+        if (
+            self.max_message_size is None
+            or HEADER_LENGTH + len(payload_bytes) <= self.max_message_size
+        ):
+            yield self.message.to_bytes(self.secret_signing_key)
+            return
+
+        budget = max(self.max_message_size - HEADER_LENGTH - CHUNK_HEADER_LENGTH, 1)
+        message_id = struct.unpack(">H", os.urandom(2))[0]
+        n_chunks = -(-len(payload_bytes) // budget)
+        for i in range(n_chunks):
+            chunk = Chunk(
+                id=i + 1,
+                message_id=message_id,
+                last=(i == n_chunks - 1),
+                data=payload_bytes[i * budget : (i + 1) * budget],
+                tag=self.message.tag,
+            )
+            part = Message(
+                participant_pk=self.message.participant_pk,
+                coordinator_pk=self.message.coordinator_pk,
+                payload=chunk,
+                tag=self.message.tag,
+                is_multipart=True,
+            )
+            yield part.to_bytes(self.secret_signing_key)
+
+
+class MessageBuilder:
+    """Server-side reassembly of one multipart message's chunks.
+
+    Chunks may arrive out of order; they are keyed by chunk id and the
+    message completes when the LAST_CHUNK id is known and all lower ids are
+    present (reference: xaynet-server multipart/buffer.rs:8-60).
+    """
+
+    def __init__(self):
+        self._chunks: dict[int, bytes] = {}
+        self._last_id: int | None = None
+
+    def add(self, chunk: Chunk) -> bool:
+        """Adds a chunk; returns True when the message is complete."""
+        self._chunks[chunk.id] = chunk.data
+        if chunk.last:
+            self._last_id = chunk.id
+        return self.is_complete()
+
+    def is_complete(self) -> bool:
+        if self._last_id is None:
+            return False
+        return all(i in self._chunks for i in range(1, self._last_id + 1))
+
+    def payload_bytes(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("message is not complete")
+        assert self._last_id is not None
+        return b"".join(self._chunks[i] for i in range(1, self._last_id + 1))

@@ -1,0 +1,214 @@
+"""PET message payloads: Sum, Update, Sum2, Chunk.
+
+Layouts (reference: rust/xaynet-core/src/message/payload/):
+
+- Sum (sum.rs): sum_signature(64) ‖ ephm_pk(32)
+- Update (update.rs): sum_signature(64) ‖ update_signature(64) ‖
+  masked model (MaskObject) ‖ local seed dict (LV-encoded, 112 B/entry)
+- Sum2 (sum2.rs): sum_signature(64) ‖ aggregated mask (MaskObject)
+- Chunk (chunk.rs): id(u16 BE) ‖ message_id(u16 BE) ‖ flags(1, bit0 =
+  LAST_CHUNK) ‖ reserved(3) ‖ data
+
+Length-Value items use a 4-byte big-endian length that *includes* the
+length field itself (reference: rust/xaynet-core/src/message/traits.rs:126-160).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Union
+
+from ..mask.object import MaskObject
+from ..mask.seed import ENCRYPTED_MASK_SEED_LENGTH, EncryptedMaskSeed
+from ..mask.serialization import (
+    DecodeError,
+    parse_mask_object,
+    serialize_mask_object,
+)
+
+SIGNATURE_LENGTH = 64
+PK_LENGTH = 32
+SEED_DICT_ENTRY_LENGTH = PK_LENGTH + ENCRYPTED_MASK_SEED_LENGTH  # 112
+CHUNK_HEADER_LENGTH = 8
+
+LocalSeedDict = dict  # bytes (sum pk, 32) -> EncryptedMaskSeed
+
+
+# --- Length-Value helpers ---------------------------------------------------
+
+
+def lv_encode(value: bytes) -> bytes:
+    return struct.pack(">I", len(value) + 4) + value
+
+
+def lv_decode(data: bytes, offset: int = 0) -> tuple[bytes, int]:
+    """Returns (value, total bytes consumed incl. the length field)."""
+    if len(data) - offset < 4:
+        raise DecodeError("LV item truncated (no length field)")
+    (length,) = struct.unpack_from(">I", data, offset)
+    if length < 4:
+        raise DecodeError("LV length below minimum")
+    if len(data) - offset < length:
+        raise DecodeError("LV value truncated")
+    return data[offset + 4 : offset + length], length
+
+
+def serialize_local_seed_dict(seed_dict: dict) -> bytes:
+    body = bytearray()
+    for pk, seed in seed_dict.items():
+        if len(pk) != PK_LENGTH:
+            raise ValueError("seed dict key must be a 32-byte public key")
+        seed_bytes = seed.as_bytes() if isinstance(seed, EncryptedMaskSeed) else bytes(seed)
+        if len(seed_bytes) != ENCRYPTED_MASK_SEED_LENGTH:
+            raise ValueError("seed dict value must be an 80-byte encrypted seed")
+        body += pk + seed_bytes
+    return lv_encode(bytes(body))
+
+
+def parse_local_seed_dict(data: bytes, offset: int = 0) -> tuple[dict, int]:
+    value, consumed = lv_decode(data, offset)
+    if len(value) % SEED_DICT_ENTRY_LENGTH != 0:
+        raise DecodeError("seed dict length not a multiple of the entry size")
+    out: dict = {}
+    for i in range(0, len(value), SEED_DICT_ENTRY_LENGTH):
+        pk = value[i : i + PK_LENGTH]
+        seed = EncryptedMaskSeed(value[i + PK_LENGTH : i + SEED_DICT_ENTRY_LENGTH])
+        if pk in out:
+            raise DecodeError("duplicate sum pk in seed dict")
+        out[pk] = seed
+    return out, consumed
+
+
+# --- payloads ---------------------------------------------------------------
+
+
+@dataclass
+class Sum:
+    sum_signature: bytes
+    ephm_pk: bytes
+
+    def serialized_length(self) -> int:
+        return SIGNATURE_LENGTH + PK_LENGTH
+
+    def to_bytes(self) -> bytes:
+        return self.sum_signature + self.ephm_pk
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Sum":
+        if len(data) < SIGNATURE_LENGTH + PK_LENGTH:
+            raise DecodeError("sum payload too short")
+        return cls(
+            sum_signature=data[:SIGNATURE_LENGTH],
+            ephm_pk=data[SIGNATURE_LENGTH : SIGNATURE_LENGTH + PK_LENGTH],
+        )
+
+
+@dataclass
+class Update:
+    sum_signature: bytes
+    update_signature: bytes
+    masked_model: MaskObject
+    local_seed_dict: dict
+
+    def serialized_length(self) -> int:
+        from ..mask.serialization import serialized_object_length
+
+        return (
+            2 * SIGNATURE_LENGTH
+            + serialized_object_length(self.masked_model.config, len(self.masked_model))
+            + 4
+            + SEED_DICT_ENTRY_LENGTH * len(self.local_seed_dict)
+        )
+
+    def to_bytes(self) -> bytes:
+        return (
+            self.sum_signature
+            + self.update_signature
+            + serialize_mask_object(self.masked_model)
+            + serialize_local_seed_dict(self.local_seed_dict)
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Update":
+        if len(data) < 2 * SIGNATURE_LENGTH:
+            raise DecodeError("update payload too short")
+        masked, consumed = parse_mask_object(data, 2 * SIGNATURE_LENGTH)
+        seed_dict, _ = parse_local_seed_dict(data, 2 * SIGNATURE_LENGTH + consumed)
+        return cls(
+            sum_signature=data[:SIGNATURE_LENGTH],
+            update_signature=data[SIGNATURE_LENGTH : 2 * SIGNATURE_LENGTH],
+            masked_model=masked,
+            local_seed_dict=seed_dict,
+        )
+
+
+@dataclass
+class Sum2:
+    sum_signature: bytes
+    model_mask: MaskObject
+
+    def serialized_length(self) -> int:
+        from ..mask.serialization import serialized_object_length
+
+        return SIGNATURE_LENGTH + serialized_object_length(
+            self.model_mask.config, len(self.model_mask)
+        )
+
+    def to_bytes(self) -> bytes:
+        return self.sum_signature + serialize_mask_object(self.model_mask)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Sum2":
+        if len(data) < SIGNATURE_LENGTH:
+            raise DecodeError("sum2 payload too short")
+        mask, _ = parse_mask_object(data, SIGNATURE_LENGTH)
+        return cls(sum_signature=data[:SIGNATURE_LENGTH], model_mask=mask)
+
+
+@dataclass
+class Chunk:
+    """One part of a multipart message.
+
+    ``tag`` carries the enclosing message's tag (the type of the message
+    being reassembled).
+    """
+
+    id: int
+    message_id: int
+    last: bool
+    data: bytes
+    tag: "object" = None  # Tag; typed loosely to avoid a circular import
+
+    def serialized_length(self) -> int:
+        return CHUNK_HEADER_LENGTH + len(self.data)
+
+    def to_bytes(self) -> bytes:
+        return (
+            struct.pack(">HHB3x", self.id & 0xFFFF, self.message_id & 0xFFFF, 1 if self.last else 0)
+            + self.data
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes, tag=None) -> "Chunk":
+        if len(data) < CHUNK_HEADER_LENGTH:
+            raise DecodeError("chunk payload too short")
+        cid, mid, flags = struct.unpack_from(">HHB", data)
+        return cls(id=cid, message_id=mid, last=bool(flags & 1), data=data[CHUNK_HEADER_LENGTH:], tag=tag)
+
+
+Payload = Union[Sum, Update, Sum2, Chunk]
+
+
+def parse_payload(tag, is_multipart: bool, data: bytes) -> Payload:
+    if is_multipart:
+        return Chunk.from_bytes(data, tag=tag)
+    from .message import Tag  # local import to avoid cycle
+
+    if tag == Tag.SUM:
+        return Sum.from_bytes(data)
+    if tag == Tag.UPDATE:
+        return Update.from_bytes(data)
+    if tag == Tag.SUM2:
+        return Sum2.from_bytes(data)
+    raise DecodeError(f"unknown tag {tag}")
