@@ -1,0 +1,113 @@
+"""Full PET round over the real REST API (sockets on localhost)."""
+
+import asyncio
+from fractions import Fraction
+
+import numpy as np
+
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+N_SUM, N_UPDATE, MODEL_LEN = 1, 3, 7
+SUM_PROB, UPDATE_PROB = 0.4, 0.5
+
+
+class ArrayModelStore(ModelStore):
+    def __init__(self, model):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+async def _run() -> tuple[np.ndarray, np.ndarray]:
+    settings = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(prob=SUM_PROB, count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 20)),
+            update=PhaseSettings(prob=UPDATE_PROB, count=CountSettings(N_UPDATE, N_UPDATE), time=TimeSettings(0, 20)),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 20)),
+        )
+    )
+    settings.model.length = MODEL_LEN
+    store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+    machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+    handler = PetMessageHandler(events, request_tx)
+    fetcher = Fetcher(events)
+    rest = RestServer(fetcher, handler)
+    host, port = await rest.start("127.0.0.1", 0)
+    machine_task = asyncio.create_task(machine.run())
+
+    try:
+        url = f"http://{host}:{port}"
+        probe = HttpClient(url)
+        while fetcher.phase().value != "sum":
+            await asyncio.sleep(0.01)
+        params = await probe.get_round_params()
+        seed = params.seed.as_bytes()
+
+        rng = np.random.default_rng(5)
+        expected = np.zeros(MODEL_LEN)
+        participants = []
+        for i in range(N_SUM):
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+            participants.append(
+                ParticipantSM(PetSettings(keys=keys), HttpClient(url), ArrayModelStore(None))
+            )
+        for i in range(N_UPDATE):
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(20 + i) * 1000)
+            local = rng.uniform(-1, 1, MODEL_LEN).astype(np.float32)
+            expected += local.astype(np.float64) / N_UPDATE
+            participants.append(
+                ParticipantSM(
+                    PetSettings(keys=keys, scalar=Fraction(1, N_UPDATE)),
+                    HttpClient(url),
+                    ArrayModelStore(local),
+                )
+            )
+
+        async def drive(sm):
+            for _ in range(500):
+                try:
+                    await sm.transition()
+                except Exception:
+                    pass
+                model = await probe.get_model()
+                if model is not None and sm.phase.value == "awaiting":
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.gather(*(drive(p) for p in participants))
+        model = await probe.get_model()
+        assert model is not None
+        return np.asarray(model), expected
+    finally:
+        machine_task.cancel()
+        await rest.stop()
+        try:
+            await machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+def test_rest_round():
+    got, expected = asyncio.run(asyncio.wait_for(_run(), timeout=60))
+    np.testing.assert_allclose(got, expected, atol=1e-9)

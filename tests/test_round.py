@@ -1,0 +1,150 @@
+"""End-to-end PET round: coordinator + N in-process participants.
+
+The reference proves the whole protocol is testable in-process by injecting
+messages straight into the request channel (SURVEY §4.3). Here we go one
+layer further out: participants run the real SDK state machine, messages go
+through the full service pipeline (sealed box, signature, task validation),
+and the coordinator runs the real phase state machine — only the network is
+replaced by direct calls.
+"""
+
+import asyncio
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.sdk.client import InProcessClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+N_SUM = 2
+N_UPDATE = 3
+MODEL_LEN = 13
+SUM_PROB = 0.4
+UPDATE_PROB = 0.5
+
+
+class ArrayModelStore(ModelStore):
+    def __init__(self, model):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+def _settings() -> Settings:
+    s = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(
+                prob=SUM_PROB,
+                count=CountSettings(min=N_SUM, max=N_SUM),
+                time=TimeSettings(min=0.0, max=20.0),
+            ),
+            update=PhaseSettings(
+                prob=UPDATE_PROB,
+                count=CountSettings(min=N_UPDATE, max=N_UPDATE),
+                time=TimeSettings(min=0.0, max=20.0),
+            ),
+            sum2=Sum2Settings(
+                count=CountSettings(min=N_SUM, max=N_SUM),
+                time=TimeSettings(min=0.0, max=20.0),
+            ),
+        )
+    )
+    s.model.length = MODEL_LEN
+    return s
+
+
+async def _run_round(settings: Settings, n_rounds: int = 1):
+    store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+    init = StateMachineInitializer(settings, store)
+    machine, request_tx, events = await init.init()
+    handler = PetMessageHandler(events, request_tx)
+    fetcher = Fetcher(events)
+
+    machine_task = asyncio.create_task(machine.run())
+
+    models = {}
+    try:
+        for round_no in range(n_rounds):
+            # wait for the sum phase of the current round so the published
+            # round seed is final
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.01)
+            params = fetcher.round_params()
+            seed = params.seed.as_bytes()
+
+            rng = np.random.default_rng(42 + round_no)
+            participants = []
+            expected = np.zeros(MODEL_LEN)
+            for i in range(N_SUM):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+                sm = ParticipantSM(
+                    PetSettings(keys=keys),
+                    InProcessClient(fetcher, handler),
+                    ArrayModelStore(None),
+                )
+                participants.append(sm)
+            for i in range(N_UPDATE):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(10 + i) * 1000)
+                local = rng.uniform(-1, 1, MODEL_LEN).astype(np.float32)
+                expected += local.astype(np.float64) / N_UPDATE
+                sm = ParticipantSM(
+                    PetSettings(keys=keys, scalar=Fraction(1, N_UPDATE)),
+                    InProcessClient(fetcher, handler),
+                    ArrayModelStore(local),
+                )
+                participants.append(sm)
+
+            async def drive(sm):
+                for _ in range(500):
+                    try:
+                        await sm.transition()
+                    except Exception:
+                        pass
+                    if fetcher.model() is not None and sm.phase.value == "awaiting":
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(*(drive(p) for p in participants))
+
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            models[round_no] = (np.asarray(fetcher.model()), expected)
+
+            # let the machine move into the next round's sum phase
+            if round_no + 1 < n_rounds:
+                while fetcher.round_params().seed.as_bytes() == seed:
+                    await asyncio.sleep(0.01)
+    finally:
+        machine_task.cancel()
+        try:
+            await machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+    return models
+
+
+def test_full_pet_round():
+    models = asyncio.run(asyncio.wait_for(_run_round(_settings()), timeout=60))
+    got, expected = models[0]
+    assert got.shape == (MODEL_LEN,)
+    np.testing.assert_allclose(got, expected, atol=1e-9)

@@ -1,0 +1,128 @@
+"""Coordinator clients: in-process (simulation/tests) and HTTP.
+
+Reference surface: rust/xaynet-sdk/src/client.rs:59-213 (five endpoints:
+params / sums / seeds / model / message). The in-process client talks
+directly to a coordinator's fetcher and message handler — the reference
+proves the whole protocol is testable without a network
+(SURVEY §4: in-process multi-node).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+import numpy as np
+
+from ..core.common import RoundParameters, UpdateSeedDict
+from .traits import XaynetClient
+
+
+class InProcessClient(XaynetClient):
+    """Direct wiring to an in-process coordinator (no sockets)."""
+
+    def __init__(self, fetcher, message_handler):
+        self.fetcher = fetcher
+        self.handler = message_handler
+
+    async def get_round_params(self) -> RoundParameters:
+        return self.fetcher.round_params()
+
+    async def get_sums(self) -> Optional[dict]:
+        return self.fetcher.sum_dict()
+
+    async def get_seeds(self, pk: bytes) -> Optional[UpdateSeedDict]:
+        return self.fetcher.seeds_for(pk)
+
+    async def get_model(self) -> Optional[np.ndarray]:
+        return self.fetcher.model()
+
+    async def send_message(self, encrypted: bytes) -> None:
+        await self.handler.handle_message(encrypted)
+
+
+class HttpClient(XaynetClient):
+    """HTTP client for a remote coordinator (REST API, rest.py).
+
+    Uses asyncio streams directly — no third-party HTTP dependency.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        if base_url.startswith("http://"):
+            base_url = base_url[len("http://") :]
+        self.host, _, port = base_url.partition(":")
+        self.port = int(port or 80)
+        self.timeout = timeout
+
+    async def _request(
+        self, method: str, path: str, body: bytes | None = None
+    ) -> tuple[int, bytes]:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout
+        )
+        try:
+            head = (
+                f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Length: {len(body) if body else 0}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode()
+            writer.write(head + (body or b""))
+            await writer.drain()
+            status_line = await asyncio.wait_for(reader.readline(), self.timeout)
+            status = int(status_line.split()[1])
+            content_length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                if name.strip().lower() == "content-length":
+                    content_length = int(value.strip())
+            payload = await reader.readexactly(content_length) if content_length else b""
+            return status, payload
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def get_round_params(self) -> RoundParameters:
+        status, body = await self._request("GET", "/params")
+        if status != 200:
+            raise RuntimeError(f"GET /params -> {status}")
+        return RoundParameters.from_dict(json.loads(body.decode()))
+
+    async def get_sums(self) -> Optional[dict]:
+        status, body = await self._request("GET", "/sums")
+        if status == 204:
+            return None
+        if status != 200:
+            raise RuntimeError(f"GET /sums -> {status}")
+        raw = json.loads(body.decode())
+        return {bytes.fromhex(k): bytes.fromhex(v) for k, v in raw.items()}
+
+    async def get_seeds(self, pk: bytes) -> Optional[UpdateSeedDict]:
+        from ..core.mask.seed import EncryptedMaskSeed
+
+        status, body = await self._request("GET", f"/seeds?pk={pk.hex()}")
+        if status == 204:
+            return None
+        if status != 200:
+            raise RuntimeError(f"GET /seeds -> {status}")
+        raw = json.loads(body.decode())
+        return {bytes.fromhex(k): EncryptedMaskSeed(bytes.fromhex(v)) for k, v in raw.items()}
+
+    async def get_model(self) -> Optional[np.ndarray]:
+        status, body = await self._request("GET", "/model")
+        if status == 204:
+            return None
+        if status != 200:
+            raise RuntimeError(f"GET /model -> {status}")
+        return np.frombuffer(body, dtype=np.float64)
+
+    async def send_message(self, encrypted: bytes) -> None:
+        status, body = await self._request("POST", "/message", encrypted)
+        if status != 200:
+            raise RuntimeError(f"POST /message -> {status}: {body[:200]!r}")

@@ -1,0 +1,38 @@
+"""Simulation helpers: deterministic role assignment for in-process rounds.
+
+PET task selection is probabilistic over each participant's Ed25519 key and
+the round seed. For simulations and tests we need participants with *known*
+roles, so we rejection-sample signing keys until the eligibility check lands
+on the desired task — the protocol itself stays untouched.
+"""
+
+from __future__ import annotations
+
+from ..core.crypto.sign import SigningKeyPair, is_eligible
+
+
+def keys_for_task(
+    round_seed: bytes,
+    sum_prob: float,
+    update_prob: float,
+    want: str,
+    start: int = 0,
+    max_tries: int = 100_000,
+) -> SigningKeyPair:
+    """Finds a signing keypair whose task for this round is ``want``.
+
+    ``want`` is "sum", "update" or "none". Deterministic given ``start``.
+    """
+    for i in range(start, start + max_tries):
+        keys = SigningKeyPair.derive_from_seed(i.to_bytes(32, "little"))
+        sum_sig = keys.sign(round_seed + b"sum").as_bytes()
+        update_sig = keys.sign(round_seed + b"update").as_bytes()
+        if is_eligible(sum_sig, sum_prob):
+            role = "sum"
+        elif is_eligible(update_sig, update_prob):
+            role = "update"
+        else:
+            role = "none"
+        if role == want:
+            return keys
+    raise RuntimeError(f"no key found for task {want} in {max_tries} tries")

@@ -1,0 +1,278 @@
+"""Participant state machine: the client half of the PET protocol.
+
+Functional port of the reference's poll-driven FSM (reference:
+rust/xaynet-sdk/src/state_machine/): phases Awaiting -> NewRound ->
+(Sum -> Sum2 | Update) -> Awaiting. Every ``transition()`` first re-polls
+the round parameters; a parameter change resets the machine to NewRound
+(phase.rs:160-200), which is what makes participants tolerant of coordinator
+restarts and round cuts.
+
+The whole machine state is serializable (``save()`` / ``restore()``,
+reference: state_machine.rs:54-148) so an embedding application can suspend
+at any point.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import logging
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from ..core.common import RoundParameters
+from ..core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey, SecretEncryptKey
+from ..core.crypto.sign import SigningKeyPair, is_eligible
+from ..core.mask.masking import Aggregation, Masker
+from ..core.mask.model import Scalar
+from ..core.mask.object import MaskObject
+from ..core.mask.seed import MaskSeed
+from ..core.message import Message, Sum, Sum2, Update
+from ..core.message.encoder import DEFAULT_MAX_MESSAGE_SIZE, MessageEncoder
+from .traits import ModelStore, Notify, XaynetClient
+
+logger = logging.getLogger("xaynet.participant")
+
+
+class TransitionOutcome(enum.Enum):
+    PENDING = "pending"  # no progress possible right now; retry later
+    COMPLETE = "complete"  # made progress
+
+
+class Task(enum.Enum):
+    NONE = "none"
+    SUM = "sum"
+    UPDATE = "update"
+
+
+class PhaseKind(str, enum.Enum):
+    AWAITING = "awaiting"
+    NEW_ROUND = "new_round"
+    SUM = "sum"
+    UPDATE = "update"
+    SUM2 = "sum2"
+
+
+@dataclass
+class PetSettings:
+    """Participant settings (reference: xaynet-sdk/src/settings/mod.rs:8-23)."""
+
+    keys: SigningKeyPair
+    scalar: Fraction = Fraction(1)
+    max_message_size: Optional[int] = DEFAULT_MAX_MESSAGE_SIZE
+
+
+class StateMachine:
+    """Poll-driven participant FSM."""
+
+    def __init__(
+        self,
+        settings: PetSettings,
+        client: XaynetClient,
+        model_store: ModelStore,
+        notify: Optional[Notify] = None,
+    ):
+        self.keys = settings.keys
+        self.scalar = settings.scalar
+        self.max_message_size = settings.max_message_size
+        self.client = client
+        self.model_store = model_store
+        self.notify = notify or Notify()
+
+        self.phase = PhaseKind.AWAITING
+        self.round_params: Optional[RoundParameters] = None
+        self.task = Task.NONE
+        self.sum_signature: Optional[bytes] = None
+        self.update_signature: Optional[bytes] = None
+        self.ephm_keys: Optional[EncryptKeyPair] = None
+        self._message_sent = False
+
+    # --- driving ----------------------------------------------------------
+
+    async def transition(self) -> TransitionOutcome:
+        """One step; checks round freshness first (phase.rs:160-200)."""
+        try:
+            fresh = await self.client.get_round_params()
+        except Exception as e:
+            logger.debug("round params unavailable: %s", e)
+            return TransitionOutcome.PENDING
+        if self.round_params is None or fresh != self.round_params:
+            self.round_params = fresh
+            self._reset_round_state()
+            self.phase = PhaseKind.NEW_ROUND
+            self.notify.new_round()
+
+        handler = {
+            PhaseKind.AWAITING: self._step_awaiting,
+            PhaseKind.NEW_ROUND: self._step_new_round,
+            PhaseKind.SUM: self._step_sum,
+            PhaseKind.UPDATE: self._step_update,
+            PhaseKind.SUM2: self._step_sum2,
+        }[self.phase]
+        return await handler()
+
+    def _reset_round_state(self) -> None:
+        self.task = Task.NONE
+        self.sum_signature = None
+        self.update_signature = None
+        self.ephm_keys = None
+        self._message_sent = False
+
+    # --- phases -----------------------------------------------------------
+
+    async def _step_awaiting(self) -> TransitionOutcome:
+        self.notify.idle()
+        return TransitionOutcome.PENDING
+
+    async def _step_new_round(self) -> TransitionOutcome:
+        """Sign the round tasks and check eligibility (new_round.rs:29-79)."""
+        assert self.round_params is not None
+        seed = self.round_params.seed.as_bytes()
+        self.sum_signature = self.keys.sign(seed + b"sum").as_bytes()
+        self.update_signature = self.keys.sign(seed + b"update").as_bytes()
+
+        if is_eligible(self.sum_signature, self.round_params.sum):
+            self.task = Task.SUM
+            self.phase = PhaseKind.SUM
+            self.notify.sum()
+        elif is_eligible(self.update_signature, self.round_params.update):
+            self.task = Task.UPDATE
+            self.phase = PhaseKind.UPDATE
+            self.notify.update()
+        else:
+            self.task = Task.NONE
+            self.phase = PhaseKind.AWAITING
+            self.notify.idle()
+        return TransitionOutcome.COMPLETE
+
+    async def _step_sum(self) -> TransitionOutcome:
+        """Send the ephemeral key, then wait for Sum2 (sum.rs:17-81)."""
+        assert self.round_params is not None and self.sum_signature is not None
+        if self.ephm_keys is None:
+            self.ephm_keys = EncryptKeyPair.generate()
+        if not self._message_sent:
+            payload = Sum(
+                sum_signature=self.sum_signature,
+                ephm_pk=self.ephm_keys.public.as_bytes(),
+            )
+            await self._send(payload)
+            self._message_sent = True
+        self.phase = PhaseKind.SUM2
+        self._message_sent = False
+        return TransitionOutcome.COMPLETE
+
+    async def _step_update(self) -> TransitionOutcome:
+        """Train, mask, encrypt seeds, upload (update.rs:134-258)."""
+        assert self.round_params is not None
+        sum_dict = await self.client.get_sums()
+        if not sum_dict:
+            return TransitionOutcome.PENDING
+        model = await self.model_store.load_model()
+        if model is None:
+            self.notify.load_model()
+            return TransitionOutcome.PENDING
+        if len(model) != self.round_params.model_length:
+            raise ValueError(
+                f"local model length {len(model)} != round model length "
+                f"{self.round_params.model_length}"
+            )
+
+        masker = Masker(self.round_params.mask_config)
+        seed, masked_model = masker.mask(Scalar.from_fraction(self.scalar), model)
+        local_seed_dict = {
+            sum_pk: seed.encrypt(PublicEncryptKey(ephm_pk))
+            for sum_pk, ephm_pk in sum_dict.items()
+        }
+        payload = Update(
+            sum_signature=self.sum_signature,
+            update_signature=self.update_signature,
+            masked_model=masked_model,
+            local_seed_dict=local_seed_dict,
+        )
+        await self._send(payload)
+        self.phase = PhaseKind.AWAITING
+        return TransitionOutcome.COMPLETE
+
+    async def _step_sum2(self) -> TransitionOutcome:
+        """Fetch seeds, derive + aggregate masks, upload (sum2.rs:82-204)."""
+        assert self.round_params is not None and self.ephm_keys is not None
+        seeds = await self.client.get_seeds(self.keys.public)
+        if not seeds:
+            return TransitionOutcome.PENDING
+
+        length = self.round_params.model_length
+        config = self.round_params.mask_config
+        mask_agg = Aggregation(config, length)
+        for update_pk, encrypted in seeds.items():
+            mask_seed = encrypted.decrypt(self.ephm_keys.secret, self.ephm_keys.public)
+            mask = mask_seed.derive_mask(length, config)
+            mask_agg.validate_aggregation(mask)
+            mask_agg.aggregate(mask)
+
+        payload = Sum2(sum_signature=self.sum_signature, model_mask=mask_agg.object)
+        await self._send(payload)
+        self.phase = PhaseKind.AWAITING
+        return TransitionOutcome.COMPLETE
+
+    # --- sending ----------------------------------------------------------
+
+    async def _send(self, payload) -> None:
+        """Sign, chunk if oversized, sealed-box encrypt, POST
+        (sending.rs:23-121)."""
+        assert self.round_params is not None
+        message = Message(
+            participant_pk=self.keys.public,
+            coordinator_pk=self.round_params.pk,
+            payload=payload,
+        )
+        coordinator_pk = PublicEncryptKey(self.round_params.pk)
+        for part in MessageEncoder(message, self.keys.secret, self.max_message_size):
+            encrypted = coordinator_pk.encrypt(part)
+            await self.client.send_message(encrypted)
+
+    # --- persistence ------------------------------------------------------
+
+    def save(self) -> bytes:
+        """Serialize the whole machine state (phase.rs:295-313)."""
+        d = {
+            "keys": self.keys.secret.hex(),
+            "scalar": [self.scalar.numerator, self.scalar.denominator],
+            "max_message_size": self.max_message_size,
+            "phase": self.phase.value,
+            "task": self.task.value,
+            "sum_signature": self.sum_signature.hex() if self.sum_signature else None,
+            "update_signature": self.update_signature.hex() if self.update_signature else None,
+            "ephm_secret": self.ephm_keys.secret.as_bytes().hex() if self.ephm_keys else None,
+            "round_params": self.round_params.to_dict() if self.round_params else None,
+        }
+        return json.dumps(d).encode()
+
+    @classmethod
+    def restore(
+        cls,
+        data: bytes,
+        client: XaynetClient,
+        model_store: ModelStore,
+        notify: Optional[Notify] = None,
+    ) -> "StateMachine":
+        d = json.loads(data.decode())
+        settings = PetSettings(
+            keys=SigningKeyPair.derive_from_seed(bytes.fromhex(d["keys"])),
+            scalar=Fraction(*d["scalar"]),
+            max_message_size=d["max_message_size"],
+        )
+        machine = cls(settings, client, model_store, notify)
+        machine.phase = PhaseKind(d["phase"])
+        machine.task = Task(d["task"])
+        machine.sum_signature = bytes.fromhex(d["sum_signature"]) if d["sum_signature"] else None
+        machine.update_signature = (
+            bytes.fromhex(d["update_signature"]) if d["update_signature"] else None
+        )
+        if d["ephm_secret"]:
+            machine.ephm_keys = EncryptKeyPair.derive_from_seed(bytes.fromhex(d["ephm_secret"]))
+        if d["round_params"]:
+            machine.round_params = RoundParameters.from_dict(d["round_params"])
+        return machine

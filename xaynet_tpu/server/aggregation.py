@@ -1,0 +1,113 @@
+"""Update-phase aggregation strategies: host numpy or TPU mesh.
+
+The reference aggregates each accepted update inline with a sequential
+big-int loop (reference:
+rust/xaynet-server/src/state_machine/phases/update.rs:119-152). Here updates
+are staged and folded in batches:
+
+- **host**: vectorized numpy limb kernels (``core.mask.Aggregation``);
+- **device**: the sharded single-pass fold on the TPU mesh
+  (``parallel.ShardedAggregator``) for the vector part, host for the tiny
+  unit part.
+
+Validation still happens per-update at accept time (the client-visible
+protocol behavior is unchanged); only the arithmetic is deferred into
+batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.mask.config import MaskConfigPair
+from ..core.mask.masking import Aggregation, AggregationError
+from ..core.mask.object import MaskObject, MaskUnit, MaskVect
+
+
+class StagedAggregator:
+    """Stages validated masked updates and folds them in batches."""
+
+    def __init__(
+        self,
+        config: MaskConfigPair,
+        object_size: int,
+        device: bool = False,
+        batch_size: int = 64,
+    ):
+        self.config = config
+        self.object_size = object_size
+        self.batch_size = max(1, batch_size)
+        self._staged_vect: list[np.ndarray] = []
+        self._staged_unit: list[np.ndarray] = []
+        self._count = 0
+        self._host = Aggregation(config, object_size)
+        self._device = None
+        if device:
+            from ..ops import limbs as limb_ops
+            from ..parallel.aggregator import ShardedAggregator
+
+            self._device = ShardedAggregator(config.vect, object_size)
+            # tiny unit part stays on host
+            self._unit_acc = np.zeros(
+                limb_ops.n_limbs_for_order(config.unit.order), dtype=np.uint32
+            )
+
+    @property
+    def nb_models(self) -> int:
+        return self._count + (self._device.nb_models if self._device else self._host.nb_models)
+
+    def validate_aggregation(self, obj: MaskObject) -> None:
+        """Per-update protocol validation (same checks as the reference,
+        masking.rs:253-279) without materializing a probe accumulator."""
+        if self.config.vect != obj.vect.config:
+            raise AggregationError("ModelMismatch")
+        if self.config.unit != obj.unit.config:
+            raise AggregationError("ScalarMismatch")
+        if self.object_size != len(obj.vect):
+            raise AggregationError("ModelMismatch")
+        if self.nb_models >= self.config.vect.max_nb_models:
+            raise AggregationError("TooManyModels")
+        if self.nb_models >= self.config.unit.max_nb_models:
+            raise AggregationError("TooManyScalars")
+        if not obj.is_valid():
+            raise AggregationError("InvalidObject")
+
+    def aggregate(self, obj: MaskObject) -> None:
+        self._staged_vect.append(obj.vect.data)
+        self._staged_unit.append(obj.unit.data)
+        self._count += 1
+        if self._count >= self.batch_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._count == 0:
+            return
+        stack = np.stack(self._staged_vect)
+        units = np.stack(self._staged_unit)
+        if self._device is not None:
+            from ..ops import limbs as limb_ops
+
+            self._device.add_batch(stack)
+            order_limbs = limb_ops.order_limbs_for(self.config.unit.order)
+            batch_unit = limb_ops.batch_mod_sum(units[:, None, :], order_limbs)[0]
+            self._unit_acc = limb_ops.mod_add(
+                self._unit_acc[None, :], batch_unit[None, :], order_limbs
+            )[0]
+        else:
+            self._host.aggregate_batch(stack, units)
+        self._staged_vect.clear()
+        self._staged_unit.clear()
+        self._count = 0
+
+    def finalize(self) -> Aggregation:
+        """Materialize the protocol-level ``Aggregation`` (for Unmask)."""
+        self.flush()
+        if self._device is None:
+            return self._host
+        agg = Aggregation(self.config, self.object_size)
+        agg.object = MaskObject(
+            MaskVect(self.config.vect, self._device.snapshot()),
+            MaskUnit(self.config.unit, self._unit_acc),
+        )
+        agg.nb_models = self._device.nb_models
+        return agg

@@ -1,0 +1,104 @@
+"""Metrics recorders.
+
+Functional port of the reference's metrics subsystem (reference:
+rust/xaynet-server/src/metrics/): eight measurements tagged with
+(round_id, phase) — phase transitions, round counts, per-phase
+accepted/rejected/discarded message counters, unique-mask totals — plus
+free-form events for phase errors. Sinks: structured log lines or a JSONL
+file (the line-protocol analogue; external collectors tail it).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger("xaynet.metrics")
+
+
+class Metrics:
+    """Recorder interface (all methods are fire-and-forget)."""
+
+    def phase(self, round_id: int, phase: str) -> None: ...
+
+    def round_total(self, round_id: int) -> None: ...
+
+    def message_accepted(self, round_id: int, phase: str) -> None: ...
+
+    def message_rejected(self, round_id: int, phase: str) -> None: ...
+
+    def message_discarded(self, round_id: int, phase: str) -> None: ...
+
+    def masks_total(self, round_id: int, count: int) -> None: ...
+
+    def event(self, round_id: int, kind: str, detail: str = "") -> None: ...
+
+
+class LogMetrics(Metrics):
+    def _emit(self, measurement: str, value, round_id: int, phase: str = "") -> None:
+        logger.info("metric %s=%s round_id=%d phase=%s", measurement, value, round_id, phase)
+
+    def phase(self, round_id: int, phase: str) -> None:
+        self._emit("phase", phase, round_id, phase)
+
+    def round_total(self, round_id: int) -> None:
+        self._emit("round_total_number", round_id, round_id)
+
+    def message_accepted(self, round_id: int, phase: str) -> None:
+        self._emit("message_accepted", 1, round_id, phase)
+
+    def message_rejected(self, round_id: int, phase: str) -> None:
+        self._emit("message_rejected", 1, round_id, phase)
+
+    def message_discarded(self, round_id: int, phase: str) -> None:
+        self._emit("message_discarded", 1, round_id, phase)
+
+    def masks_total(self, round_id: int, count: int) -> None:
+        self._emit("masks_total_number", count, round_id)
+
+    def event(self, round_id: int, kind: str, detail: str = "") -> None:
+        logger.warning("event %s round_id=%d: %s", kind, round_id, detail)
+
+
+class JsonlMetrics(Metrics):
+    """Appends one JSON object per measurement (thread-safe)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _emit(self, measurement: str, value, round_id: int, phase: str = "") -> None:
+        record = {
+            "ts": time.time(),
+            "measurement": measurement,
+            "value": value,
+            "round_id": round_id,
+        }
+        if phase:
+            record["phase"] = phase
+        line = json.dumps(record)
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+    def phase(self, round_id: int, phase: str) -> None:
+        self._emit("phase", phase, round_id, phase)
+
+    def round_total(self, round_id: int) -> None:
+        self._emit("round_total_number", round_id, round_id)
+
+    def message_accepted(self, round_id: int, phase: str) -> None:
+        self._emit("message_accepted", 1, round_id, phase)
+
+    def message_rejected(self, round_id: int, phase: str) -> None:
+        self._emit("message_rejected", 1, round_id, phase)
+
+    def message_discarded(self, round_id: int, phase: str) -> None:
+        self._emit("message_discarded", 1, round_id, phase)
+
+    def masks_total(self, round_id: int, count: int) -> None:
+        self._emit("masks_total_number", count, round_id)
+
+    def event(self, round_id: int, kind: str, detail: str = "") -> None:
+        self._emit("event_" + kind, detail, round_id)

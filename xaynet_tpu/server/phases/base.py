@@ -1,0 +1,216 @@
+"""Phase machinery: shared context, run loop, count/time request windows.
+
+Functional port of the reference's phase framework (reference:
+rust/xaynet-server/src/state_machine/phases/phase.rs:49-231 and
+handler.rs:96-202):
+
+- ``run_phase``: broadcast the phase event -> ``process`` -> purge requests
+  left over from the phase -> ``broadcast`` -> ``next``; any error routes to
+  the Failure phase.
+- request windows: accept up to ``count.max`` requests during
+  ``[0, time.min]``; then keep accepting until ``count.min`` is reached,
+  bounded by ``time.max`` — too few accepted requests is a
+  ``PhaseTimeout``. Requests beyond ``count.max`` are *discarded*; requests
+  that fail protocol checks are *rejected*.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time as time_mod
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from ...storage.traits import Store
+from ..events import EventPublisher, PhaseName
+from ..requests import ChannelClosed, RequestError, RequestReceiver, StateMachineRequest
+from ..settings import PhaseSettings, Settings, Sum2Settings
+
+if TYPE_CHECKING:
+    from ..coordinator import CoordinatorState
+
+logger = logging.getLogger("xaynet.coordinator")
+
+
+class PhaseError(Exception):
+    """A phase failed; drives the transition into Failure."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}{': ' + detail if detail else ''}")
+        self.kind = kind
+
+
+class PhaseTimeout(PhaseError):
+    def __init__(self):
+        super().__init__("PhaseTimeout", "not enough messages received within the time window")
+
+
+@dataclass
+class Shared:
+    """Context threaded through all phases (single-writer)."""
+
+    state: "CoordinatorState"
+    request_rx: RequestReceiver
+    events: EventPublisher
+    store: Store
+    settings: Settings
+    metrics: Optional[object] = None
+
+    def set_round_id(self, round_id: int) -> None:
+        self.state.round_id = round_id
+        self.events.set_round_id(round_id)
+
+    @property
+    def round_id(self) -> int:
+        return self.state.round_id
+
+
+class _Counter:
+    """Accepted/rejected/discarded bookkeeping (handler.rs:28-89)."""
+
+    def __init__(self, count_min: int, count_max: int):
+        self.min = count_min
+        self.max = count_max
+        self.accepted = 0
+        self.rejected = 0
+        self.discarded = 0
+
+    @property
+    def has_enough(self) -> bool:
+        return self.accepted >= self.min
+
+    @property
+    def has_overmuch(self) -> bool:
+        return self.accepted >= self.max
+
+
+class PhaseState:
+    """Base class for phases; subclasses set NAME and implement hooks."""
+
+    NAME: PhaseName
+
+    def __init__(self, shared: Shared):
+        self.shared = shared
+
+    # --- hooks ------------------------------------------------------------
+
+    async def process(self) -> None:
+        raise NotImplementedError
+
+    def broadcast(self) -> None:
+        pass
+
+    async def next(self) -> Optional["PhaseState"]:
+        raise NotImplementedError
+
+    async def handle_request(self, req: StateMachineRequest) -> None:
+        """Phase-specific request handling; raises ``RequestError`` to reject."""
+        raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase accepts no requests")
+
+    # --- run loop ---------------------------------------------------------
+
+    async def run_phase(self) -> Optional["PhaseState"]:
+        self.shared.events.broadcast_phase(self.NAME)
+        if self.shared.metrics is not None:
+            self.shared.metrics.phase(self.shared.round_id, self.NAME.value)
+        logger.info("round %d: entering %s phase", self.shared.round_id, self.NAME.value)
+        try:
+            await self.process()
+            await self.purge_outdated_requests()
+        except (PhaseError, ChannelClosed) as err:
+            return await self._into_failure(err)
+        except Exception as err:  # storage or internal errors
+            return await self._into_failure(PhaseError(type(err).__name__, str(err)))
+        self.broadcast()
+        return await self.next()
+
+    async def _into_failure(self, err: Exception) -> "PhaseState":
+        from .failure import Failure
+
+        logger.warning("round %d: %s phase failed: %s", self.shared.round_id, self.NAME.value, err)
+        return Failure(self.shared, err)
+
+    async def purge_outdated_requests(self) -> None:
+        """Reject every request still queued from this phase (phase.rs:183-192)."""
+        while True:
+            env = self.shared.request_rx.try_recv()
+            if env is None:
+                return
+            self._respond(env, RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase ended"))
+            if self.shared.metrics is not None:
+                self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
+
+    # --- request windows --------------------------------------------------
+
+    async def process_requests(self, params: PhaseSettings | Sum2Settings) -> None:
+        counter = _Counter(params.count.min, params.count.max)
+        logger.debug(
+            "processing requests for min %.1fs / max %.1fs (count %d..%d)",
+            params.time.min,
+            params.time.max,
+            params.count.min,
+            params.count.max,
+        )
+        await self._process_during(params.time.min, counter)
+        time_left = max(params.time.max - params.time.min, 0.0)
+        try:
+            await asyncio.wait_for(self._process_until_enough(counter), timeout=time_left)
+        except asyncio.TimeoutError:
+            raise PhaseTimeout() from None
+        logger.info(
+            "round %d %s: %d accepted (min %d, max %d), %d rejected, %d discarded",
+            self.shared.round_id,
+            self.NAME.value,
+            counter.accepted,
+            counter.min,
+            counter.max,
+            counter.rejected,
+            counter.discarded,
+        )
+
+    async def _process_during(self, duration: float, counter: _Counter) -> None:
+        deadline = time_mod.monotonic() + duration
+        while True:
+            remaining = deadline - time_mod.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                env = await asyncio.wait_for(self.shared.request_rx.next_request(), remaining)
+            except asyncio.TimeoutError:
+                return
+            await self._process_single(env, counter)
+
+    async def _process_until_enough(self, counter: _Counter) -> None:
+        while not counter.has_enough:
+            env = await self.shared.request_rx.next_request()
+            await self._process_single(env, counter)
+
+    async def _process_single(self, env, counter: _Counter) -> None:
+        if counter.has_overmuch:
+            counter.discarded += 1
+            if self.shared.metrics is not None:
+                self.shared.metrics.message_discarded(self.shared.round_id, self.NAME.value)
+            self._respond(env, RequestError(RequestError.Kind.MESSAGE_DISCARDED))
+            return
+        try:
+            await self.handle_request(env.request)
+        except RequestError as err:
+            counter.rejected += 1
+            if self.shared.metrics is not None:
+                self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
+            self._respond(env, err)
+            return
+        counter.accepted += 1
+        if self.shared.metrics is not None:
+            self.shared.metrics.message_accepted(self.shared.round_id, self.NAME.value)
+        self._respond(env, None)
+
+    @staticmethod
+    def _respond(env, error: Optional[Exception]) -> None:
+        if env.response.done():
+            return
+        if error is None:
+            env.response.set_result(None)
+        else:
+            env.response.set_exception(error)

@@ -1,0 +1,54 @@
+"""Failure phase: error capture and round restart.
+
+Reference behavior
+(rust/xaynet-server/src/state_machine/phases/failure.rs:30-106): a broken
+request channel shuts the coordinator down; any other phase error waits for
+storage readiness and restarts the round at Idle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..events import PhaseName
+from ..requests import ChannelClosed
+from .base import PhaseState
+
+logger = logging.getLogger("xaynet.coordinator")
+
+STORE_READY_RETRY_SECONDS = 1.0
+
+
+class Failure(PhaseState):
+    NAME = PhaseName.FAILURE
+
+    def __init__(self, shared, error: Exception):
+        super().__init__(shared)
+        self.error = error
+
+    async def process(self) -> None:
+        logger.warning("round %d failed: %s", self.shared.round_id, self.error)
+        if self.shared.metrics is not None:
+            self.shared.metrics.event(self.shared.round_id, "phase_error", str(self.error))
+
+    async def run_phase(self):
+        self.shared.events.broadcast_phase(self.NAME)
+        await self.process()
+        if isinstance(self.error, ChannelClosed):
+            from .shutdown import Shutdown
+
+            return Shutdown(self.shared)
+        await self._wait_for_store_readiness()
+        from .idle import Idle
+
+        return Idle(self.shared)
+
+    async def _wait_for_store_readiness(self) -> None:
+        while True:
+            try:
+                await self.shared.store.is_ready()
+                return
+            except Exception as err:
+                logger.warning("store not ready: %s; retrying", err)
+                await asyncio.sleep(STORE_READY_RETRY_SECONDS)

@@ -1,0 +1,45 @@
+"""Sum phase: collect ephemeral keys from sum participants.
+
+Reference behavior (rust/xaynet-server/src/state_machine/phases/sum.rs:43-126):
+accept ``SumRequest``s within the count/time window, adding each
+(participant pk -> ephemeral pk) entry to the sum dictionary; duplicates are
+rejected. On success the sum dictionary is fetched and broadcast for update
+participants.
+"""
+
+from __future__ import annotations
+
+from ..events import DictionaryUpdate, PhaseName
+from ..requests import RequestError, StateMachineRequest, SumRequest
+from .base import PhaseError, PhaseState
+
+
+class SumPhase(PhaseState):
+    NAME = PhaseName.SUM
+
+    def __init__(self, shared):
+        super().__init__(shared)
+        self._sum_dict = None
+
+    async def process(self) -> None:
+        await self.process_requests(self.shared.settings.pet.sum)
+        self._sum_dict = await self.shared.store.coordinator.sum_dict()
+        if not self._sum_dict:
+            raise PhaseError("NoSumDict", "sum dictionary missing after sum phase")
+
+    def broadcast(self) -> None:
+        self.shared.events.broadcast_sum_dict(DictionaryUpdate.new(self._sum_dict))
+
+    async def next(self):
+        from .update import UpdatePhase
+
+        return UpdatePhase(self.shared)
+
+    async def handle_request(self, req: StateMachineRequest) -> None:
+        if not isinstance(req, SumRequest):
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "not a sum message")
+        err = await self.shared.store.coordinator.add_sum_participant(
+            req.participant_pk, req.ephm_pk
+        )
+        if err is not None:
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, err.value)

@@ -1,0 +1,39 @@
+"""Sum2 phase: collect aggregated masks from sum participants.
+
+Reference behavior (rust/xaynet-server/src/state_machine/phases/sum2.rs:33-98):
+each accepted ``Sum2Request`` increments the score of the submitted mask
+(sum membership and single submission enforced by the store); the model
+aggregation is carried forward to Unmask.
+"""
+
+from __future__ import annotations
+
+from ..aggregation import StagedAggregator
+from ..events import PhaseName
+from ..requests import RequestError, StateMachineRequest, Sum2Request
+from .base import PhaseState
+
+
+class Sum2Phase(PhaseState):
+    NAME = PhaseName.SUM2
+
+    def __init__(self, shared, aggregator: StagedAggregator):
+        super().__init__(shared)
+        self.aggregator = aggregator
+
+    async def process(self) -> None:
+        await self.process_requests(self.shared.settings.pet.sum2)
+
+    async def next(self):
+        from .unmask import Unmask
+
+        return Unmask(self.shared, self.aggregator.finalize())
+
+    async def handle_request(self, req: StateMachineRequest) -> None:
+        if not isinstance(req, Sum2Request):
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "not a sum2 message")
+        err = await self.shared.store.coordinator.incr_mask_score(
+            req.participant_pk, req.model_mask
+        )
+        if err is not None:
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, err.value)

@@ -1,0 +1,95 @@
+"""Unmask phase: elect the winning mask and reveal the new global model.
+
+Reference behavior
+(rust/xaynet-server/src/state_machine/phases/unmask.rs:56-219): fetch the
+two best-scored masks; the winner must be the *unique* maximum (equal top
+scores are ambiguous -> round failure); validate and unmask the aggregate;
+persist the global model under ``{round_id}_{hex(seed)}`` with the latest-id
+pointer; publish proof to the trust anchor; broadcast the new model.
+
+The unmask subtract runs on the vectorized limb kernels; the fixed-point
+decode uses the double-double fast path for f32 configs
+(core/mask/encode.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.mask.masking import Aggregation, UnmaskingError
+from ...core.mask.object import MaskObject
+from ..events import ModelUpdate, PhaseName
+from .base import PhaseError, PhaseState
+
+
+class Unmask(PhaseState):
+    NAME = PhaseName.UNMASK
+
+    def __init__(self, shared, model_agg: Aggregation):
+        super().__init__(shared)
+        self.model_agg = model_agg
+        self.global_model: np.ndarray | None = None
+
+    async def process(self) -> None:
+        if self.shared.metrics is not None:
+            n_masks = await self.shared.store.coordinator.number_of_unique_masks()
+            self.shared.metrics.masks_total(self.shared.round_id, n_masks)
+        best = await self.shared.store.coordinator.best_masks()
+        if best is None:
+            raise PhaseError("NoMask", "no masks submitted")
+        mask = self._freeze_mask_dict(best)
+        try:
+            self.model_agg.validate_unmasking(mask)
+        except UnmaskingError as err:
+            raise PhaseError("Unmasking", err.kind) from err
+        self.global_model = self.model_agg.unmask_array(mask)
+        await self._save_global_model()
+        await self._publish_proof()
+
+    def broadcast(self) -> None:
+        assert self.global_model is not None
+        self.shared.events.broadcast_model(ModelUpdate.new(self.global_model))
+
+    async def next(self):
+        from .idle import Idle
+
+        return Idle(self.shared)
+
+    # --- internals --------------------------------------------------------
+
+    @staticmethod
+    def _freeze_mask_dict(best: list[tuple[MaskObject, int]]) -> MaskObject:
+        """Unique-maximum election (unmask.rs:96-115)."""
+        winner, winner_count = None, 0
+        for mask, count in best:
+            if count > winner_count:
+                winner, winner_count = mask, count
+            elif count == winner_count:
+                winner = None
+        if winner is None:
+            raise PhaseError("AmbiguousMasks", "top masks share the same score")
+        return winner
+
+    async def _save_global_model(self) -> None:
+        assert self.global_model is not None
+        data = np.asarray(self.global_model, dtype=np.float64).tobytes()
+        model_id = await self.shared.store.models.set_global_model(
+            self.shared.state.round_id,
+            self.shared.state.round_params.seed.as_bytes(),
+            data,
+        )
+        try:
+            await self.shared.store.coordinator.set_latest_global_model_id(model_id)
+        except Exception as err:  # pointer update is best-effort (unmask.rs:191-198)
+            import logging
+
+            logging.getLogger("xaynet.coordinator").warning(
+                "failed to update latest global model id: %s", err
+            )
+
+    async def _publish_proof(self) -> None:
+        if self.shared.store.trust_anchor is None:
+            return
+        assert self.global_model is not None
+        data = np.asarray(self.global_model, dtype=np.float64).tobytes()
+        await self.shared.store.trust_anchor.publish_proof(data)

@@ -1,0 +1,67 @@
+"""Update phase: collect and aggregate masked model updates.
+
+Reference behavior
+(rust/xaynet-server/src/state_machine/phases/update.rs:50-184): for each
+accepted ``UpdateRequest``: validate the masked object against the
+aggregation state, atomically insert the participant's encrypted seed dict
+(validated against the sum dictionary), then aggregate the masked model.
+Afterwards the seed dictionary is fetched and broadcast for sum
+participants.
+
+TPU-native difference: accepted updates are *staged* and folded in batches
+by the ``StagedAggregator`` (host numpy kernels or the sharded device fold)
+instead of a per-update big-int loop; validation and seed-dict ordering are
+per-update exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from ...core.mask.masking import AggregationError
+from ..aggregation import StagedAggregator
+from ..events import DictionaryUpdate, PhaseName
+from ..requests import RequestError, StateMachineRequest, UpdateRequest
+from .base import PhaseError, PhaseState
+
+
+class UpdatePhase(PhaseState):
+    NAME = PhaseName.UPDATE
+
+    def __init__(self, shared):
+        super().__init__(shared)
+        settings = shared.settings
+        self.aggregator = StagedAggregator(
+            config=shared.state.round_params.mask_config,
+            object_size=shared.state.round_params.model_length,
+            device=settings.aggregation.device,
+            batch_size=settings.aggregation.batch_size,
+        )
+        self._seed_dict = None
+
+    async def process(self) -> None:
+        await self.process_requests(self.shared.settings.pet.update)
+        self.aggregator.flush()
+        self._seed_dict = await self.shared.store.coordinator.seed_dict()
+        if not self._seed_dict:
+            raise PhaseError("NoSeedDict", "seed dictionary missing after update phase")
+
+    def broadcast(self) -> None:
+        self.shared.events.broadcast_seed_dict(DictionaryUpdate.new(self._seed_dict))
+
+    async def next(self):
+        from .sum2 import Sum2Phase
+
+        return Sum2Phase(self.shared, self.aggregator)
+
+    async def handle_request(self, req: StateMachineRequest) -> None:
+        if not isinstance(req, UpdateRequest):
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "not an update message")
+        try:
+            self.aggregator.validate_aggregation(req.masked_model)
+        except AggregationError as err:
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, err.kind) from err
+        store_err = await self.shared.store.coordinator.add_local_seed_dict(
+            req.participant_pk, req.local_seed_dict
+        )
+        if store_err is not None:
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, store_err.value)
+        self.aggregator.aggregate(req.masked_model)

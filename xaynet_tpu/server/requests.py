@@ -1,0 +1,130 @@
+"""Request channel between the services and the state machine.
+
+Functional port of the reference's request plumbing (reference:
+rust/xaynet-server/src/state_machine/requests.rs:27-205): services submit
+typed requests over an unbounded queue; each request carries a one-shot
+response future resolved by the phase that handles it. Requests from prior
+phases are purged with a rejection at phase end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional, Union
+
+from ..core.common import LocalSeedDict
+from ..core.mask.object import MaskObject
+from ..core.message import Message, Sum, Sum2, Tag, Update
+
+
+class RequestError(Exception):
+    """A request was rejected by the state machine."""
+
+    class Kind(str, Enum):
+        MESSAGE_REJECTED = "the message was rejected"
+        MESSAGE_DISCARDED = "the message was discarded"
+        INTERNAL = "internal error"
+
+    def __init__(self, kind: "RequestError.Kind", detail: str = ""):
+        super().__init__(f"{kind.value}{': ' + detail if detail else ''}")
+        self.kind = kind
+
+
+@dataclass
+class SumRequest:
+    participant_pk: bytes
+    ephm_pk: bytes
+
+
+@dataclass
+class UpdateRequest:
+    participant_pk: bytes
+    local_seed_dict: LocalSeedDict
+    masked_model: MaskObject
+
+
+@dataclass
+class Sum2Request:
+    participant_pk: bytes
+    model_mask: MaskObject
+
+
+StateMachineRequest = Union[SumRequest, UpdateRequest, Sum2Request]
+
+
+def request_from_message(message: Message) -> StateMachineRequest:
+    """Converts a verified message into a state-machine request
+    (reference: requests.rs:88-114)."""
+    payload = message.payload
+    if isinstance(payload, Sum):
+        return SumRequest(participant_pk=message.participant_pk, ephm_pk=payload.ephm_pk)
+    if isinstance(payload, Update):
+        return UpdateRequest(
+            participant_pk=message.participant_pk,
+            local_seed_dict=payload.local_seed_dict,
+            masked_model=payload.masked_model,
+        )
+    if isinstance(payload, Sum2):
+        return Sum2Request(participant_pk=message.participant_pk, model_mask=payload.model_mask)
+    raise ValueError(f"cannot convert payload {type(payload)} into a request")
+
+
+@dataclass
+class _Envelope:
+    request: StateMachineRequest
+    response: asyncio.Future
+
+
+class RequestReceiver:
+    """The state machine's end of the request channel."""
+
+    def __init__(self):
+        self._queue: asyncio.Queue[Optional[_Envelope]] = asyncio.Queue()
+        self._closed = False
+
+    async def next_request(self) -> _Envelope:
+        env = await self._queue.get()
+        if env is None:
+            raise ChannelClosed()
+        return env
+
+    def try_recv(self) -> Optional[_Envelope]:
+        """Non-blocking receive; None when the queue is momentarily empty."""
+        try:
+            env = self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if env is None:
+            raise ChannelClosed()
+        return env
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+
+    def sender(self) -> "RequestSender":
+        return RequestSender(self)
+
+
+class ChannelClosed(Exception):
+    """The request channel was shut down."""
+
+
+class RequestSender:
+    """The services' end of the request channel (cloneable)."""
+
+    def __init__(self, receiver: RequestReceiver):
+        self._receiver = receiver
+
+    async def request(self, req: StateMachineRequest) -> None:
+        """Submit a request and await the state machine's verdict.
+
+        Raises ``RequestError`` when the request is rejected/discarded.
+        """
+        if self._receiver._closed:
+            raise RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._receiver._queue.put_nowait(_Envelope(req, fut))
+        await fut

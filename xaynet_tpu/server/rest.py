@@ -1,0 +1,161 @@
+"""Coordinator REST API.
+
+Functional port of the reference's HTTP surface (reference:
+rust/xaynet-server/src/rest.rs:40-315):
+
+- ``POST /message`` — opaque sealed-box message bytes
+- ``GET /params``   — current round parameters
+- ``GET /sums``     — sum dictionary (204 while absent)
+- ``GET /seeds?pk=<hex>`` — a sum participant's seed slice (204 while absent)
+- ``GET /model``    — latest global model bytes (204 while absent)
+
+Responses are JSON (parameters, dictionaries) or raw bytes (model) — a
+readable stand-in for the reference's bincode bodies; both ends of the wire
+are this framework. Implemented directly on asyncio streams (no third-party
+HTTP dependency); optional TLS via ``ssl.SSLContext``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import ssl
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..sdk.traits import XaynetClient  # noqa: F401  (doc cross-reference)
+from .requests import RequestError
+from .services import Fetcher, PetMessageHandler, ServiceError
+
+logger = logging.getLogger("xaynet.rest")
+
+MAX_BODY = 1 << 32  # u32 length field ceiling, as in the reference
+
+
+class RestServer:
+    def __init__(self, fetcher: Fetcher, handler: PetMessageHandler):
+        self.fetcher = fetcher
+        self.handler = handler
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(
+        self, host: str = "127.0.0.1", port: int = 8081, tls: Optional[ssl.SSLContext] = None
+    ) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle_conn, host, port, ssl=tls)
+        addr = self._server.sockets[0].getsockname()
+        logger.info("REST API listening on %s:%d", addr[0], addr[1])
+        return addr[0], addr[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # --- request handling -------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    break
+                try:
+                    method, target, _ = request_line.decode().split(None, 2)
+                except ValueError:
+                    await self._respond(writer, 400, b"bad request")
+                    break
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode().partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", "0"))
+                if length > MAX_BODY:
+                    await self._respond(writer, 413, b"body too large")
+                    break
+                body = await reader.readexactly(length) if length else b""
+                keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+                status, payload, ctype = await self._route(method, target, body)
+                await self._respond(writer, status, payload, ctype, keep_alive)
+                if not keep_alive:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, target: str, body: bytes) -> tuple[int, bytes, str]:
+        url = urlparse(target)
+        path = url.path
+        try:
+            if method == "POST" and path == "/message":
+                return await self._post_message(body)
+            if method == "GET" and path == "/params":
+                return 200, json.dumps(self.fetcher.round_params().to_dict()).encode(), "application/json"
+            if method == "GET" and path == "/sums":
+                sums = self.fetcher.sum_dict()
+                if sums is None:
+                    return 204, b"", "text/plain"
+                return (
+                    200,
+                    json.dumps({k.hex(): v.hex() for k, v in sums.items()}).encode(),
+                    "application/json",
+                )
+            if method == "GET" and path == "/seeds":
+                qs = parse_qs(url.query)
+                pk_hex = (qs.get("pk") or [""])[0]
+                if not pk_hex:
+                    return 400, b"missing pk", "text/plain"
+                seeds = self.fetcher.seeds_for(bytes.fromhex(pk_hex))
+                if seeds is None:
+                    return 204, b"", "text/plain"
+                return (
+                    200,
+                    json.dumps({k.hex(): v.as_bytes().hex() for k, v in seeds.items()}).encode(),
+                    "application/json",
+                )
+            if method == "GET" and path == "/model":
+                model = self.fetcher.model()
+                if model is None:
+                    return 204, b"", "text/plain"
+                return 200, np.asarray(model, dtype=np.float64).tobytes(), "application/octet-stream"
+            return 404, b"not found", "text/plain"
+        except Exception as err:
+            logger.exception("request failed: %s %s", method, path)
+            return 500, str(err).encode(), "text/plain"
+
+    async def _post_message(self, body: bytes) -> tuple[int, bytes, str]:
+        try:
+            await self.handler.handle_message(body)
+        except (ServiceError, RequestError) as err:
+            # the reference answers 200 regardless and logs the drop —
+            # clients learn outcomes from round progression, not the POST
+            logger.debug("message dropped: %s", err)
+        return 200, b"", "text/plain"
+
+    @staticmethod
+    async def _respond(
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload: bytes,
+        ctype: str = "text/plain",
+        keep_alive: bool = False,
+    ) -> None:
+        reason = {200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found", 413: "Payload Too Large", 500: "Internal Server Error"}.get(status, "")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+        ).encode()
+        writer.write(head + payload)
+        await writer.drain()

@@ -1,0 +1,106 @@
+"""Coordinator process wiring and entry point.
+
+Functional port of the reference's startup (reference:
+rust/xaynet-server/src/bin/main.rs:29-138): settings -> logging -> metrics ->
+store -> state-machine initializer -> REST server, with the state machine
+and the API as the two long-lived tasks.
+
+Run:  python -m xaynet_tpu.server.runner -c configs/config.toml
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from ..storage.memory import (
+    FilesystemModelStorage,
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from ..storage.traits import Store
+from .metrics import JsonlMetrics, LogMetrics
+from .rest import RestServer
+from .services import Fetcher, PetMessageHandler
+from .settings import Settings
+from .state_machine import StateMachineInitializer
+
+logger = logging.getLogger("xaynet.coordinator")
+
+
+def init_store(settings: Settings) -> Store:
+    coordinator = InMemoryCoordinatorStorage()
+    if settings.storage.backend == "filesystem":
+        models = FilesystemModelStorage(settings.storage.model_dir)
+    else:
+        models = InMemoryModelStorage()
+    return Store(coordinator, models, NoOpTrustAnchor())
+
+
+def init_metrics(settings: Settings):
+    if not settings.metrics.enable:
+        return None
+    if settings.metrics.sink == "jsonl":
+        return JsonlMetrics(settings.metrics.path)
+    return LogMetrics()
+
+
+async def serve(settings: Settings, store: Optional[Store] = None) -> None:
+    logging.basicConfig(
+        level=getattr(logging, settings.log.filter.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    store = store if store is not None else init_store(settings)
+    metrics = init_metrics(settings)
+    initializer = StateMachineInitializer(settings, store, metrics)
+    machine, request_tx, events = await initializer.init()
+
+    handler = PetMessageHandler(events, request_tx)
+    fetcher = Fetcher(events)
+    rest = RestServer(fetcher, handler)
+    host, _, port = settings.api.bind_address.partition(":")
+    tls = None
+    if settings.api.tls_certificate:
+        import ssl
+
+        tls = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        tls.load_cert_chain(settings.api.tls_certificate, settings.api.tls_key)
+        if settings.api.tls_client_auth:
+            tls.verify_mode = ssl.CERT_REQUIRED
+            tls.load_verify_locations(settings.api.tls_client_auth)
+    await rest.start(host or "127.0.0.1", int(port or 8081), tls)
+
+    stop = asyncio.get_running_loop().create_future()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            asyncio.get_running_loop().add_signal_handler(sig, lambda: stop.cancel())
+        except NotImplementedError:  # pragma: no cover (non-unix)
+            pass
+
+    machine_task = asyncio.create_task(machine.run())
+    try:
+        done, _ = await asyncio.wait(
+            [machine_task, stop], return_when=asyncio.FIRST_COMPLETED
+        )
+    except asyncio.CancelledError:
+        pass
+    finally:
+        machine_task.cancel()
+        await rest.stop()
+        logger.info("coordinator stopped")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="xaynet-tpu coordinator")
+    parser.add_argument("-c", "--config", help="TOML configuration file", default=None)
+    args = parser.parse_args()
+    settings = Settings.load(args.config)
+    asyncio.run(serve(settings))
+
+
+if __name__ == "__main__":
+    main()

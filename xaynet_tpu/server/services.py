@@ -1,0 +1,186 @@
+"""Message-processing pipeline and data fetchers.
+
+Functional port of the reference's tower service stack (reference:
+rust/xaynet-server/src/services/messages/mod.rs:30-118):
+
+    Decryptor -> MessageParser (phase filter + signature verification)
+    -> MultipartHandler (chunk reassembly) -> TaskValidator -> StateMachine
+
+CPU-heavy stages (sealed-box open, Ed25519 verify) run on a thread pool so
+the asyncio loop stays responsive — the analogue of the reference's rayon
+offload with a concurrency limit.
+
+``Fetcher`` exposes the latest event-bus values to the API layer
+(reference: rust/xaynet-server/src/services/fetchers/mod.rs:27-42).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..core.common import RoundParameters
+from ..core.crypto.encrypt import DecryptError, EncryptKeyPair
+from ..core.crypto.sign import is_eligible, verify_detached
+from ..core.mask.serialization import DecodeError
+from ..core.message import Chunk, Message, Sum, Sum2, Tag, Update, peek_header
+from ..core.message.encoder import MessageBuilder
+from .events import EventSubscriber, PhaseName
+from .requests import RequestSender, request_from_message
+
+_PHASE_TAGS = {
+    PhaseName.SUM: Tag.SUM,
+    PhaseName.UPDATE: Tag.UPDATE,
+    PhaseName.SUM2: Tag.SUM2,
+}
+
+
+class ServiceError(Exception):
+    """A message was dropped by the pipeline (with the stage as context)."""
+
+    def __init__(self, stage: str, detail: str):
+        super().__init__(f"{stage}: {detail}")
+        self.stage = stage
+
+
+class PetMessageHandler:
+    """End-to-end handling of one encrypted PET message."""
+
+    def __init__(
+        self,
+        events: EventSubscriber,
+        request_tx: RequestSender,
+        max_workers: int = 4,
+    ):
+        self.events = events
+        self.request_tx = request_tx
+        self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="pet-msg")
+        # multipart reassembly buffers keyed by (participant_pk, message_id)
+        self._multipart: dict[tuple[bytes, int], MessageBuilder] = {}
+
+    async def handle_message(self, encrypted: bytes) -> None:
+        """Decrypt, verify, validate and forward one message.
+
+        Raises ``ServiceError`` (pipeline drop) or ``RequestError`` (state
+        machine rejection).
+        """
+        message = await self._parse_message(encrypted)
+        if message is None:
+            return  # multipart message still incomplete
+        self._validate_task(message)
+        await self.request_tx.request(request_from_message(message))
+
+    # --- pipeline stages --------------------------------------------------
+
+    async def _parse_message(self, encrypted: bytes) -> Optional[Message]:
+        loop = asyncio.get_running_loop()
+        keys: EncryptKeyPair = self.events.keys.get_latest().event
+        phase: PhaseName = self.events.phase.get_latest().event
+
+        def decrypt_and_parse() -> Message:
+            # sealed-box open (CPU) — reference: decryptor.rs:48-69
+            try:
+                raw = keys.secret.decrypt(encrypted)
+            except (DecryptError, ValueError) as e:
+                raise ServiceError("decrypt", str(e)) from e
+            # phase filter before the expensive signature check
+            # (reference: message_parser.rs:88-141)
+            try:
+                _, tag, _ = peek_header(raw)
+            except DecodeError as e:
+                raise ServiceError("parse", str(e)) from e
+            expected = _PHASE_TAGS.get(phase)
+            if expected is None or tag != expected:
+                raise ServiceError("phase-filter", f"{tag.name} message during {phase.value}")
+            # signature verification + full parse
+            try:
+                return Message.from_bytes(raw, verify=True)
+            except DecodeError as e:
+                raise ServiceError("parse", str(e)) from e
+
+        message = await loop.run_in_executor(self._pool, decrypt_and_parse)
+        if message.is_multipart:
+            return self._handle_chunk(message)
+        return message
+
+    def _handle_chunk(self, message: Message) -> Optional[Message]:
+        """Reassembly per (participant, message_id)
+        (reference: multipart/service.rs:26-117)."""
+        chunk = message.payload
+        assert isinstance(chunk, Chunk)
+        key = (message.participant_pk, chunk.message_id)
+        builder = self._multipart.setdefault(key, MessageBuilder())
+        if not builder.add(chunk):
+            return None
+        del self._multipart[key]
+        payload_bytes = builder.payload_bytes()
+        from ..core.message.payloads import parse_payload
+
+        try:
+            payload = parse_payload(message.tag, False, payload_bytes)
+        except DecodeError as e:
+            raise ServiceError("multipart", str(e)) from e
+        return Message(
+            participant_pk=message.participant_pk,
+            coordinator_pk=message.coordinator_pk,
+            payload=payload,
+            tag=message.tag,
+            is_multipart=False,
+            signature=message.signature,
+        )
+
+    def _validate_task(self, message: Message) -> None:
+        """Sum/update task eligibility (reference: task_validator.rs:40-88)."""
+        params: RoundParameters = self.events.params.get_latest().event
+        seed = params.seed.as_bytes()
+        payload = message.payload
+        if isinstance(payload, (Sum, Sum2)):
+            if not verify_detached(message.participant_pk, payload.sum_signature, seed + b"sum"):
+                raise ServiceError("task-validator", "invalid sum task signature")
+            if not is_eligible(payload.sum_signature, params.sum):
+                raise ServiceError("task-validator", "not eligible for the sum task")
+        elif isinstance(payload, Update):
+            if not verify_detached(message.participant_pk, payload.sum_signature, seed + b"sum"):
+                raise ServiceError("task-validator", "invalid sum task signature")
+            if not verify_detached(
+                message.participant_pk, payload.update_signature, seed + b"update"
+            ):
+                raise ServiceError("task-validator", "invalid update task signature")
+            # an update participant must NOT be a sum participant, and must
+            # be eligible for the update task
+            if is_eligible(payload.sum_signature, params.sum):
+                raise ServiceError("task-validator", "sum participant sent an update message")
+            if not is_eligible(payload.update_signature, params.update):
+                raise ServiceError("task-validator", "not eligible for the update task")
+        else:
+            raise ServiceError("task-validator", f"unexpected payload {type(payload)}")
+
+
+class Fetcher:
+    """Read access to the latest round data for the API layer."""
+
+    def __init__(self, events: EventSubscriber):
+        self.events = events
+
+    def round_params(self) -> RoundParameters:
+        return self.events.params.get_latest().event
+
+    def phase(self) -> PhaseName:
+        return self.events.phase.get_latest().event
+
+    def sum_dict(self):
+        return self.events.sum_dict.get_latest().event.dict
+
+    def seed_dict(self):
+        return self.events.seed_dict.get_latest().event.dict
+
+    def seeds_for(self, pk: bytes):
+        """The UpdateSeedDict slice for one sum participant (GET /seeds)."""
+        seed_dict = self.seed_dict()
+        if seed_dict is None:
+            return None
+        return seed_dict.get(pk)
+
+    def model(self):
+        return self.events.model.get_latest().event.model

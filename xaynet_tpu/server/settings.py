@@ -1,0 +1,288 @@
+"""Coordinator configuration: TOML file + environment overrides.
+
+Functional port of the reference's layered settings (reference:
+rust/xaynet-server/src/settings/mod.rs): sections [log], [api], [pet],
+[mask], [model], [metrics], [redis]/[storage], [restore]; env overrides use
+``XAYNET__SECTION__KEY``; cross-field invariants are validated on load
+(count min<=max with protocol floors, time min<=max, probability ranges —
+settings/mod.rs:307-376).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
+from ..core.message import SUM_COUNT_MIN, UPDATE_COUNT_MIN
+
+
+class SettingsError(ValueError):
+    """Invalid or inconsistent configuration."""
+
+
+@dataclass
+class CountSettings:
+    min: int
+    max: int
+
+
+@dataclass
+class TimeSettings:
+    min: float
+    max: float
+
+
+@dataclass
+class PhaseSettings:
+    prob: float
+    count: CountSettings
+    time: TimeSettings
+
+
+@dataclass
+class Sum2Settings:
+    count: CountSettings
+    time: TimeSettings
+
+
+@dataclass
+class PetSettings:
+    sum: PhaseSettings
+    update: PhaseSettings
+    sum2: Sum2Settings
+
+    def validate(self) -> None:
+        for name, phase, floor in (
+            ("sum", self.sum, SUM_COUNT_MIN),
+            ("update", self.update, UPDATE_COUNT_MIN),
+        ):
+            if not (0.0 < phase.prob <= 1.0) if name == "sum" else not (0.0 <= phase.prob < 1.0):
+                raise SettingsError(f"pet.{name}.prob out of range")
+            if phase.count.min < floor:
+                raise SettingsError(f"pet.{name}.count.min must be >= {floor}")
+            if phase.count.max < phase.count.min:
+                raise SettingsError(f"pet.{name}.count.max must be >= count.min")
+            if phase.time.max < phase.time.min:
+                raise SettingsError(f"pet.{name}.time.max must be >= time.min")
+        if self.sum2.count.min < SUM_COUNT_MIN:
+            raise SettingsError("pet.sum2.count.min must be >= 1")
+        if self.sum2.count.max < self.sum2.count.min:
+            raise SettingsError("pet.sum2.count.max must be >= count.min")
+        if self.sum2.time.max < self.sum2.time.min:
+            raise SettingsError("pet.sum2.time.max must be >= time.min")
+
+
+@dataclass
+class MaskSettings:
+    group_type: GroupType = GroupType.PRIME
+    data_type: DataType = DataType.F32
+    bound_type: BoundType = BoundType.B0
+    model_type: ModelType = ModelType.M3
+
+    def to_config(self) -> MaskConfig:
+        return MaskConfig(self.group_type, self.data_type, self.bound_type, self.model_type)
+
+
+@dataclass
+class ModelSettings:
+    length: int = 4
+
+
+@dataclass
+class ApiSettings:
+    bind_address: str = "127.0.0.1:8081"
+    tls_certificate: Optional[str] = None
+    tls_key: Optional[str] = None
+    tls_client_auth: Optional[str] = None
+
+    def validate(self) -> None:
+        if (self.tls_certificate is None) != (self.tls_key is None):
+            raise SettingsError("api TLS requires both certificate and key")
+
+
+@dataclass
+class StorageSettings:
+    backend: str = "memory"  # memory | filesystem
+    model_dir: str = "./global_models"
+
+
+@dataclass
+class RestoreSettings:
+    enable: bool = False
+
+
+@dataclass
+class MetricsSettings:
+    enable: bool = False
+    sink: str = "log"  # log | jsonl
+    path: str = "./metrics.jsonl"
+
+
+@dataclass
+class LoggingSettings:
+    filter: str = "info"
+
+
+@dataclass
+class AggregationSettings:
+    device: bool = False  # fold updates on the TPU mesh instead of host numpy
+    batch_size: int = 64  # staged updates per device fold
+
+
+@dataclass
+class Settings:
+    pet: PetSettings
+    mask: MaskSettings = field(default_factory=MaskSettings)
+    model: ModelSettings = field(default_factory=ModelSettings)
+    api: ApiSettings = field(default_factory=ApiSettings)
+    storage: StorageSettings = field(default_factory=StorageSettings)
+    restore: RestoreSettings = field(default_factory=RestoreSettings)
+    metrics: MetricsSettings = field(default_factory=MetricsSettings)
+    log: LoggingSettings = field(default_factory=LoggingSettings)
+    aggregation: AggregationSettings = field(default_factory=AggregationSettings)
+
+    def validate(self) -> None:
+        self.pet.validate()
+        self.api.validate()
+        if self.model.length < 1:
+            raise SettingsError("model.length must be >= 1")
+        if self.aggregation.batch_size < 1:
+            raise SettingsError("aggregation.batch_size must be >= 1")
+
+    @classmethod
+    def default(cls) -> "Settings":
+        return cls(
+            pet=PetSettings(
+                sum=PhaseSettings(
+                    prob=0.01,
+                    count=CountSettings(min=1, max=100),
+                    time=TimeSettings(min=0.0, max=600.0),
+                ),
+                update=PhaseSettings(
+                    prob=0.1,
+                    count=CountSettings(min=3, max=10000),
+                    time=TimeSettings(min=0.0, max=600.0),
+                ),
+                sum2=Sum2Settings(
+                    count=CountSettings(min=1, max=100),
+                    time=TimeSettings(min=0.0, max=600.0),
+                ),
+            )
+        )
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, env: Optional[dict] = None) -> "Settings":
+        """Load from TOML (optional) with ``XAYNET__SECTION__KEY`` env overrides."""
+        raw: dict[str, Any] = {}
+        if path is not None:
+            with open(path, "rb") as f:
+                raw = tomllib.load(f)
+        env = dict(os.environ if env is None else env)
+        for key, value in env.items():
+            if not key.startswith("XAYNET__"):
+                continue
+            parts = [p.lower() for p in key.split("__")[1:]]
+            node = raw
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = _coerce(value)
+        settings = cls._from_raw(raw)
+        settings.validate()
+        return settings
+
+    @classmethod
+    def _from_raw(cls, raw: dict) -> "Settings":
+        base = cls.default()
+        pet = raw.get("pet", {})
+
+        def phase(name: str, default: PhaseSettings | Sum2Settings):
+            section = pet.get(name, {})
+            count = section.get("count", {})
+            time_ = section.get("time", {})
+            kwargs = dict(
+                count=CountSettings(
+                    min=int(count.get("min", default.count.min)),
+                    max=int(count.get("max", default.count.max)),
+                ),
+                time=TimeSettings(
+                    min=float(time_.get("min", default.time.min)),
+                    max=float(time_.get("max", default.time.max)),
+                ),
+            )
+            if isinstance(default, PhaseSettings):
+                return PhaseSettings(prob=float(section.get("prob", default.prob)), **kwargs)
+            return Sum2Settings(**kwargs)
+
+        mask_raw = raw.get("mask", {})
+        model_raw = raw.get("model", {})
+        api_raw = raw.get("api", {})
+        storage_raw = raw.get("storage", {})
+        restore_raw = raw.get("restore", {})
+        metrics_raw = raw.get("metrics", {})
+        log_raw = raw.get("log", {})
+        agg_raw = raw.get("aggregation", {})
+
+        return cls(
+            pet=PetSettings(
+                sum=phase("sum", base.pet.sum),
+                update=phase("update", base.pet.update),
+                sum2=phase("sum2", base.pet.sum2),
+            ),
+            mask=MaskSettings(
+                group_type=_enum(GroupType, mask_raw.get("group_type", "prime")),
+                data_type=_enum(DataType, mask_raw.get("data_type", "f32")),
+                bound_type=_enum(BoundType, mask_raw.get("bound_type", "b0")),
+                model_type=_enum(ModelType, mask_raw.get("model_type", "m3")),
+            ),
+            model=ModelSettings(length=int(model_raw.get("length", base.model.length))),
+            api=ApiSettings(
+                bind_address=str(api_raw.get("bind_address", base.api.bind_address)),
+                tls_certificate=api_raw.get("tls_certificate"),
+                tls_key=api_raw.get("tls_key"),
+                tls_client_auth=api_raw.get("tls_client_auth"),
+            ),
+            storage=StorageSettings(
+                backend=str(storage_raw.get("backend", base.storage.backend)),
+                model_dir=str(storage_raw.get("model_dir", base.storage.model_dir)),
+            ),
+            restore=RestoreSettings(enable=bool(restore_raw.get("enable", False))),
+            metrics=MetricsSettings(
+                enable=bool(metrics_raw.get("enable", False)),
+                sink=str(metrics_raw.get("sink", base.metrics.sink)),
+                path=str(metrics_raw.get("path", base.metrics.path)),
+            ),
+            log=LoggingSettings(filter=str(log_raw.get("filter", base.log.filter))),
+            aggregation=AggregationSettings(
+                device=bool(agg_raw.get("device", False)),
+                batch_size=int(agg_raw.get("batch_size", base.aggregation.batch_size)),
+            ),
+        )
+
+
+def _coerce(value: str):
+    low = value.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        pass
+    return value
+
+
+def _enum(enum_cls, name):
+    if isinstance(name, enum_cls):
+        return name
+    try:
+        if isinstance(name, int):
+            return enum_cls(name)
+        return enum_cls[str(name).upper()]
+    except KeyError as e:
+        raise SettingsError(f"invalid {enum_cls.__name__}: {name}") from e

@@ -1,0 +1,117 @@
+"""The coordinator state machine and its initializer.
+
+Reference surface: rust/xaynet-server/src/state_machine/mod.rs:124-180 (the
+phase loop) and initializer.rs:97-281 (fresh start vs. restore-from-store
+with model-length validation).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..storage.traits import Store
+from .coordinator import CoordinatorState
+from .events import EventPublisher, EventSubscriber, ModelUpdate, PhaseName
+from .phases import Idle, PhaseState, Shared
+from .requests import RequestReceiver, RequestSender
+from .settings import Settings, SettingsError
+
+logger = logging.getLogger("xaynet.coordinator")
+
+
+class StateMachine:
+    """Runs phases until shutdown; single writer of all round state."""
+
+    def __init__(self, initial: PhaseState):
+        self._phase: Optional[PhaseState] = initial
+
+    @property
+    def phase(self) -> Optional[PhaseState]:
+        return self._phase
+
+    async def next(self) -> bool:
+        """Runs one phase; returns False when the machine has shut down."""
+        if self._phase is None:
+            return False
+        self._phase = await self._phase.run_phase()
+        return self._phase is not None
+
+    async def run(self) -> None:
+        while await self.next():
+            pass
+        logger.info("state machine terminated")
+
+
+class RestoreError(RuntimeError):
+    """Coordinator restore failed (dangling model id, length mismatch, ...)."""
+
+
+class StateMachineInitializer:
+    """Builds (StateMachine, RequestSender, EventSubscriber) from settings."""
+
+    def __init__(self, settings: Settings, store: Store, metrics=None):
+        settings.validate()
+        self.settings = settings
+        self.store = store
+        self.metrics = metrics
+
+    async def init(self) -> tuple[StateMachine, RequestSender, EventSubscriber]:
+        """Fresh start (or restore when enabled and state exists)."""
+        if self.settings.restore.enable:
+            restored = await self._try_restore()
+            if restored is not None:
+                return restored
+            logger.info("no coordinator state found; starting fresh")
+        else:
+            logger.info("restore disabled; deleting coordinator data")
+            await self.store.coordinator.delete_coordinator_data()
+        state = CoordinatorState.from_settings(self.settings)
+        return self._assemble(state, ModelUpdate.invalidate())
+
+    async def _try_restore(self):
+        raw = await self.store.coordinator.coordinator_state()
+        if raw is None:
+            return None
+        state = CoordinatorState.from_bytes(raw)
+        logger.info("restored coordinator state at round %d", state.round_id)
+        # restore the latest global model, validating its length
+        # (reference: initializer.rs:199-271)
+        model_update = ModelUpdate.invalidate()
+        model_id = await self.store.coordinator.latest_global_model_id()
+        if model_id is not None:
+            blob = await self.store.models.global_model(model_id)
+            if blob is None:
+                raise RestoreError(
+                    f"latest global model id {model_id} points to no stored model"
+                )
+            model = np.frombuffer(blob, dtype=np.float64)
+            if model.shape[0] != state.round_params.model_length:
+                raise RestoreError(
+                    f"restored model length {model.shape[0]} != configured "
+                    f"{state.round_params.model_length}"
+                )
+            model_update = ModelUpdate.new(model)
+        return self._assemble(state, model_update)
+
+    def _assemble(self, state: CoordinatorState, model_update: ModelUpdate):
+        events = EventPublisher(
+            round_id=state.round_id,
+            keys=state.keys,
+            params=state.round_params,
+            phase=PhaseName.IDLE,
+            model=model_update,
+        )
+        request_rx = RequestReceiver()
+        shared = Shared(
+            state=state,
+            request_rx=request_rx,
+            events=events,
+            store=self.store,
+            settings=self.settings,
+            metrics=self.metrics,
+        )
+        machine = StateMachine(Idle(shared))
+        return machine, request_rx.sender(), events.subscribe()
