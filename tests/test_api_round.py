@@ -1,0 +1,126 @@
+"""Rounds driven through the high-level API (spawn_participant / ABC).
+
+Mirrors the reference's python-bindings usage
+(bindings/python/examples/hello_world.py): a coordinator over REST, N
+background participant threads implementing ``ParticipantABC``, two full
+rounds including global-model delivery back into the trainers.
+"""
+
+import asyncio
+import threading
+import time
+from fractions import Fraction
+
+import numpy as np
+
+from xaynet_tpu.sdk.api import ParticipantABC, spawn_participant
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+N_SUM, N_UPDATE, MODEL_LEN = 1, 3, 5
+SUM_PROB, UPDATE_PROB = 0.4, 0.5
+
+
+class ConstantTrainer(ParticipantABC):
+    def __init__(self, value: float):
+        self.value = value
+        self.received_models: list[np.ndarray] = []
+
+    def train_round(self, training_input):
+        return np.full(MODEL_LEN, self.value, dtype=np.float32)
+
+    def on_new_global_model(self, model):
+        self.received_models.append(np.asarray(model))
+
+
+def _start_coordinator():
+    settings = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(prob=SUM_PROB, count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
+            update=PhaseSettings(prob=UPDATE_PROB, count=CountSettings(N_UPDATE, N_UPDATE), time=TimeSettings(0, 30)),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
+        )
+    )
+    settings.model.length = MODEL_LEN
+    started = threading.Event()
+    info = {}
+
+    def run():
+        async def main():
+            store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+            machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+            handler = PetMessageHandler(events, request_tx)
+            fetcher = Fetcher(events)
+            rest = RestServer(fetcher, handler)
+            host, port = await rest.start("127.0.0.1", 0)
+            info["url"] = f"http://{host}:{port}"
+            started.set()
+            await machine.run()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    return info["url"]
+
+
+def test_spawn_participants_two_rounds():
+    url = _start_coordinator()
+    probe = HttpClient(url)
+
+    def sync(coro):
+        return asyncio.run(asyncio.wait_for(coro, 20))
+
+    # wait for round params of round 1
+    for _ in range(200):
+        try:
+            params = sync(probe.get_round_params())
+            break
+        except Exception:
+            time.sleep(0.05)
+    seed = params.seed.as_bytes()
+
+    threads = []
+    trainers = []
+    for i in range(N_SUM):
+        keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+        threads.append(spawn_participant(url, ConstantTrainer, args=(0.0,), keys=keys))
+    for i in range(N_UPDATE):
+        keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(30 + i) * 1000)
+        trainer_thread = spawn_participant(
+            url, ConstantTrainer, args=(float(i + 1),), scalar=Fraction(1, N_UPDATE), keys=keys
+        )
+        threads.append(trainer_thread)
+        trainers.append(trainer_thread)
+
+    # round 1 completes: global model = mean(1, 2, 3) = 2.0
+    deadline = time.time() + 45
+    model = None
+    while time.time() < deadline:
+        model = sync(probe.get_model())
+        if model is not None:
+            break
+        time.sleep(0.1)
+    assert model is not None, "round did not complete"
+    np.testing.assert_allclose(model, np.full(MODEL_LEN, 2.0), atol=1e-8)
+
+    for t in threads:
+        t.stop()

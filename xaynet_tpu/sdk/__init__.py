@@ -1,0 +1,37 @@
+"""Participant SDK: state machine, clients, embeddable + high-level APIs.
+
+Reference surface: rust/xaynet-sdk/ (FSM, client, encoder),
+rust/xaynet-mobile/ (tick-driven Participant), bindings/python/xaynet_sdk
+(ParticipantABC / AsyncParticipant / spawn_*).
+"""
+
+from .api import (
+    AsyncParticipant,
+    InternalParticipant,
+    ParticipantABC,
+    spawn_async_participant,
+    spawn_participant,
+)
+from .client import HttpClient, InProcessClient
+from .participant import Participant
+from .state_machine import PetSettings, PhaseKind, StateMachine, Task, TransitionOutcome
+from .traits import ModelStore, Notify, XaynetClient
+
+__all__ = [
+    "AsyncParticipant",
+    "InternalParticipant",
+    "ParticipantABC",
+    "spawn_async_participant",
+    "spawn_participant",
+    "HttpClient",
+    "InProcessClient",
+    "Participant",
+    "PetSettings",
+    "PhaseKind",
+    "StateMachine",
+    "Task",
+    "TransitionOutcome",
+    "ModelStore",
+    "Notify",
+    "XaynetClient",
+]

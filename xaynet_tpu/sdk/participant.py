@@ -1,0 +1,136 @@
+"""Caller-driven participant: the embeddable tick-based wrapper.
+
+Functional port of the reference's mobile participant (reference:
+rust/xaynet-mobile/src/participant.rs:129-353): the embedding application
+owns the control flow and calls ``tick()``; between ticks it can inspect
+``task()``, ``made_progress()``, ``should_set_model()`` and
+``new_global_model()``, provide the trained model via ``set_model()``, and
+suspend/resume the whole participant with ``save()`` / ``restore()``.
+
+The reference wraps a tokio current-thread runtime; this wraps a private
+asyncio event loop, so ``tick()`` is synchronous for the caller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from enum import Enum
+from fractions import Fraction
+from typing import Optional, Union
+
+import numpy as np
+
+from ..core.crypto.sign import SigningKeyPair
+from .client import HttpClient, InProcessClient
+from .state_machine import PetSettings, PhaseKind, StateMachine, Task, TransitionOutcome
+from .traits import ModelStore, Notify, XaynetClient
+
+
+class _Events(Notify):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.got_new_round = False
+        self.wants_model = False
+        self.new_global = False
+
+    def new_round(self):
+        self.got_new_round = True
+
+    def load_model(self):
+        self.wants_model = True
+
+    def new_model(self, model):
+        self.new_global = True
+
+
+class _SettableModelStore(ModelStore):
+    def __init__(self):
+        self.model: Optional[np.ndarray] = None
+
+    async def load_model(self):
+        return self.model
+
+
+class Participant:
+    """Tick-driven PET participant."""
+
+    def __init__(
+        self,
+        client: Union[str, XaynetClient],
+        scalar: Fraction = Fraction(1),
+        state: Optional[bytes] = None,
+        keys: Optional[SigningKeyPair] = None,
+        max_message_size: Optional[int] = 4096,
+    ):
+        if isinstance(client, str):
+            client = HttpClient(client)
+        self._loop = asyncio.new_event_loop()
+        self._events = _Events()
+        self._store = _SettableModelStore()
+        if state is not None:
+            self._sm = StateMachine.restore(state, client, self._store, self._events)
+        else:
+            settings = PetSettings(
+                keys=keys or SigningKeyPair.generate(),
+                scalar=scalar,
+                max_message_size=max_message_size,
+            )
+            self._sm = StateMachine(settings, client, self._store, self._events)
+        self._made_progress = False
+
+    # --- driving ----------------------------------------------------------
+
+    def tick(self) -> None:
+        """Runs one state-machine transition."""
+        self._events.wants_model = False
+        outcome = self._loop.run_until_complete(self._guarded_transition())
+        self._made_progress = outcome == TransitionOutcome.COMPLETE
+
+    async def _guarded_transition(self) -> TransitionOutcome:
+        try:
+            return await self._sm.transition()
+        except Exception:
+            return TransitionOutcome.PENDING
+
+    # --- inspection -------------------------------------------------------
+
+    def made_progress(self) -> bool:
+        return self._made_progress
+
+    def task(self) -> Task:
+        return self._sm.task
+
+    def should_set_model(self) -> bool:
+        return self._events.wants_model
+
+    def new_global_model(self) -> bool:
+        """True once per round start (a fresh global model may be ready)."""
+        flag = self._events.got_new_round
+        self._events.got_new_round = False
+        return flag
+
+    # --- model exchange ---------------------------------------------------
+
+    def set_model(self, model) -> None:
+        self._store.model = np.asarray(model, dtype=np.float32)
+
+    def clear_model(self) -> None:
+        """Forget the staged local model (typically at round start)."""
+        self._store.model = None
+
+    def global_model(self) -> Optional[np.ndarray]:
+        return self._loop.run_until_complete(self._sm.client.get_model())
+
+    # --- persistence ------------------------------------------------------
+
+    def save(self) -> bytes:
+        """Serializes the participant; the instance must not be used after."""
+        state = self._sm.save()
+        self._loop.close()
+        return state
+
+    @classmethod
+    def restore(cls, state: bytes, client: Union[str, XaynetClient]) -> "Participant":
+        return cls(client, state=state)
