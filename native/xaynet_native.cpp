@@ -1,0 +1,184 @@
+// Native host kernels for xaynet_tpu.
+//
+// The reference implements its entire hot path in native code (Rust); the
+// TPU build keeps the *device* hot loops in XLA/Pallas and implements the
+// host-side compute-heavy pieces here in C++:
+//
+//   - ChaCha20 keystream generation (the PET mask-expansion PRNG;
+//     reference semantics: rust/xaynet-core/src/crypto/prng.rs:16-27),
+//   - rejection sampling of uniform finite-group elements from that
+//     keystream (byte-stream compatible with the Python/JAX samplers),
+//   - fixed-width little-endian modular add/sub over element vectors (the
+//     CPU fallback of the aggregation kernels).
+//
+// Built as a plain shared library; loaded via ctypes (no pybind11).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#define XN_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+inline uint32_t rotl(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+
+inline void quarter(uint32_t s[16], int a, int b, int c, int d) {
+  s[a] += s[b];
+  s[d] = rotl(s[d] ^ s[a], 16);
+  s[c] += s[d];
+  s[b] = rotl(s[b] ^ s[c], 12);
+  s[a] += s[b];
+  s[d] = rotl(s[d] ^ s[a], 8);
+  s[c] += s[d];
+  s[b] = rotl(s[b] ^ s[c], 7);
+}
+
+// One 64-byte ChaCha20 block (djb variant: 64-bit counter, 64-bit zero nonce).
+void chacha20_block(const uint32_t key[8], uint64_t counter, uint8_t out[64]) {
+  uint32_t s[16] = {0x61707865u, 0x3320646eu, 0x79622d32u, 0x6b206574u,
+                    key[0],      key[1],      key[2],      key[3],
+                    key[4],      key[5],      key[6],      key[7],
+                    (uint32_t)(counter & 0xffffffffu),
+                    (uint32_t)(counter >> 32),
+                    0u,          0u};
+  uint32_t w[16];
+  std::memcpy(w, s, sizeof(w));
+  for (int i = 0; i < 10; i++) {
+    quarter(w, 0, 4, 8, 12);
+    quarter(w, 1, 5, 9, 13);
+    quarter(w, 2, 6, 10, 14);
+    quarter(w, 3, 7, 11, 15);
+    quarter(w, 0, 5, 10, 15);
+    quarter(w, 1, 6, 11, 12);
+    quarter(w, 2, 7, 8, 13);
+    quarter(w, 3, 4, 9, 14);
+  }
+  for (int i = 0; i < 16; i++) {
+    uint32_t v = w[i] + s[i];
+    out[i * 4 + 0] = (uint8_t)(v);
+    out[i * 4 + 1] = (uint8_t)(v >> 8);
+    out[i * 4 + 2] = (uint8_t)(v >> 16);
+    out[i * 4 + 3] = (uint8_t)(v >> 24);
+  }
+}
+
+// value < order over fixed-width little-endian byte strings.
+inline bool lt_le(const uint8_t* value, const uint8_t* order, uint32_t n) {
+  for (int i = (int)n - 1; i >= 0; i--) {
+    if (value[i] < order[i]) return true;
+    if (value[i] > order[i]) return false;
+  }
+  return false;  // equal
+}
+
+}  // namespace
+
+// Generate `nblocks` keystream blocks starting at `block_start` into `out`
+// (64 bytes per block).
+XN_EXPORT void xn_chacha20_blocks(const uint8_t key_bytes[32], uint64_t block_start,
+                                  uint64_t nblocks, uint8_t* out) {
+  uint32_t key[8];
+  std::memcpy(key, key_bytes, 32);
+  for (uint64_t i = 0; i < nblocks; i++) {
+    chacha20_block(key, block_start + i, out + i * 64);
+  }
+}
+
+// Draw `count` uniform values below `order` (little-endian, `order_nbytes`
+// wide — the byte length of the order itself) from the keystream of `key`,
+// starting at absolute keystream byte `byte_offset`. Each rejection attempt
+// consumes `order_nbytes` bytes, exactly like the sequential reference
+// sampler. Accepted values are written fixed-width little-endian to `out`
+// (count * order_nbytes bytes). Returns the new keystream byte offset.
+XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_offset,
+                                     uint64_t count, const uint8_t* order_le,
+                                     uint32_t order_nbytes, uint8_t* out) {
+  uint32_t key[8];
+  std::memcpy(key, key_bytes, 32);
+
+  uint8_t block[64];
+  uint64_t cur_block = UINT64_MAX;  // invalid: forces initial generation
+  uint8_t candidate[512];           // order_nbytes <= 268 in the catalogue
+
+  uint64_t offset = byte_offset;
+  for (uint64_t got = 0; got < count;) {
+    // assemble the next candidate from (possibly two) keystream blocks
+    for (uint32_t i = 0; i < order_nbytes; i++) {
+      uint64_t pos = offset + i;
+      uint64_t blk = pos / 64;
+      if (blk != cur_block) {
+        chacha20_block(key, blk, block);
+        cur_block = blk;
+      }
+      candidate[i] = block[pos % 64];
+    }
+    offset += order_nbytes;
+    if (lt_le(candidate, order_le, order_nbytes)) {
+      std::memcpy(out + got * order_nbytes, candidate, order_nbytes);
+      got++;
+    }
+  }
+  return offset;
+}
+
+// (a + b) mod order, elementwise over `n` values of `n_limbs` uint32 limbs
+// (little-endian limb order, wire layout [n, L]); a, b < order.
+// `order_limbs` may be all zero when order == 2^(32*L) (natural wraparound).
+XN_EXPORT void xn_mod_add(const uint32_t* a, const uint32_t* b, uint32_t* out,
+                          uint64_t n, uint32_t n_limbs, const uint32_t* order_limbs) {
+  bool order_is_pow2_boundary = true;
+  for (uint32_t j = 0; j < n_limbs; j++)
+    if (order_limbs[j] != 0) order_is_pow2_boundary = false;
+
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t* av = a + i * n_limbs;
+    const uint32_t* bv = b + i * n_limbs;
+    uint32_t* ov = out + i * n_limbs;
+    uint64_t carry = 0;
+    for (uint32_t j = 0; j < n_limbs; j++) {
+      uint64_t s = (uint64_t)av[j] + bv[j] + carry;
+      ov[j] = (uint32_t)s;
+      carry = s >> 32;
+    }
+    if (order_is_pow2_boundary) continue;
+    bool ge = carry != 0;
+    if (!ge) {
+      ge = !lt_le((const uint8_t*)ov, (const uint8_t*)order_limbs, n_limbs * 4);
+    }
+    if (ge) {
+      uint64_t borrow = 0;
+      for (uint32_t j = 0; j < n_limbs; j++) {
+        uint64_t d = (uint64_t)ov[j] - order_limbs[j] - borrow;
+        ov[j] = (uint32_t)d;
+        borrow = (d >> 63) & 1;
+      }
+    }
+  }
+}
+
+// (a - b) mod order, elementwise (same layout/conventions as xn_mod_add).
+XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
+                          uint64_t n, uint32_t n_limbs, const uint32_t* order_limbs) {
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t* av = a + i * n_limbs;
+    const uint32_t* bv = b + i * n_limbs;
+    uint32_t* ov = out + i * n_limbs;
+    uint64_t borrow = 0;
+    for (uint32_t j = 0; j < n_limbs; j++) {
+      uint64_t d = (uint64_t)av[j] - bv[j] - borrow;
+      ov[j] = (uint32_t)d;
+      borrow = (d >> 63) & 1;
+    }
+    if (borrow) {
+      uint64_t carry = 0;
+      for (uint32_t j = 0; j < n_limbs; j++) {
+        uint64_t s = (uint64_t)ov[j] + order_limbs[j] + carry;
+        ov[j] = (uint32_t)s;
+        carry = s >> 32;
+      }
+    }
+  }
+}
+
+XN_EXPORT uint32_t xn_abi_version(void) { return 1; }

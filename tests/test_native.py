@@ -1,0 +1,88 @@
+"""Native C++ kernels vs the pure-python/numpy implementations."""
+
+import os
+import random
+import subprocess
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(native.load() is None, reason="native library unavailable")
+
+
+def test_native_chacha_blocks_match():
+    from xaynet_tpu.core.crypto.chacha import keystream_blocks
+
+    lib = native.load()
+    key = bytes(range(32))
+    out = np.empty(8 * 64, dtype=np.uint8)
+    lib.xn_chacha20_blocks(native.as_u8p(key), 3, 8, native.np_u8p(out))
+    assert bytes(out) == bytes(keystream_blocks(key, 3, 8))
+
+
+@pytest.mark.parametrize(
+    "order",
+    [20_000_000_000_001, 2**45, 2**88, 2**96, 255, (2**128 - 1) ** 2],
+)
+def test_native_sampler_matches_python(order):
+    """Native and numpy samplers must consume the identical keystream."""
+    from xaynet_tpu.core.crypto.chacha import ChaChaStream
+    from xaynet_tpu.core.crypto.prng import StreamSampler, generate_integer
+    from xaynet_tpu.ops import limbs as limb_ops
+
+    seed = b"\x13" * 32
+    oracle = ChaChaStream(seed)
+    expected = [generate_integer(oracle, order) for _ in range(100)]
+
+    sampler = StreamSampler(seed)  # native path (library is loaded)
+    got = limb_ops.limbs_to_ints(sampler.draw_limbs(100, order))
+    assert got == expected
+
+
+def test_native_python_interleave():
+    """Mixed native/python draws stay on the same keystream offset."""
+    from xaynet_tpu.core.crypto.chacha import ChaChaStream
+    from xaynet_tpu.core.crypto.prng import StreamSampler, generate_integer
+    from xaynet_tpu.ops import limbs as limb_ops
+
+    order_a, order_b = 20_000_000_000_021, 2**45
+    seed = b"\x31" * 32
+    oracle = ChaChaStream(seed)
+    exp_a = [generate_integer(oracle, order_a) for _ in range(7)]
+    exp_b = [generate_integer(oracle, order_b) for _ in range(7)]
+    exp_c = [generate_integer(oracle, order_a) for _ in range(7)]
+
+    sampler = StreamSampler(seed)
+    a = limb_ops.limbs_to_ints(sampler.draw_limbs(7, order_a))
+    # force the numpy path for the middle draw
+    os.environ["XAYNET_TPU_NO_NATIVE"] = "1"
+    try:
+        native._tried = False
+        native._lib = None
+        b = limb_ops.limbs_to_ints(sampler.draw_limbs(7, order_b))
+    finally:
+        del os.environ["XAYNET_TPU_NO_NATIVE"]
+        native._tried = False
+        native._lib = None
+    c = limb_ops.limbs_to_ints(sampler.draw_limbs(7, order_a))
+    assert (a, b, c) == (exp_a, exp_b, exp_c)
+
+
+@pytest.mark.parametrize("order", [20_000_000_000_001, 2**96, 2**64 - 59])
+def test_native_mod_ops_match(order):
+    from xaynet_tpu.ops import limbs as limb_ops
+
+    rng = random.Random(4)
+    n_limb = limb_ops.n_limbs_for_order(order)
+    ol = limb_ops.order_limbs_for(order)
+    a_i = [rng.randrange(order) for _ in range(200)]
+    b_i = [rng.randrange(order) for _ in range(200)]
+    a = limb_ops.ints_to_limbs(a_i, n_limb)
+    b = limb_ops.ints_to_limbs(b_i, n_limb)
+
+    got_add = limb_ops.limbs_to_ints(limb_ops.mod_add(a, b, ol))
+    assert got_add == [(x + y) % order for x, y in zip(a_i, b_i)]
+    got_sub = limb_ops.limbs_to_ints(limb_ops.mod_sub(a, b, ol))
+    assert got_sub == [(x - y) % order for x, y in zip(a_i, b_i)]

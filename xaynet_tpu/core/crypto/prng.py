@@ -70,11 +70,17 @@ class StreamSampler:
         """First ``count`` accepted draws below ``order`` as ``uint32[count, L]``.
 
         Consumes the same keystream prefix as ``count`` sequential
-        ``generate_integer`` calls.
+        ``generate_integer`` calls. Uses the native C++ sampler when the
+        library is available (bit-identical byte-stream semantics).
         """
         out_limbs = limb_ops.n_limbs_for_order(order)
         if count == 0:
             return np.zeros((0, out_limbs), dtype=np.uint32)
+        from ...utils import native
+
+        lib = native.load()
+        if lib is not None:
+            return self._draw_limbs_native(lib, count, order, out_limbs)
         # Draw width is the byte length of the *order itself* (the reference
         # sizes the buffer with `max_int.to_bytes_le()`), which exceeds the
         # element width when the order is a power of two at a byte boundary
@@ -109,6 +115,31 @@ class StreamSampler:
                 accepted.append(keep[:, :out_limbs])
                 got += keep.shape[0]
         return accepted[0] if len(accepted) == 1 else np.concatenate(accepted, axis=0)
+
+    def _draw_limbs_native(self, lib, count: int, order: int, out_limbs: int) -> np.ndarray:
+        from ...utils import native
+
+        bpn = (order.bit_length() + 7) // 8
+        order_le = order.to_bytes(bpn, "little")
+        out = np.empty(count * bpn, dtype=np.uint8)
+        new_offset = lib.xn_sample_uniform(
+            native.as_u8p(self._seed),
+            self.consumed_bytes,
+            count,
+            native.as_u8p(order_le),
+            bpn,
+            native.np_u8p(out),
+        )
+        # re-sync the numpy-side cursor so mixed native/numpy draws stay
+        # on the same keystream byte offset
+        self._block = new_offset // BLOCK_BYTES
+        self._leftover = np.zeros(0, dtype=np.uint8)
+        intra = new_offset % BLOCK_BYTES
+        if intra:
+            self._block += 1
+            blk = keystream_blocks(self._seed, self._block - 1, 1)
+            self._leftover = blk[intra:]
+        return limb_ops.bytes_le_to_limbs(out, count, bpn)[:, :out_limbs]
 
     def draw_int(self, order: int) -> int:
         return limb_ops.limbs_to_ints(self.draw_limbs(1, order))[0]

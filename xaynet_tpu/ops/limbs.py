@@ -147,8 +147,35 @@ def sub_limbs(a: np.ndarray, b: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return out, borrow.astype(_U32)
 
 
+def _native_binop(name: str, a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray):
+    """Run an elementwise modular op in the native library when possible."""
+    if a.ndim != 2 or a.shape != b.shape or a.shape[1] != order_limbs.shape[0]:
+        return None
+    from ..utils import native
+
+    lib = native.load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=_U32)
+    b = np.ascontiguousarray(b, dtype=_U32)
+    ol = np.ascontiguousarray(order_limbs, dtype=_U32)
+    out = np.empty_like(a)
+    getattr(lib, name)(
+        native.np_u32p(a),
+        native.np_u32p(b),
+        native.np_u32p(out),
+        a.shape[0],
+        a.shape[1],
+        native.np_u32p(ol),
+    )
+    return out
+
+
 def mod_add(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
     """``(a + b) mod order`` assuming ``a, b < order`` (branch-free)."""
+    fast = _native_binop("xn_mod_add", a, b, order_limbs)
+    if fast is not None:
+        return fast
     s, carry = add_limbs(a, b)
     # sum >= order  <=>  carry set (sum overflowed the limb width) or s >= order
     ge = carry.astype(bool) | ~lt_const(s, order_limbs)
@@ -158,6 +185,9 @@ def mod_add(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray
 
 def mod_sub(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
     """``(a - b) mod order`` assuming ``a, b < order``."""
+    fast = _native_binop("xn_mod_sub", a, b, order_limbs)
+    if fast is not None:
+        return fast
     d, borrow = sub_limbs(a, b)
     d2, _ = add_limbs(d, np.broadcast_to(order_limbs, d.shape))
     return np.where(borrow.astype(bool)[..., None], d2, d)

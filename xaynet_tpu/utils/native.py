@@ -1,0 +1,92 @@
+"""Loader for the native host kernels (ctypes, lazy on-demand build).
+
+``libxaynet_native.so`` is built from ``native/xaynet_native.cpp`` on first
+use (plain ``make``; no network). Everything has a pure-Python/numpy
+fallback — set ``XAYNET_TPU_NO_NATIVE=1`` to force it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+logger = logging.getLogger("xaynet.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception as e:  # toolchain missing — fall back to python
+        logger.debug("native build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("XAYNET_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
+        _build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        if lib.xn_abi_version() != 1:
+            logger.warning("native library ABI mismatch; using python fallback")
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.xn_chacha20_blocks.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p]
+        lib.xn_chacha20_blocks.restype = None
+        lib.xn_sample_uniform.argtypes = [
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint32,
+            u8p,
+        ]
+        lib.xn_sample_uniform.restype = ctypes.c_uint64
+        lib.xn_mod_add.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
+        lib.xn_mod_add.restype = None
+        lib.xn_mod_sub.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
+        lib.xn_mod_sub.restype = None
+        _lib = lib
+    except OSError as e:
+        logger.debug("native library load failed: %s", e)
+        _lib = None
+    return _lib
+
+
+def as_u8p(buf) -> "ctypes.pointer":
+    return ctypes.cast(ctypes.c_char_p(bytes(buf)), ctypes.POINTER(ctypes.c_uint8))
+
+
+def np_u8p(arr):
+    import numpy as np
+
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def np_u32p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
