@@ -1,0 +1,139 @@
+"""Federated learning converges: multi-round MLP training over the protocol."""
+
+import asyncio
+import threading
+import time
+from fractions import Fraction
+
+import jax
+import numpy as np
+
+from xaynet_tpu.models import mlp
+from xaynet_tpu.models.federated import FederatedTrainer, model_length
+from xaynet_tpu.sdk.api import spawn_participant
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+INPUT_DIM = 5
+N_SUM, N_UPDATE = 1, 3
+FEATURES = (8,)
+
+
+def _start(model_len):
+    settings = Settings(
+        pet=PetSettings(
+            sum=PhaseSettings(prob=0.3, count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
+            update=PhaseSettings(prob=0.6, count=CountSettings(N_UPDATE, N_UPDATE), time=TimeSettings(0, 30)),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
+        )
+    )
+    settings.model.length = model_len
+    info, started = {}, threading.Event()
+
+    def run():
+        async def main():
+            store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+            machine, tx, events = await StateMachineInitializer(settings, store).init()
+            rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
+            host, port = await rest.start("127.0.0.1", 0)
+            info["url"] = f"http://{host}:{port}"
+            started.set()
+            await machine.run()
+
+        asyncio.run(main())
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return info["url"]
+
+
+def test_federated_mlp_learns():
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=INPUT_DIM).astype(np.float32)
+
+    def make_data(seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(96, INPUT_DIM)).astype(np.float32)
+        y = (x @ w_true).astype(np.float32)
+        return x, y
+
+    template = mlp.init_params(jax.random.PRNGKey(0), INPUT_DIM, FEATURES)
+    model_len = model_length(template)
+    url = _start(model_len)
+    probe = HttpClient(url)
+
+    def sync(coro):
+        return asyncio.run(coro)
+
+    params = sync(probe.get_round_params())
+    seed = params.seed.as_bytes()
+
+    # shared across trainers so the train step compiles once
+    shared_step = mlp.make_train_step(FEATURES, learning_rate=5e-3)
+
+    def make_kwargs(i):
+        return dict(
+            init_params_fn=lambda: mlp.init_params(jax.random.PRNGKey(7), INPUT_DIM, FEATURES),
+            make_step=lambda: shared_step,
+            data=make_data(100 + i),
+            epochs=2,
+            batch_size=32,
+        )
+
+    # Task eligibility is re-drawn every round (fresh seed), so a simulation
+    # pins fresh role-matched participants per round — joining mid-federation
+    # is exactly what the protocol supports.
+    xs, ys = make_data(999)
+    losses = []
+    last_model = None
+    deadline = time.time() + 150
+    n_rounds = 3
+    for round_no in range(n_rounds):
+        threads, trainers = [], []
+        for i in range(N_SUM):
+            keys = keys_for_task(seed, 0.3, 0.6, "sum", start=i * 1000)
+            threads.append(
+                spawn_participant(url, FederatedTrainer, kwargs=make_kwargs(90), keys=keys)
+            )
+        for i in range(N_UPDATE):
+            keys = keys_for_task(seed, 0.3, 0.6, "update", start=(60 + i) * 1000)
+            t = spawn_participant(
+                url, FederatedTrainer, kwargs=make_kwargs(i), scalar=Fraction(1, N_UPDATE), keys=keys
+            )
+            threads.append(t)
+            trainers.append(t)
+
+        # wait for this round's model
+        while time.time() < deadline:
+            model = sync(probe.get_model())
+            if model is not None and (last_model is None or not np.array_equal(model, last_model)):
+                last_model = model
+                p = mlp.unflatten_params(template, np.asarray(model, np.float32))
+                pred = mlp.MLP(FEATURES).apply(p, xs).squeeze(-1)
+                losses.append(float(np.mean((np.asarray(pred) - ys) ** 2)))
+                break
+            time.sleep(0.1)
+        for t in threads:
+            t.stop()
+        # the next round's seed
+        seed = sync(probe.get_round_params()).seed.as_bytes()
+
+    assert len(losses) >= 2, f"only {len(losses)} rounds completed"
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,99 @@
+"""Model families: construction, jitted train steps, flatten round trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xaynet_tpu.models import mlp, lenet, lora, lstm, resnet
+from xaynet_tpu.models.mlp import flatten_params, unflatten_params
+
+
+def test_mlp_trains():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 13)).astype(np.float32)
+    w = rng.normal(size=13).astype(np.float32)
+    y = x @ w
+    params = mlp.init_params(jax.random.PRNGKey(0), 13)
+    model, tx, step = mlp.make_train_step()
+    opt_state = tx.init(params)
+    jit_step = jax.jit(step)
+    first = None
+    for i in range(60):
+        params, opt_state, loss = jit_step(params, opt_state, x, y)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5
+
+
+def test_flatten_roundtrip():
+    params = mlp.init_params(jax.random.PRNGKey(1), 13)
+    flat = flatten_params(params)
+    back = unflatten_params(params, flat)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b), rtol=1e-6)
+
+
+def test_lenet_step():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=8)
+    params = lenet.init_params(jax.random.PRNGKey(0))
+    _, tx, step = lenet.make_train_step()
+    opt_state = tx.init(params)
+    p2, _, loss = step(params, opt_state, x, y)
+    assert np.isfinite(float(loss))
+    assert flatten_params(p2).shape == flatten_params(params).shape
+
+
+def test_lstm_step():
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 80, size=(4, 20)).astype(np.int32)
+    targets = rng.integers(0, 80, size=(4, 20)).astype(np.int32)
+    params = lstm.init_params(jax.random.PRNGKey(0), seq_len=20, hidden=32)
+    _, tx, step = lstm.make_train_step(hidden=32)
+    opt_state = tx.init(params)
+    _, _, loss = step(params, opt_state, tokens, targets)
+    assert np.isfinite(float(loss))
+
+
+def test_resnet50_param_count():
+    """The stress model must be in the ~25M-parameter class."""
+    params = resnet.init_params(jax.random.PRNGKey(0), image_shape=(32, 32, 3), num_classes=1000)
+    n = resnet.param_count(params)
+    assert 20_000_000 < n < 30_000_000, n
+
+
+def test_lora_quantize_roundtrip():
+    spec = lora.LoraSpec(targets={"q": (64, 64), "v": (64, 64)}, rank=4)
+    adapters = lora.init_adapters(jax.random.PRNGKey(0), spec)
+    q = lora.quantize_deltas(adapters, scale=10**6)
+    back = lora.dequantize_deltas(q, adapters, scale=10**6)
+    for a, b in zip(jax.tree_util.tree_leaves(adapters), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_lora_masking_i32():
+    """Quantized LoRA deltas federate through the I32 masking pipeline."""
+    from xaynet_tpu.core.mask import (
+        Aggregation,
+        BoundType,
+        DataType,
+        GroupType,
+        Masker,
+        MaskConfig,
+        Model,
+        ModelType,
+        Scalar,
+    )
+
+    spec = lora.LoraSpec(targets={"q": (8, 8)}, rank=2)
+    adapters = lora.init_adapters(jax.random.PRNGKey(1), spec)
+    q = lora.quantize_deltas(adapters, scale=10**4)
+    config = MaskConfig(GroupType.PRIME, DataType.I32, BoundType.B6, ModelType.M3)
+    model = Model.from_primitives([int(v) for v in q], DataType.I32)
+    seed, masked = Masker(config.pair()).mask(Scalar.unit(), model)
+    mask = seed.derive_mask(len(model), config.pair())
+    unmasked = Aggregation.from_object(masked).unmask(mask)
+    got = np.asarray(unmasked.into_primitives(DataType.I32))
+    np.testing.assert_array_equal(got, q)

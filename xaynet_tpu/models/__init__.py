@@ -1,0 +1,17 @@
+"""Baseline model families with JAX local training.
+
+Covers the benchmark configurations (BASELINE.md):
+
+1. house-prices regression MLP (``mlp``) — 10 participants;
+2. LeNet / CIFAR-10 (``lenet``) — 100 simulated participants;
+3. character LSTM next-token (``lstm``) — LEAF-Shakespeare shaped;
+4. ResNet-50 (``resnet``) — the 25M-parameter aggregation stress model;
+5. LoRA adapters (``lora``) — federated low-rank deltas (stretch config).
+
+Every family exposes ``init_params`` and a jittable train step; the
+``federated`` module glues any of them into a PET participant.
+"""
+
+from .mlp import MLP, flatten_params, unflatten_params
+
+__all__ = ["MLP", "flatten_params", "unflatten_params"]

@@ -1,0 +1,62 @@
+"""Glue between JAX model families and the PET participant API.
+
+``FederatedTrainer`` wraps (init_params, train_step, local data) into a
+``ParticipantABC``: each round it deserializes the global model into
+parameters, runs E local epochs (jitted), and returns the flattened weight
+vector for masking — the analogue of the reference's keras participant
+(reference: bindings/python/examples/keras_house_prices/).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..sdk.api import ParticipantABC
+from .mlp import flatten_params, unflatten_params
+
+
+class FederatedTrainer(ParticipantABC):
+    """Local trainer for any (params, step) JAX model."""
+
+    def __init__(
+        self,
+        init_params_fn: Callable[[], object],
+        make_step: Callable[[], tuple],
+        data: tuple[np.ndarray, np.ndarray],
+        epochs: int = 1,
+        batch_size: int = 32,
+        seed: int = 0,
+    ):
+        self.params = init_params_fn()
+        _, self.tx, self.step = make_step()
+        self.opt_state = self.tx.init(self.params)
+        self.x, self.y = data
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        self.last_loss: Optional[float] = None
+
+    def train_round(self, training_input):
+        if training_input is not None:
+            self.params = unflatten_params(self.params, np.asarray(training_input, np.float32))
+            self.opt_state = self.tx.init(self.params)
+        n = self.x.shape[0]
+        for _ in range(self.epochs):
+            order = self.rng.permutation(n)
+            for start in range(0, n - self.batch_size + 1, self.batch_size):
+                idx = order[start : start + self.batch_size]
+                self.params, self.opt_state, loss = self.step(
+                    self.params, self.opt_state, self.x[idx], self.y[idx]
+                )
+            self.last_loss = float(loss)
+        return flatten_params(self.params)
+
+    def deserialize_training_input(self, global_model):
+        return np.asarray(global_model, dtype=np.float32)
+
+
+def model_length(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

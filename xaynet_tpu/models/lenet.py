@@ -1,0 +1,53 @@
+"""LeNet-style CNN — baseline config #2 (CIFAR-10, 100 participants).
+
+Convolutions run on the MXU; the local step is fully jittable and the
+parameter vector plugs straight into the masking pipeline via
+``flatten_params``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LeNet(nn.Module):
+    """Classic conv-conv-dense classifier (CIFAR-10 shapes)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, 32, 32, 3]
+        x = nn.relu(nn.Conv(6, (5, 5))(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5))(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def init_params(rng, image_shape=(32, 32, 3), num_classes: int = 10):
+    model = LeNet(num_classes)
+    return model.init(rng, jnp.zeros((1, *image_shape)))
+
+
+def make_train_step(num_classes: int = 10, learning_rate: float = 1e-3):
+    """(model, tx, jittable step): cross-entropy SGD on one batch."""
+    model = LeNet(num_classes)
+    tx = optax.sgd(learning_rate, momentum=0.9)
+
+    def loss_fn(params, x, y):
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return model, tx, step

@@ -1,0 +1,52 @@
+"""Character-level LSTM — baseline config #3 (LEAF-Shakespeare shaped).
+
+Next-character prediction over an 80-symbol vocabulary (the LEAF benchmark
+shape): embedding -> 2-layer LSTM (via ``flax.linen.scan`` — compiler-
+friendly sequence recurrence, no python loops under jit) -> projection.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+VOCAB_SIZE = 80  # LEAF Shakespeare symbol count
+
+
+class CharLSTM(nn.Module):
+    vocab_size: int = VOCAB_SIZE
+    hidden: int = 256
+    embed: int = 8
+
+    @nn.compact
+    def __call__(self, tokens):  # [B, T] int32
+        x = nn.Embed(self.vocab_size, self.embed)(tokens)  # [B, T, E]
+        for layer in range(2):
+            cell = nn.OptimizedLSTMCell(self.hidden, name=f"lstm{layer}")
+            scan = nn.RNN(cell)  # internally a lax.scan over T
+            x = scan(x)
+        return nn.Dense(self.vocab_size)(x)  # [B, T, V]
+
+
+def init_params(rng, seq_len: int = 80, vocab_size: int = VOCAB_SIZE, hidden: int = 256):
+    model = CharLSTM(vocab_size, hidden)
+    return model.init(rng, jnp.zeros((1, seq_len), dtype=jnp.int32))
+
+
+def make_train_step(vocab_size: int = VOCAB_SIZE, hidden: int = 256, learning_rate: float = 1e-3):
+    model = CharLSTM(vocab_size, hidden)
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, tokens, targets):
+        logits = model.apply(params, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return model, tx, step

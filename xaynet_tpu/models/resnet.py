@@ -1,0 +1,83 @@
+"""ResNet-50 — baseline config #4 (the ~25M-parameter aggregation stress).
+
+Standard bottleneck ResNet in flax; used to produce realistically-sized
+update vectors for the aggregation benchmarks and for federated vision
+training. bfloat16 activations keep the MXU fed; parameters stay f32 for
+the masking pipeline.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.GroupNorm, num_groups=32, dtype=self.dtype)
+
+        residual = x
+        y = nn.relu(norm()(conv(self.features, (1, 1))(x)))
+        y = nn.relu(norm()(conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)))
+        y = norm()(conv(self.features * 4, (1, 1))(y))
+        if residual.shape != y.shape:
+            residual = norm()(
+                conv(self.features * 4, (1, 1), strides=(self.strides, self.strides))(residual)
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):  # [B, H, W, 3]
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = conv(64, (7, 7), strides=(2, 2))(x)
+        x = nn.relu(nn.GroupNorm(num_groups=32, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(64 * 2**i, strides=strides, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def init_params(rng, image_shape=(64, 64, 3), num_classes: int = 1000):
+    model = ResNet50(num_classes)
+    return model.init(rng, jnp.zeros((1, *image_shape)))
+
+
+def make_train_step(num_classes: int = 1000, learning_rate: float = 0.1):
+    model = ResNet50(num_classes)
+    tx = optax.sgd(learning_rate, momentum=0.9)
+
+    def loss_fn(params, x, y):
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return model, tx, step
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
