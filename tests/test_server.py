@@ -1,0 +1,228 @@
+"""Coordinator phase tests: event snapshots, timeout, failure, restore.
+
+Mirrors the reference's phase-test strategy (SURVEY §4.3): drive transitions
+one at a time, assert which events changed, exercise the failure/timeout
+paths and the checkpoint/restore cycle.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto.encrypt import PublicEncryptKey
+from xaynet_tpu.core.message import Message, Sum
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler, ServiceError
+from xaynet_tpu.server.requests import RequestError
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings,
+    Settings,
+    SettingsError,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+
+def _settings(sum_max_time=0.3):
+    s = Settings(
+        pet=PetSettings(
+            sum=PhaseSettings(prob=0.5, count=CountSettings(1, 2), time=TimeSettings(0, sum_max_time)),
+            update=PhaseSettings(prob=0.4, count=CountSettings(3, 5), time=TimeSettings(0, 0.3)),
+            sum2=Sum2Settings(count=CountSettings(1, 2), time=TimeSettings(0, 0.3)),
+        )
+    )
+    s.model.length = 4
+    return s
+
+
+def _store():
+    return Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+
+
+def test_idle_phase_bootstraps_round():
+    async def run():
+        store = _store()
+        machine, _, events = await StateMachineInitializer(_settings(), store).init()
+        params_before = events.params.get_latest()
+        assert params_before.round_id == 0
+
+        assert await machine.next()  # runs Idle -> Sum
+        assert machine.phase.NAME.value == "sum"
+
+        params = events.params.get_latest()
+        assert params.round_id == 1
+        assert params.event.seed.as_bytes() != params_before.event.seed.as_bytes()
+        keys = events.keys.get_latest()
+        assert keys.round_id == 1
+        assert keys.event.public.as_bytes() == params.event.pk
+        # state persisted
+        assert await store.coordinator.coordinator_state() is not None
+
+    asyncio.run(run())
+
+
+def test_sum_timeout_routes_to_failure_then_idle():
+    async def run():
+        store = _store()
+        machine, _, events = await StateMachineInitializer(_settings(0.2), store).init()
+        assert await machine.next()  # Idle -> Sum
+        assert await machine.next()  # Sum times out -> Failure
+        assert machine.phase.NAME.value == "failure"
+        assert await machine.next()  # Failure -> Idle (round restart)
+        assert machine.phase.NAME.value == "idle"
+        assert await machine.next()  # Idle -> Sum of round 2
+        assert events.params.get_latest().round_id == 2
+
+    asyncio.run(run())
+
+
+def test_phase_filter_drops_wrong_tag():
+    async def run():
+        store = _store()
+        machine, tx, events = await StateMachineInitializer(_settings(5.0), store).init()
+        handler = PetMessageHandler(events, tx)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while events.phase.get_latest().event.value != "sum":
+                await asyncio.sleep(0.01)
+            params = events.params.get_latest().event
+            # craft an *update*-task participant but send a Sum message —
+            # phase filter passes (tag matches) but eligibility fails
+            keys = keys_for_task(params.seed.as_bytes(), params.sum, params.update, "update")
+            payload = Sum(
+                sum_signature=keys.sign(params.seed.as_bytes() + b"sum").as_bytes(),
+                ephm_pk=b"\x01" * 32,
+            )
+            msg = Message(participant_pk=keys.public, coordinator_pk=params.pk, payload=payload)
+            encrypted = PublicEncryptKey(params.pk).encrypt(msg.to_bytes(keys.secret))
+            with pytest.raises(ServiceError):
+                await handler.handle_message(encrypted)
+            # garbage bytes are dropped at the decrypt stage
+            with pytest.raises(ServiceError):
+                await handler.handle_message(b"\x00" * 200)
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(run())
+
+
+def test_duplicate_sum_rejected():
+    async def run():
+        store = _store()
+        settings = _settings(5.0)
+        settings.pet.sum.count = CountSettings(2, 2)  # keep the phase open
+        machine, tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, tx)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while events.phase.get_latest().event.value != "sum":
+                await asyncio.sleep(0.01)
+            params = events.params.get_latest().event
+            keys = keys_for_task(params.seed.as_bytes(), params.sum, params.update, "sum")
+            payload = Sum(
+                sum_signature=keys.sign(params.seed.as_bytes() + b"sum").as_bytes(),
+                ephm_pk=b"\x02" * 32,
+            )
+            msg = Message(participant_pk=keys.public, coordinator_pk=params.pk, payload=payload)
+            wire = msg.to_bytes(keys.secret)
+            await handler.handle_message(PublicEncryptKey(params.pk).encrypt(wire))
+            with pytest.raises(RequestError):
+                await handler.handle_message(PublicEncryptKey(params.pk).encrypt(wire))
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(run())
+
+
+def test_checkpoint_restore_resumes_round_and_model():
+    async def run():
+        store = _store()
+        settings = _settings()
+        machine, _, events = await StateMachineInitializer(settings, store).init()
+        assert await machine.next()  # Idle -> Sum: persists state at round 1
+
+        # simulate a completed round having stored a global model
+        model = np.arange(4, dtype=np.float64)
+        seed = events.params.get_latest().event.seed.as_bytes()
+        model_id = await store.models.set_global_model(1, seed, model.tobytes())
+        await store.coordinator.set_latest_global_model_id(model_id)
+
+        # "crash" and restore
+        settings2 = _settings()
+        settings2.restore.enable = True
+        machine2, _, events2 = await StateMachineInitializer(settings2, store).init()
+        assert events2.params.get_latest().round_id == 1
+        restored = events2.model.get_latest().event.model
+        assert restored is not None
+        np.testing.assert_array_equal(np.asarray(restored), model)
+
+        # restart continues with round 2
+        assert await machine2.next()
+        assert events2.params.get_latest().round_id == 2
+
+    asyncio.run(run())
+
+
+def test_restore_fails_on_dangling_model_id():
+    async def run():
+        from xaynet_tpu.server.state_machine import RestoreError
+
+        store = _store()
+        machine, _, _ = await StateMachineInitializer(_settings(), store).init()
+        assert await machine.next()
+        await store.coordinator.set_latest_global_model_id("1_deadbeef")
+
+        settings2 = _settings()
+        settings2.restore.enable = True
+        with pytest.raises(RestoreError):
+            await StateMachineInitializer(settings2, store).init()
+
+    asyncio.run(run())
+
+
+def test_settings_validation_and_env_overrides(tmp_path, monkeypatch):
+    cfg = tmp_path / "config.toml"
+    cfg.write_text(
+        """
+[pet.sum]
+prob = 0.02
+[pet.sum.count]
+min = 5
+max = 10
+[model]
+length = 42
+[mask]
+group_type = "integer"
+bound_type = "b2"
+"""
+    )
+    monkeypatch.setenv("XAYNET__MODEL__LENGTH", "99")
+    monkeypatch.setenv("XAYNET__PET__SUM__PROB", "0.5")
+    s = Settings.load(str(cfg))
+    assert s.model.length == 99
+    assert s.pet.sum.prob == 0.5
+    assert s.pet.sum.count.min == 5
+    assert s.mask.to_config().group_type.name == "INTEGER"
+
+    bad = _settings()
+    bad.pet.update.count = CountSettings(min=2, max=10)  # below protocol floor (3)
+    with pytest.raises(SettingsError):
+        bad.validate()

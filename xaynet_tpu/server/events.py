@@ -10,6 +10,7 @@ asyncio's single-loop execution (no locks needed).
 from __future__ import annotations
 
 import asyncio
+import copy
 from dataclasses import dataclass
 from enum import Enum
 from typing import Generic, Optional, TypeVar
@@ -104,7 +105,9 @@ class EventPublisher:
     ):
         self._round_id = round_id
         self.keys = _Watch(Event(round_id, keys))
-        self.params = _Watch(Event(round_id, params))
+        # round_params is mutated in place by the Idle phase; events must
+        # carry snapshots so subscribers can detect changes
+        self.params = _Watch(Event(round_id, copy.copy(params)))
         self.phase = _Watch(Event(round_id, phase))
         self.model = _Watch(Event(round_id, model or ModelUpdate.invalidate()))
         self.sum_dict = _Watch(Event(round_id, DictionaryUpdate.invalidate()))
@@ -121,7 +124,7 @@ class EventPublisher:
         self.keys.publish(Event(self._round_id, keys))
 
     def broadcast_params(self, params: RoundParameters) -> None:
-        self.params.publish(Event(self._round_id, params))
+        self.params.publish(Event(self._round_id, copy.copy(params)))
 
     def broadcast_phase(self, phase: PhaseName) -> None:
         self.phase.publish(Event(self._round_id, phase))
