@@ -1,0 +1,29 @@
+"""Fetch the latest global model from a running coordinator.
+
+Analogue of the reference's download_global_model.py example.
+
+Run:  python examples/download_global_model.py http://localhost:8081
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from xaynet_tpu.sdk.client import HttpClient
+
+
+async def main(url: str):
+    client = HttpClient(url)
+    model = await client.get_model()
+    if model is None:
+        print("no global model available yet (204)")
+        return
+    print(f"global model: {model.shape[0]} parameters, "
+          f"norm {float((model ** 2).sum()) ** 0.5:.4f}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main(sys.argv[1] if len(sys.argv) > 1 else "http://localhost:8081"))

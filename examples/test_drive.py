@@ -1,0 +1,134 @@
+"""Test drive: N in-process participants against a coordinator.
+
+Analogue of the reference's test-drive example
+(rust/examples/test-drive/main.rs): spawns a coordinator and N participants
+uploading a dummy model of length ``-l``, then runs rounds until
+interrupted, printing round progress.
+
+Run:  python examples/test_drive.py -n 20 -l 1000 -r 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import threading
+import time
+from fractions import Fraction
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from xaynet_tpu.sdk.api import ParticipantABC, spawn_participant
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+
+class DummyTrainer(ParticipantABC):
+    def __init__(self, length: int):
+        self.length = length
+
+    def train_round(self, training_input):
+        return np.zeros(self.length, dtype=np.float32)
+
+
+def start_coordinator(model_len, n_sum, n_update):
+    settings = Settings(
+        pet=PetSettings(
+            sum=PhaseSettings(prob=0.3, count=CountSettings(n_sum, n_sum), time=TimeSettings(0, 60)),
+            update=PhaseSettings(prob=0.6, count=CountSettings(n_update, n_update), time=TimeSettings(0, 60)),
+            sum2=Sum2Settings(count=CountSettings(n_sum, n_sum), time=TimeSettings(0, 60)),
+        )
+    )
+    settings.model.length = model_len
+    info, started = {}, threading.Event()
+
+    def run():
+        async def main():
+            store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+            machine, tx, events = await StateMachineInitializer(settings, store).init()
+            rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
+            host, port = await rest.start("127.0.0.1", 0)
+            info["url"] = f"http://{host}:{port}"
+            started.set()
+            await machine.run()
+
+        asyncio.run(main())
+
+    threading.Thread(target=run, daemon=True).start()
+    started.wait(10)
+    return info["url"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=20, help="participants per round")
+    ap.add_argument("-l", type=int, default=1000, help="model length")
+    ap.add_argument("-r", type=int, default=3, help="rounds")
+    args = ap.parse_args()
+
+    n_sum = max(1, args.n // 10)
+    n_update = max(3, args.n - n_sum)
+    url = start_coordinator(args.l, n_sum, n_update)
+    probe = HttpClient(url)
+    print(f"coordinator at {url}: {n_sum} sum + {n_update} update participants/round")
+
+    def sync(coro):
+        return asyncio.run(coro)
+
+    last_seed = None
+    threads = []  # participants stay alive across rounds (roles re-draw)
+    for round_no in range(1, args.r + 1):
+        t0 = time.time()
+        params = sync(probe.get_round_params())
+        while last_seed is not None and params.seed.as_bytes() == last_seed:
+            time.sleep(0.1)
+            params = sync(probe.get_round_params())
+        seed = params.seed.as_bytes()
+
+        for i in range(n_sum):
+            keys = keys_for_task(seed, 0.3, 0.6, "sum", start=i * 1000)
+            threads.append(spawn_participant(url, DummyTrainer, args=(args.l,), keys=keys))
+        for i in range(n_update):
+            keys = keys_for_task(seed, 0.3, 0.6, "update", start=(1000 + i) * 1000)
+            threads.append(
+                spawn_participant(
+                    url, DummyTrainer, args=(args.l,), scalar=Fraction(1, n_update), keys=keys
+                )
+            )
+
+        while True:
+            model = sync(probe.get_model())
+            fresh = sync(probe.get_round_params())
+            if model is not None and fresh.seed.as_bytes() != seed:
+                break
+            time.sleep(0.1)
+        last_seed = seed  # the completed round; the next loop uses the new seed
+        print(f"round {round_no}: completed in {time.time() - t0:.1f}s "
+              f"(model norm {float(np.linalg.norm(model)):.3f})")
+
+    for t in threads:
+        t.stop()
+
+
+if __name__ == "__main__":
+    main()
