@@ -1,0 +1,249 @@
+"""Redis backend against an in-process fake RESP server.
+
+No Redis binary ships in the image, so a miniature RESP2 server implements
+the command subset the backend uses (the three Lua scripts are recognized
+by content and executed as equivalent python). This exercises the real
+protocol encoding, the data model and the conditional-insert semantics.
+"""
+
+import asyncio
+
+import pytest
+
+from xaynet_tpu.core.crypto.prng import uniform_ints
+from xaynet_tpu.core.mask import BoundType, DataType, GroupType, MaskConfig, MaskObject, ModelType
+from xaynet_tpu.storage.redis import (
+    ADD_LOCAL_SEED_DICT,
+    ADD_SUM_PARTICIPANT,
+    INCR_MASK_SCORE,
+    RedisCoordinatorStorage,
+)
+from xaynet_tpu.storage.traits import LocalSeedDictAddError, MaskScoreIncrError, SumPartAddError
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+
+
+class FakeRedis:
+    """Tiny RESP2 server over asyncio streams (test double)."""
+
+    def __init__(self):
+        self.strings: dict[bytes, bytes] = {}
+        self.hashes: dict[bytes, dict[bytes, bytes]] = {}
+        self.sets: dict[bytes, set] = {}
+        self.zsets: dict[bytes, dict[bytes, float]] = {}
+        self._server = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                assert line[:1] == b"*"
+                n = int(line[1:-2])
+                parts = []
+                for _ in range(n):
+                    ln = await reader.readline()
+                    assert ln[:1] == b"$"
+                    size = int(ln[1:-2])
+                    data = await reader.readexactly(size + 2)
+                    parts.append(data[:-2])
+                writer.write(self._dispatch(parts))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    # --- encoding helpers -------------------------------------------------
+
+    @staticmethod
+    def _int(v):
+        return b":%d\r\n" % v
+
+    @staticmethod
+    def _bulk(v):
+        if v is None:
+            return b"$-1\r\n"
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+
+    @classmethod
+    def _array(cls, items):
+        return b"*%d\r\n" % len(items) + b"".join(cls._bulk(i) for i in items)
+
+    # --- command dispatch -------------------------------------------------
+
+    def _dispatch(self, parts):
+        cmd = parts[0].upper()
+        if cmd == b"PING":
+            return b"+PONG\r\n"
+        if cmd == b"SET":
+            self.strings[parts[1]] = parts[2]
+            return b"+OK\r\n"
+        if cmd == b"GET":
+            return self._bulk(self.strings.get(parts[1]))
+        if cmd == b"HGETALL":
+            h = self.hashes.get(parts[1], {})
+            flat = []
+            for k, v in h.items():
+                flat += [k, v]
+            return self._array(flat)
+        if cmd == b"HKEYS":
+            return self._array(list(self.hashes.get(parts[1], {})))
+        if cmd == b"DEL":
+            n = 0
+            for key in parts[1:]:
+                n += int(
+                    self.strings.pop(key, None) is not None
+                    or self.hashes.pop(key, None) is not None
+                    or self.sets.pop(key, None) is not None
+                    or self.zsets.pop(key, None) is not None
+                )
+            return self._int(n)
+        if cmd == b"FLUSHDB":
+            self.strings.clear()
+            self.hashes.clear()
+            self.sets.clear()
+            self.zsets.clear()
+            return b"+OK\r\n"
+        if cmd == b"ZCARD":
+            return self._int(len(self.zsets.get(parts[1], {})))
+        if cmd == b"ZREVRANGE":
+            z = self.zsets.get(parts[1], {})
+            ranked = sorted(z.items(), key=lambda kv: kv[1], reverse=True)
+            lo, hi = int(parts[2]), int(parts[3])
+            flat = []
+            for member, score in ranked[lo : hi + 1]:
+                flat += [member, str(int(score)).encode()]
+            return self._array(flat)
+        if cmd == b"EVAL":
+            return self._eval(parts[1], parts)
+        raise AssertionError(f"unsupported command {cmd!r}")
+
+    def _eval(self, script, parts):
+        nkeys = int(parts[2])
+        keys = parts[3 : 3 + nkeys]
+        argv = parts[3 + nkeys :]
+        if script == ADD_SUM_PARTICIPANT:
+            h = self.hashes.setdefault(keys[0], {})
+            if argv[0] in h:
+                return self._int(0)
+            h[argv[0]] = argv[1]
+            return self._int(1)
+        if script == ADD_LOCAL_SEED_DICT:
+            sum_dict = self.hashes.get(keys[0], {})
+            update_set = self.sets.setdefault(keys[1], set())
+            update_pk = argv[0]
+            entries = [(argv[i], argv[i + 1]) for i in range(1, len(argv), 2)]
+            if len(entries) != len(sum_dict):
+                return self._int(-1)
+            if any(pk not in sum_dict for pk, _ in entries):
+                return self._int(-2)
+            if update_pk in update_set:
+                return self._int(-3)
+            for pk, _ in entries:
+                if update_pk in self.hashes.get(b"seed_dict:" + pk, {}):
+                    return self._int(-4)
+            for pk, seed in entries:
+                self.hashes.setdefault(b"seed_dict:" + pk, {})[update_pk] = seed
+            update_set.add(update_pk)
+            return self._int(0)
+        if script == INCR_MASK_SCORE:
+            sum_dict = self.hashes.get(keys[0], {})
+            submitted = self.sets.setdefault(keys[1], set())
+            z = self.zsets.setdefault(keys[2], {})
+            if argv[0] not in sum_dict:
+                return self._int(-1)
+            if argv[0] in submitted:
+                return self._int(-2)
+            submitted.add(argv[0])
+            z[argv[1]] = z.get(argv[1], 0) + 1
+            return self._int(0)
+        raise AssertionError("unknown script")
+
+
+def _mask(seed=1, n=4) -> MaskObject:
+    ints = uniform_ints(bytes([seed]) * 32, n + 1, CFG.order)
+    return MaskObject.new(CFG.pair(), ints[1:], ints[0])
+
+
+def test_redis_backend_full_cycle():
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        store = RedisCoordinatorStorage(port=port)
+        try:
+            await store.is_ready()
+
+            # coordinator state
+            await store.set_coordinator_state(b"state-1")
+            assert await store.coordinator_state() == b"state-1"
+
+            # sum dict with duplicate rejection
+            assert await store.add_sum_participant(b"s1" * 16, b"e1" * 16) is None
+            assert await store.add_sum_participant(b"s2" * 16, b"e2" * 16) is None
+            assert (
+                await store.add_sum_participant(b"s1" * 16, b"e3" * 16)
+                is SumPartAddError.ALREADY_EXISTS
+            )
+            sums = await store.sum_dict()
+            assert set(sums) == {b"s1" * 16, b"s2" * 16}
+
+            # seed dicts: length mismatch, unknown pk, dedup, success
+            seed80 = b"\x07" * 80
+            assert (
+                await store.add_local_seed_dict(b"u1" * 16, {b"s1" * 16: seed80})
+                is LocalSeedDictAddError.LENGTH_MISMATCH
+            )
+            assert (
+                await store.add_local_seed_dict(
+                    b"u1" * 16, {b"s1" * 16: seed80, b"zz" * 16: seed80}
+                )
+                is LocalSeedDictAddError.UNKNOWN_SUM_PARTICIPANT
+            )
+            full = {b"s1" * 16: seed80, b"s2" * 16: seed80}
+            assert await store.add_local_seed_dict(b"u1" * 16, full) is None
+            assert (
+                await store.add_local_seed_dict(b"u1" * 16, full)
+                is LocalSeedDictAddError.UPDATE_PK_ALREADY_SUBMITTED
+            )
+            seeds = await store.seed_dict()
+            assert set(seeds) == {b"s1" * 16, b"s2" * 16}
+            assert seeds[b"s1" * 16][b"u1" * 16].as_bytes() == seed80
+
+            # mask scores: membership, single submission, best-mask ranking
+            m1, m2 = _mask(1), _mask(2)
+            assert (
+                await store.incr_mask_score(b"??" * 16, m1) is MaskScoreIncrError.UNKNOWN_SUM_PK
+            )
+            assert await store.incr_mask_score(b"s1" * 16, m1) is None
+            assert (
+                await store.incr_mask_score(b"s1" * 16, m1)
+                is MaskScoreIncrError.MASK_ALREADY_SUBMITTED
+            )
+            assert await store.incr_mask_score(b"s2" * 16, m1) is None
+            assert await store.number_of_unique_masks() == 1
+            best = await store.best_masks()
+            assert len(best) == 1 and best[0][1] == 2 and best[0][0] == m1
+
+            # latest model pointer + dict deletion keeps state
+            await store.set_latest_global_model_id("7_cafe")
+            assert await store.latest_global_model_id() == "7_cafe"
+            await store.delete_dicts()
+            assert await store.sum_dict() is None
+            assert await store.coordinator_state() == b"state-1"
+            await store.delete_coordinator_data()
+            assert await store.coordinator_state() is None
+        finally:
+            await store.client.close()
+            await fake.stop()
+
+    asyncio.run(run())

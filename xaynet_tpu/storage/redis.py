@@ -1,0 +1,274 @@
+"""Redis coordinator-storage backend (RESP client from scratch).
+
+Functional port of the reference's Redis backend (reference:
+rust/xaynet-server/src/storage/coordinator_storage/redis/mod.rs): the same
+data model (sum_dict hash, per-sum-pk seed hashes, update_participants set,
+mask_submitted set, mask_dict sorted set keyed by the serialized mask) and
+the same *atomic Lua scripts* for the conditional inserts
+(redis/mod.rs:208-267 for seed dicts, :303-343 for mask scores).
+
+No third-party client: a minimal RESP2 protocol implementation over asyncio
+streams (`RespClient`). Use this backend when running several coordinator
+replicas or when round state must survive a coordinator crash with an
+external store; the in-process backend provides the same semantics for
+single-process deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..core.mask.object import MaskObject
+from ..core.mask.seed import EncryptedMaskSeed
+from ..core.mask.serialization import parse_mask_object, serialize_mask_object
+from .traits import (
+    CoordinatorStorage,
+    LocalSeedDictAddError,
+    MaskScoreIncrError,
+    StorageError,
+    SumPartAddError,
+)
+
+# --- RESP2 client ----------------------------------------------------------
+
+
+class RespClient:
+    """Minimal Redis protocol client (RESP2) over asyncio streams."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0):
+        self.host, self.port, self.db = host, port, db
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        if self.db:
+            await self.command(b"SELECT", str(self.db).encode())
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    async def command(self, *parts: bytes):
+        """Sends one command and decodes one reply (auto-reconnect once)."""
+        async with self._lock:
+            if self._writer is None:
+                await self._connect_locked()
+            try:
+                return await self._roundtrip(parts)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                await self._connect_locked()
+                return await self._roundtrip(parts)
+
+    async def _connect_locked(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        if self.db:
+            await self._roundtrip((b"SELECT", str(self.db).encode()))
+
+    async def _roundtrip(self, parts: tuple[bytes, ...]):
+        assert self._writer is not None and self._reader is not None
+        out = [b"*%d\r\n" % len(parts)]
+        for p in parts:
+            out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+        self._writer.write(b"".join(out))
+        await self._writer.drain()
+        return await self._read_reply()
+
+    async def _read_reply(self):
+        assert self._reader is not None
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("redis connection closed")
+        kind, rest = line[:1], line[1:-2]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise StorageError(f"redis error: {rest.decode()}")
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await self._reader.readexactly(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [await self._read_reply() for _ in range(n)]
+        raise StorageError(f"unexpected RESP reply type {kind!r}")
+
+
+# --- Lua scripts (same validation logic as the reference's) ----------------
+
+# KEYS[1]=sum_dict, ARGV[1]=pk, ARGV[2]=ephm_pk
+ADD_SUM_PARTICIPANT = b"""
+if redis.call("HSETNX", KEYS[1], ARGV[1], ARGV[2]) == 1 then
+  return 1
+end
+return 0
+"""
+
+# KEYS[1]=sum_dict, KEYS[2]=update_participants,
+# ARGV[1]=update_pk, ARGV[2..]=alternating sum_pk, seed
+ADD_LOCAL_SEED_DICT = b"""
+local n_entries = (#ARGV - 1) / 2
+if n_entries ~= redis.call("HLEN", KEYS[1]) then
+  return -1
+end
+for i = 2, #ARGV, 2 do
+  if redis.call("HEXISTS", KEYS[1], ARGV[i]) == 0 then
+    return -2
+  end
+end
+if redis.call("SISMEMBER", KEYS[2], ARGV[1]) == 1 then
+  return -3
+end
+for i = 2, #ARGV, 2 do
+  if redis.call("HEXISTS", "seed_dict:" .. ARGV[i], ARGV[1]) == 1 then
+    return -4
+  end
+end
+for i = 2, #ARGV, 2 do
+  redis.call("HSET", "seed_dict:" .. ARGV[i], ARGV[1], ARGV[i + 1])
+end
+redis.call("SADD", KEYS[2], ARGV[1])
+return 0
+"""
+
+# KEYS[1]=sum_dict, KEYS[2]=mask_submitted, KEYS[3]=mask_dict,
+# ARGV[1]=pk, ARGV[2]=serialized mask
+INCR_MASK_SCORE = b"""
+if redis.call("HEXISTS", KEYS[1], ARGV[1]) == 0 then
+  return -1
+end
+if redis.call("SISMEMBER", KEYS[2], ARGV[1]) == 1 then
+  return -2
+end
+redis.call("SADD", KEYS[2], ARGV[1])
+redis.call("ZINCRBY", KEYS[3], 1, ARGV[2])
+return 0
+"""
+
+_K_STATE = b"coordinator_state"
+_K_SUM_DICT = b"sum_dict"
+_K_UPDATE_SET = b"update_participants"
+_K_MASK_SUBMITTED = b"mask_submitted"
+_K_MASK_DICT = b"mask_dict"
+_K_LATEST_MODEL = b"latest_global_model_id"
+
+
+class RedisCoordinatorStorage(CoordinatorStorage):
+    """Coordinator storage over Redis with Lua-scripted atomicity."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0):
+        self.client = RespClient(host, port, db)
+
+    async def set_coordinator_state(self, state: bytes) -> None:
+        await self.client.command(b"SET", _K_STATE, state)
+
+    async def coordinator_state(self) -> Optional[bytes]:
+        return await self.client.command(b"GET", _K_STATE)
+
+    async def add_sum_participant(self, pk: bytes, ephm_pk: bytes) -> Optional[SumPartAddError]:
+        ok = await self.client.command(
+            b"EVAL", ADD_SUM_PARTICIPANT, b"1", _K_SUM_DICT, pk, ephm_pk
+        )
+        return None if ok == 1 else SumPartAddError.ALREADY_EXISTS
+
+    async def sum_dict(self):
+        flat = await self.client.command(b"HGETALL", _K_SUM_DICT)
+        if not flat:
+            return None
+        return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+
+    async def add_local_seed_dict(
+        self, update_pk: bytes, local_seed_dict
+    ) -> Optional[LocalSeedDictAddError]:
+        argv: list[bytes] = [update_pk]
+        for sum_pk, seed in local_seed_dict.items():
+            seed_bytes = seed.as_bytes() if isinstance(seed, EncryptedMaskSeed) else bytes(seed)
+            argv += [sum_pk, seed_bytes]
+        code = await self.client.command(
+            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", _K_SUM_DICT, _K_UPDATE_SET, *argv
+        )
+        return {
+            0: None,
+            -1: LocalSeedDictAddError.LENGTH_MISMATCH,
+            -2: LocalSeedDictAddError.UNKNOWN_SUM_PARTICIPANT,
+            -3: LocalSeedDictAddError.UPDATE_PK_ALREADY_SUBMITTED,
+            -4: LocalSeedDictAddError.UPDATE_PK_ALREADY_EXISTS_IN_UPDATE_SEED_DICT,
+        }[int(code)]
+
+    async def seed_dict(self):
+        sums = await self.sum_dict()
+        if not sums:
+            return None
+        out = {}
+        for sum_pk in sums:
+            flat = await self.client.command(b"HGETALL", b"seed_dict:" + sum_pk)
+            out[sum_pk] = {
+                flat[i]: EncryptedMaskSeed(flat[i + 1]) for i in range(0, len(flat), 2)
+            }
+        return out if any(out.values()) else None
+
+    async def incr_mask_score(self, pk: bytes, mask: MaskObject) -> Optional[MaskScoreIncrError]:
+        code = await self.client.command(
+            b"EVAL",
+            INCR_MASK_SCORE,
+            b"3",
+            _K_SUM_DICT,
+            _K_MASK_SUBMITTED,
+            _K_MASK_DICT,
+            pk,
+            serialize_mask_object(mask),
+        )
+        return {
+            0: None,
+            -1: MaskScoreIncrError.UNKNOWN_SUM_PK,
+            -2: MaskScoreIncrError.MASK_ALREADY_SUBMITTED,
+        }[int(code)]
+
+    async def best_masks(self):
+        reply = await self.client.command(
+            b"ZREVRANGE", _K_MASK_DICT, b"0", b"1", b"WITHSCORES"
+        )
+        if not reply:
+            return None
+        out = []
+        for i in range(0, len(reply), 2):
+            mask, _ = parse_mask_object(reply[i])
+            out.append((mask, int(float(reply[i + 1]))))
+        return out
+
+    async def number_of_unique_masks(self) -> int:
+        return int(await self.client.command(b"ZCARD", _K_MASK_DICT))
+
+    async def delete_coordinator_data(self) -> None:
+        await self.client.command(b"FLUSHDB")
+
+    async def delete_dicts(self) -> None:
+        sums = await self.client.command(b"HKEYS", _K_SUM_DICT) or []
+        keys = [_K_SUM_DICT, _K_UPDATE_SET, _K_MASK_SUBMITTED, _K_MASK_DICT]
+        keys += [b"seed_dict:" + pk for pk in sums]
+        await self.client.command(b"DEL", *keys)
+
+    async def set_latest_global_model_id(self, model_id: str) -> None:
+        await self.client.command(b"SET", _K_LATEST_MODEL, model_id.encode())
+
+    async def latest_global_model_id(self) -> Optional[str]:
+        v = await self.client.command(b"GET", _K_LATEST_MODEL)
+        return v.decode() if v is not None else None
+
+    async def is_ready(self) -> None:
+        pong = await self.client.command(b"PING")
+        if pong != b"PONG":
+            raise StorageError(f"unexpected PING reply {pong!r}")
