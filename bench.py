@@ -26,9 +26,36 @@ def _sync(x) -> None:
     np.asarray(x[:1, :8])
 
 
+def _device_probe_ok(timeout: float = 120.0) -> bool:
+    """Probe the accelerator in a subprocess (a wedged tunnel hangs forever)."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "x = jax.device_put(np.ones(8, np.float32));"
+        "print(float(jnp.sum(x)))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout, text=True
+        )
+        return r.returncode == 0 and "8.0" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _device_probe_ok():
+        print("accelerator unreachable; falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
     import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
     from xaynet_tpu.ops import limbs as host_limbs

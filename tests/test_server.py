@@ -226,3 +226,60 @@ bound_type = "b2"
     bad.pet.update.count = CountSettings(min=2, max=10)  # below protocol floor (3)
     with pytest.raises(SettingsError):
         bad.validate()
+
+
+def test_staged_aggregator_device_matches_host():
+    """Device (mesh) aggregation path == host path, including unmask."""
+    import numpy as np
+
+    from xaynet_tpu.core.mask import (
+        BoundType,
+        DataType,
+        GroupType,
+        Masker,
+        MaskConfig,
+        ModelType,
+        Scalar,
+    )
+    from xaynet_tpu.server.aggregation import StagedAggregator
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    n, k = 57, 7
+    rng = np.random.default_rng(9)
+    host = StagedAggregator(cfg.pair(), n, device=False, batch_size=3)
+    dev = StagedAggregator(cfg.pair(), n, device=True, batch_size=3)
+    for _ in range(k):
+        w = rng.uniform(-1, 1, n).astype(np.float32)
+        _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
+        host.validate_aggregation(masked)
+        host.aggregate(masked)
+        dev.validate_aggregation(masked)
+        dev.aggregate(masked)
+    a, b = host.finalize(), dev.finalize()
+    assert a.nb_models == b.nb_models == k
+    assert a.object == b.object
+
+
+def test_sdk_sum2_device_path_matches_host(monkeypatch):
+    """SDK mask aggregation: device kernels == host path."""
+    import numpy as np
+
+    from xaynet_tpu.core.mask import (
+        BoundType,
+        DataType,
+        GroupType,
+        MaskConfig,
+        MaskSeed,
+        ModelType,
+    )
+    from xaynet_tpu.sdk.state_machine import PetSettings as SdkSettings, StateMachine
+    from xaynet_tpu.sdk.simulation import keys_for_task
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3)
+    sm = StateMachine.__new__(StateMachine)
+    seeds = [MaskSeed(bytes([i]) * 32) for i in range(1, 5)]
+
+    host_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
+    monkeypatch.setattr(StateMachine, "DEVICE_SUM2_THRESHOLD", 1)
+    dev_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
+    assert host_obj == dev_obj

@@ -196,6 +196,11 @@ class StateMachine:
         self.phase = PhaseKind.AWAITING
         return TransitionOutcome.COMPLETE
 
+    # model sizes above this use the JAX device kernels for mask
+    # derivation + aggregation (the Sum2 participant hot loop:
+    # #updates x model_length group elements)
+    DEVICE_SUM2_THRESHOLD = 262_144
+
     async def _step_sum2(self) -> TransitionOutcome:
         """Fetch seeds, derive + aggregate masks, upload (sum2.rs:82-204)."""
         assert self.round_params is not None and self.ephm_keys is not None
@@ -205,17 +210,38 @@ class StateMachine:
 
         length = self.round_params.model_length
         config = self.round_params.mask_config
-        mask_agg = Aggregation(config, length)
-        for update_pk, encrypted in seeds.items():
-            mask_seed = encrypted.decrypt(self.ephm_keys.secret, self.ephm_keys.public)
-            mask = mask_seed.derive_mask(length, config)
-            mask_agg.validate_aggregation(mask)
-            mask_agg.aggregate(mask)
+        mask_seeds = [
+            encrypted.decrypt(self.ephm_keys.secret, self.ephm_keys.public)
+            for encrypted in seeds.values()
+        ]
+        mask_obj = self._aggregate_masks(mask_seeds, length, config)
 
-        payload = Sum2(sum_signature=self.sum_signature, model_mask=mask_agg.object)
+        payload = Sum2(sum_signature=self.sum_signature, model_mask=mask_obj)
         await self._send(payload)
         self.phase = PhaseKind.AWAITING
         return TransitionOutcome.COMPLETE
+
+    def _aggregate_masks(self, mask_seeds, length: int, config) -> MaskObject:
+        if length >= self.DEVICE_SUM2_THRESHOLD:
+            try:
+                from ..core.mask.object import MaskUnit, MaskVect
+                from ..ops import masking_jax
+
+                unit, vect = masking_jax.sum_masks(
+                    [s.as_bytes() for s in mask_seeds], length, config
+                )
+                return MaskObject(
+                    MaskVect(config.vect, np.asarray(vect)),
+                    MaskUnit(config.unit, unit),
+                )
+            except Exception:
+                logger.warning("device mask aggregation failed; using host path", exc_info=True)
+        mask_agg = Aggregation(config, length)
+        for mask_seed in mask_seeds:
+            mask = mask_seed.derive_mask(length, config)
+            mask_agg.validate_aggregation(mask)
+            mask_agg.aggregate(mask)
+        return mask_agg.object
 
     # --- sending ----------------------------------------------------------
 

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
 from ...storage.traits import Store
+from ...utils import tracing
 from ..events import EventPublisher, PhaseName
 from ..requests import ChannelClosed, RequestError, RequestReceiver, StateMachineRequest
 from ..settings import PhaseSettings, Settings, Sum2Settings
@@ -194,7 +195,10 @@ class PhaseState:
             self._respond(env, RequestError(RequestError.Kind.MESSAGE_DISCARDED))
             return
         try:
-            await self.handle_request(env.request)
+            with tracing.use_request_id(env.request_id), tracing.span(
+                "handle_request", phase=self.NAME.value
+            ):
+                await self.handle_request(env.request)
         except RequestError as err:
             counter.rejected += 1
             if self.shared.metrics is not None:

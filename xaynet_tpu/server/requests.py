@@ -14,6 +14,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional, Union
 
+from ..utils import tracing
+
 from ..core.common import LocalSeedDict
 from ..core.mask.object import MaskObject
 from ..core.message import Message, Sum, Sum2, Tag, Update
@@ -75,6 +77,7 @@ def request_from_message(message: Message) -> StateMachineRequest:
 class _Envelope:
     request: StateMachineRequest
     response: asyncio.Future
+    request_id: str = "-"
 
 
 class RequestReceiver:
@@ -126,5 +129,5 @@ class RequestSender:
         if self._receiver._closed:
             raise RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._receiver._queue.put_nowait(_Envelope(req, fut))
+        self._receiver._queue.put_nowait(_Envelope(req, fut, tracing.current_request_id()))
         await fut

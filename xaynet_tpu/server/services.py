@@ -26,6 +26,7 @@ from ..core.crypto.sign import is_eligible, verify_detached
 from ..core.mask.serialization import DecodeError
 from ..core.message import Chunk, Message, Sum, Sum2, Tag, Update, peek_header
 from ..core.message.encoder import MessageBuilder
+from ..utils import tracing
 from .events import EventSubscriber, PhaseName
 from .requests import RequestSender, request_from_message
 
@@ -65,11 +66,14 @@ class PetMessageHandler:
         Raises ``ServiceError`` (pipeline drop) or ``RequestError`` (state
         machine rejection).
         """
-        message = await self._parse_message(encrypted)
-        if message is None:
-            return  # multipart message still incomplete
-        self._validate_task(message)
-        await self.request_tx.request(request_from_message(message))
+        tracing.new_request_id()
+        with tracing.span("handle_message", size=len(encrypted)):
+            message = await self._parse_message(encrypted)
+            if message is None:
+                return  # multipart message still incomplete
+            with tracing.span("task_validator"):
+                self._validate_task(message)
+            await self.request_tx.request(request_from_message(message))
 
     # --- pipeline stages --------------------------------------------------
 
