@@ -97,22 +97,40 @@ XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_
   uint32_t key[8];
   std::memcpy(key, key_bytes, 32);
 
-  uint8_t block[64];
-  uint64_t cur_block = UINT64_MAX;  // invalid: forces initial generation
-  uint8_t candidate[512];           // order_nbytes <= 268 in the catalogue
+  // Buffered keystream: generate CHUNK_BLOCKS blocks at a time and slice
+  // candidates out of the flat buffer (carrying the partial tail between
+  // refills), instead of reassembling byte-by-byte.
+  constexpr uint64_t CHUNK_BLOCKS = 1024;  // 64 KiB of keystream per refill
+  std::vector<uint8_t> buf(CHUNK_BLOCKS * 64 + 512);
+  uint64_t avail = 0;  // valid bytes in buf
+
+  uint64_t next_block = byte_offset / 64;
+  uint64_t intra = byte_offset % 64;
+  // prime the buffer with the partial first block
+  if (intra) {
+    uint8_t first[64];
+    chacha20_block(key, next_block, first);
+    next_block++;
+    avail = 64 - intra;
+    std::memcpy(buf.data(), first + intra, avail);
+  }
 
   uint64_t offset = byte_offset;
+  uint64_t pos = 0;  // read cursor within buf
   for (uint64_t got = 0; got < count;) {
-    // assemble the next candidate from (possibly two) keystream blocks
-    for (uint32_t i = 0; i < order_nbytes; i++) {
-      uint64_t pos = offset + i;
-      uint64_t blk = pos / 64;
-      if (blk != cur_block) {
-        chacha20_block(key, blk, block);
-        cur_block = blk;
+    if (avail - pos < order_nbytes) {
+      // move the tail to the front, refill
+      uint64_t tail = avail - pos;
+      std::memmove(buf.data(), buf.data() + pos, tail);
+      for (uint64_t b = 0; b < CHUNK_BLOCKS; b++) {
+        chacha20_block(key, next_block + b, buf.data() + tail + b * 64);
       }
-      candidate[i] = block[pos % 64];
+      next_block += CHUNK_BLOCKS;
+      avail = tail + CHUNK_BLOCKS * 64;
+      pos = 0;
     }
+    const uint8_t* candidate = buf.data() + pos;
+    pos += order_nbytes;
     offset += order_nbytes;
     if (lt_le(candidate, order_le, order_nbytes)) {
       std::memcpy(out + got * order_nbytes, candidate, order_nbytes);

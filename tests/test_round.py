@@ -148,3 +148,70 @@ def test_full_pet_round():
     got, expected = models[0]
     assert got.shape == (MODEL_LEN,)
     np.testing.assert_allclose(got, expected, atol=1e-9)
+
+
+def test_round_with_chunked_updates_and_device_aggregation():
+    """Multipart update messages + TPU-mesh aggregation, end to end."""
+
+    async def run():
+        settings = _settings()
+        settings.model.length = 600  # update payload >> max_message_size
+        settings.aggregation.device = True
+        settings.aggregation.batch_size = 2
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, request_tx)
+        fetcher = Fetcher(events)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.01)
+            params = fetcher.round_params()
+            seed = params.seed.as_bytes()
+            rng = np.random.default_rng(3)
+            expected = np.zeros(600)
+            participants = []
+            for i in range(N_SUM):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+                sm = ParticipantSM(
+                    PetSettings(keys=keys, max_message_size=1024),
+                    InProcessClient(fetcher, handler),
+                    ArrayModelStore(None),
+                )
+                participants.append(sm)
+            for i in range(N_UPDATE):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(10 + i) * 1000)
+                local = rng.uniform(-1, 1, 600).astype(np.float32)
+                expected += local.astype(np.float64) / N_UPDATE
+                sm = ParticipantSM(
+                    PetSettings(
+                        keys=keys, scalar=Fraction(1, N_UPDATE), max_message_size=1024
+                    ),
+                    InProcessClient(fetcher, handler),
+                    ArrayModelStore(local),
+                )
+                participants.append(sm)
+
+            async def drive(sm):
+                for _ in range(500):
+                    try:
+                        await sm.transition()
+                    except Exception:
+                        pass
+                    if fetcher.model() is not None and sm.phase.value == "awaiting":
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(*(drive(p) for p in participants))
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            return np.asarray(fetcher.model()), expected
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    got, expected = asyncio.run(asyncio.wait_for(run(), timeout=90))
+    np.testing.assert_allclose(got, expected, atol=1e-9)
