@@ -159,3 +159,27 @@ def test_fold_planar_batch(cfg, k):
     got = host_limbs.limbs_to_ints(np.ascontiguousarray(np.asarray(out).T))
     want = [(acc0[j] + sum(rows[i][j] for i in range(k))) % order for j in range(n)]
     assert got == want
+
+
+@pytest.mark.parametrize("k", [1, 2, 13])
+def test_fold_pallas_matches_oracle(k):
+    """Pallas fold (interpret mode on CPU) == python big-int oracle."""
+    import jax.numpy as jnp
+
+    from xaynet_tpu.ops.fold_jax import wire_to_planar
+    from xaynet_tpu.ops.fold_pallas import fold_planar_batch_pallas
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    order = cfg.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    rng = random.Random(k)
+    n = 256
+    rows = [[rng.randrange(order) for _ in range(n)] for _ in range(k)]
+    stack = np.stack([host_limbs.ints_to_limbs(r, n_limb) for r in rows])
+    acc0 = [rng.randrange(order) for _ in range(n)]
+    acc = jnp.asarray(wire_to_planar(host_limbs.ints_to_limbs(acc0, n_limb)))
+
+    out = fold_planar_batch_pallas(acc, jnp.asarray(wire_to_planar(stack)), order, interpret=True)
+    got = host_limbs.limbs_to_ints(np.ascontiguousarray(np.asarray(out).T))
+    want = [(acc0[j] + sum(rows[i][j] for i in range(k))) % order for j in range(n)]
+    assert got == want

@@ -1,0 +1,131 @@
+"""Pallas TPU kernel for the lazy-carry batch fold.
+
+Fuses the whole aggregation fold (16-bit split -> K-sum -> carry propagate
+-> modular reduce -> accumulate) into one kernel so the staged batch makes
+exactly one HBM->VMEM trip per tile with no intermediate HBM materialization.
+Grid: one program per model-axis tile; each program loops the K updates of
+its tile in VMEM.
+
+Equivalent to ``fold_jax.fold_planar_batch`` (the XLA version, which remains
+the fallback and the CPU/interpret oracle). Layouts match: planar
+``uint32[K, L, n]`` batch, ``uint32[L, n]`` accumulator.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .fold_jax import MAX_LAZY_BATCH
+
+_U32 = jnp.uint32
+
+TILE = 2048  # model-axis elements per grid program (VMEM-friendly)
+
+
+def _limbs(value: int, n_limbs: int) -> tuple[int, ...]:
+    return tuple((value >> (32 * i)) & 0xFFFFFFFF for i in range(n_limbs))
+
+
+def _fold_kernel(acc_ref, stack_ref, out_ref, *, k: int, n_limb: int, order: int):
+    """One model-axis tile: sum K updates lazily, reduce, accumulate."""
+    # 16-bit column sums over K (values < K * 2^16 <= 2^32)
+    lo = jnp.zeros((n_limb, stack_ref.shape[2]), dtype=_U32)
+    hi = jnp.zeros((n_limb, stack_ref.shape[2]), dtype=_U32)
+    for i in range(k):  # statically unrolled; stack tile lives in VMEM
+        limbs = stack_ref[i]
+        lo = lo + (limbs & _U32(0xFFFF))
+        hi = hi + (limbs >> _U32(16))
+
+    # carry-propagate into an (L+1)-limb value < K * order
+    carry = jnp.zeros((stack_ref.shape[2],), dtype=_U32)
+    value = []
+    for j in range(n_limb):
+        t_lo = lo[j] + carry
+        t_hi = hi[j] + (t_lo >> _U32(16))
+        value.append((t_lo & _U32(0xFFFF)) | (t_hi << _U32(16)))
+        carry = t_hi >> _U32(16)
+    value.append(carry)
+
+    # conditional subtracts of order << b
+    kbits = max(1, (k - 1).bit_length())
+    for b in range(kbits - 1, -1, -1):
+        const = _limbs(order << b, n_limb + 1)
+        lt = jnp.zeros_like(value[0], dtype=jnp.bool_)
+        decided = jnp.zeros_like(lt)
+        for j in range(n_limb, -1, -1):
+            o = _U32(const[j])
+            lt = lt | (~decided & (value[j] < o))
+            decided = decided | (value[j] != o)
+        ge = ~lt
+        borrow = jnp.zeros_like(value[0])
+        new_value = []
+        for j in range(n_limb + 1):
+            d1 = value[j] - _U32(const[j])
+            b1 = (value[j] < _U32(const[j])).astype(_U32)
+            d2 = d1 - borrow
+            b2 = (d1 < borrow).astype(_U32)
+            new_value.append(jnp.where(ge, d2, value[j]))
+            borrow = b1 | b2
+        value = new_value
+
+    # modular add into the accumulator (top limb of value is now zero)
+    acc = acc_ref[:]
+    carry = jnp.zeros_like(value[0])
+    summed = []
+    for j in range(n_limb):
+        s1 = acc[j] + value[j]
+        c1 = (s1 < acc[j]).astype(_U32)
+        s2 = s1 + carry
+        c2 = (s2 < s1).astype(_U32)
+        summed.append(s2)
+        carry = c1 | c2
+    if order == 1 << (32 * n_limb):
+        out_ref[:] = jnp.stack(summed)
+        return
+    ol = _limbs(order, n_limb)
+    lt = jnp.zeros_like(summed[0], dtype=jnp.bool_)
+    decided = jnp.zeros_like(lt)
+    for j in range(n_limb - 1, -1, -1):
+        o = _U32(ol[j])
+        lt = lt | (~decided & (summed[j] < o))
+        decided = decided | (summed[j] != o)
+    ge = (carry != 0) | ~lt
+    borrow = jnp.zeros_like(summed[0])
+    reduced = []
+    for j in range(n_limb):
+        d1 = summed[j] - _U32(ol[j])
+        b1 = (summed[j] < _U32(ol[j])).astype(_U32)
+        d2 = d1 - borrow
+        b2 = (d1 < borrow).astype(_U32)
+        reduced.append(jnp.where(ge, d2, summed[j]))
+        borrow = b1 | b2
+    out_ref[:] = jnp.stack(reduced)
+
+
+@partial(jax.jit, static_argnames=("order", "interpret"), donate_argnums=(0,))
+def fold_planar_batch_pallas(acc, stack_planar, order: int, interpret: bool = False):
+    """Pallas version of ``fold_jax.fold_planar_batch`` (same contract)."""
+    k, n_limb, n = stack_planar.shape
+    if k > MAX_LAZY_BATCH:
+        raise ValueError(f"batch of {k} exceeds lazy-carry headroom {MAX_LAZY_BATCH}")
+    tile = min(TILE, n)
+    if n % tile != 0:
+        # shapes are padded by the aggregator; guard anyway
+        raise ValueError(f"model axis {n} not divisible by tile {tile}")
+    grid = (n // tile,)
+    return pl.pallas_call(
+        partial(_fold_kernel, k=k, n_limb=n_limb, order=order),
+        out_shape=jax.ShapeDtypeStruct((n_limb, n), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n_limb, tile), lambda i: (0, i)),
+            pl.BlockSpec((k, n_limb, tile), lambda i: (0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((n_limb, tile), lambda i: (0, i)),
+        interpret=interpret,
+    )(acc, stack_planar)

@@ -28,7 +28,8 @@ _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 class ShardedAggregator:
     """Accumulates masked updates on-device, sharded over the model axis."""
 
-    def __init__(self, config: MaskConfig, model_length: int, mesh=None):
+    def __init__(self, config: MaskConfig, model_length: int, mesh=None, use_pallas: bool = False):
+        self.use_pallas = use_pallas
         self.config = config
         self.model_length = model_length
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -65,12 +66,22 @@ class ShardedAggregator:
         if stack.shape[0] > MAX_LAZY_BATCH:
             raise ValueError("batch too large for lazy-carry fold")
         staged = jax.device_put(self._to_planar_padded(stack), self._batch_sharding)
-        self.acc = fold_planar_batch(self.acc, staged, self.order)
+        if self.use_pallas:
+            from ..ops.fold_pallas import fold_planar_batch_pallas
+
+            self.acc = fold_planar_batch_pallas(self.acc, staged, self.order)
+        else:
+            self.acc = fold_planar_batch(self.acc, staged, self.order)
         self.nb_models += stack.shape[0]
 
     def add_planar_batch(self, stack_planar: jax.Array) -> None:
         """Fold an already device-resident planar ``[K, L, padded_len]`` batch."""
-        self.acc = fold_planar_batch(self.acc, stack_planar, self.order)
+        if self.use_pallas:
+            from ..ops.fold_pallas import fold_planar_batch_pallas
+
+            self.acc = fold_planar_batch_pallas(self.acc, stack_planar, self.order)
+        else:
+            self.acc = fold_planar_batch(self.acc, stack_planar, self.order)
         self.nb_models += stack_planar.shape[0]
 
     def unmask_limbs(self, mask_vect) -> np.ndarray:

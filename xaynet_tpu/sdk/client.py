@@ -48,18 +48,25 @@ class HttpClient(XaynetClient):
     Uses asyncio streams directly — no third-party HTTP dependency.
     """
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
-        if base_url.startswith("http://"):
+    def __init__(self, base_url: str, timeout: float = 30.0, tls_context=None):
+        self.tls = tls_context
+        if base_url.startswith("https://"):
+            base_url = base_url[len("https://") :]
+            if self.tls is None:
+                import ssl
+
+                self.tls = ssl.create_default_context()
+        elif base_url.startswith("http://"):
             base_url = base_url[len("http://") :]
         self.host, _, port = base_url.partition(":")
-        self.port = int(port or 80)
+        self.port = int(port or (443 if self.tls is not None else 80))
         self.timeout = timeout
 
     async def _request(
         self, method: str, path: str, body: bytes | None = None
     ) -> tuple[int, bytes]:
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port), self.timeout
+            asyncio.open_connection(self.host, self.port, ssl=self.tls), self.timeout
         )
         try:
             head = (
