@@ -279,7 +279,10 @@ def test_sdk_sum2_device_path_matches_host(monkeypatch):
     sm = StateMachine.__new__(StateMachine)
     seeds = [MaskSeed(bytes([i]) * 32) for i in range(1, 5)]
 
+    sm.device_sum2 = False
     host_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
+    # force the device branch: enable the flag and drop the size threshold
+    sm.device_sum2 = True
     monkeypatch.setattr(StateMachine, "DEVICE_SUM2_THRESHOLD", 1)
     dev_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
     assert host_obj == dev_obj

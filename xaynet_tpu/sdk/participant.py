@@ -63,6 +63,7 @@ class Participant:
         state: Optional[bytes] = None,
         keys: Optional[SigningKeyPair] = None,
         max_message_size: Optional[int] = 4096,
+        device_sum2: bool = False,
     ):
         if isinstance(client, str):
             client = HttpClient(client)
@@ -76,6 +77,7 @@ class Participant:
                 keys=keys or SigningKeyPair.generate(),
                 scalar=scalar,
                 max_message_size=max_message_size,
+                device_sum2=device_sum2,
             )
             self._sm = StateMachine(settings, client, self._store, self._events)
         self._made_progress = False

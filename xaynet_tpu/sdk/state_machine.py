@@ -63,6 +63,10 @@ class PetSettings:
     keys: SigningKeyPair
     scalar: Fraction = Fraction(1)
     max_message_size: Optional[int] = DEFAULT_MAX_MESSAGE_SIZE
+    # opt-in: run the Sum2 mask expansion/aggregation on the JAX device
+    # (kept explicit — initializing an accelerator backend inside an edge
+    # participant must be the embedder's decision)
+    device_sum2: bool = False
 
 
 class StateMachine:
@@ -78,6 +82,7 @@ class StateMachine:
         self.keys = settings.keys
         self.scalar = settings.scalar
         self.max_message_size = settings.max_message_size
+        self.device_sum2 = settings.device_sum2
         self.client = client
         self.model_store = model_store
         self.notify = notify or Notify()
@@ -196,9 +201,9 @@ class StateMachine:
         self.phase = PhaseKind.AWAITING
         return TransitionOutcome.COMPLETE
 
-    # model sizes above this use the JAX device kernels for mask
-    # derivation + aggregation (the Sum2 participant hot loop:
-    # #updates x model_length group elements)
+    # with device_sum2 enabled, models above this size use the JAX device
+    # kernels for mask derivation + aggregation (the Sum2 participant hot
+    # loop: #updates x model_length group elements)
     DEVICE_SUM2_THRESHOLD = 262_144
 
     async def _step_sum2(self) -> TransitionOutcome:
@@ -222,7 +227,7 @@ class StateMachine:
         return TransitionOutcome.COMPLETE
 
     def _aggregate_masks(self, mask_seeds, length: int, config) -> MaskObject:
-        if length >= self.DEVICE_SUM2_THRESHOLD:
+        if self.device_sum2 and length >= self.DEVICE_SUM2_THRESHOLD:
             try:
                 from ..core.mask.object import MaskUnit, MaskVect
                 from ..ops import masking_jax
@@ -267,6 +272,7 @@ class StateMachine:
             "keys": self.keys.secret.hex(),
             "scalar": [self.scalar.numerator, self.scalar.denominator],
             "max_message_size": self.max_message_size,
+            "device_sum2": self.device_sum2,
             "phase": self.phase.value,
             "task": self.task.value,
             "sum_signature": self.sum_signature.hex() if self.sum_signature else None,
@@ -289,6 +295,7 @@ class StateMachine:
             keys=SigningKeyPair.derive_from_seed(bytes.fromhex(d["keys"])),
             scalar=Fraction(*d["scalar"]),
             max_message_size=d["max_message_size"],
+            device_sum2=bool(d.get("device_sum2", False)),
         )
         machine = cls(settings, client, model_store, notify)
         machine.phase = PhaseKind(d["phase"])
