@@ -1,0 +1,81 @@
+"""Byte-golden wire fixtures (regression pins) + decode fuzzing.
+
+The reference pins its wire format with byte-exact fixtures (SURVEY §4.2);
+these goldens freeze ours so layout drift is loud. The fuzz check asserts
+the parser's total failure mode is DecodeError — never a crash.
+"""
+
+import random
+
+import pytest
+
+from xaynet_tpu.core.crypto.prng import uniform_ints
+from xaynet_tpu.core.crypto.sign import SigningKeyPair
+from xaynet_tpu.core.mask import (
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    MaskObject,
+    ModelType,
+)
+from xaynet_tpu.core.mask.serialization import serialize_mask_object
+from xaynet_tpu.core.message import DecodeError, Message, Sum, Tag
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+KEYS = SigningKeyPair.derive_from_seed(b"\x01" * 32)
+
+
+def test_mask_object_golden_bytes():
+    ints = uniform_ints(b"\x02" * 32, 3, CFG.order)
+    obj = MaskObject.new(CFG.pair(), ints[1:], ints[0])
+    wire = serialize_mask_object(obj)
+    # config(01 00 00 03) ‖ count(00000002 BE) ‖ 2x 6-byte LE ‖ config ‖ 6-byte LE
+    assert wire.hex() == (
+        "0100000300000002"  # vect config + count
+        + ints[1].to_bytes(6, "little").hex()
+        + ints[2].to_bytes(6, "little").hex()
+        + "01000003"  # unit config
+        + ints[0].to_bytes(6, "little").hex()
+    )
+
+
+def test_sum_message_golden_layout():
+    msg = Message(
+        participant_pk=KEYS.public,
+        coordinator_pk=b"\x09" * 32,
+        payload=Sum(sum_signature=b"\x0a" * 64, ephm_pk=b"\x0b" * 32),
+    )
+    wire = msg.to_bytes(KEYS.secret)
+    assert len(wire) == 136 + 96
+    assert wire[64:96] == KEYS.public  # participant pk
+    assert wire[96:128] == b"\x09" * 32  # coordinator pk
+    assert wire[128:132] == (232).to_bytes(4, "big")  # length field
+    assert wire[132] == int(Tag.SUM) and wire[133] == 0  # tag, flags
+    assert wire[136 : 136 + 64] == b"\x0a" * 64  # sum signature
+    assert wire[200:232] == b"\x0b" * 32  # ephemeral pk
+    # deterministic (ed25519 signatures are deterministic)
+    assert msg.to_bytes(KEYS.secret) == wire
+
+
+def test_decode_fuzz_never_crashes():
+    msg = Message(
+        participant_pk=KEYS.public,
+        coordinator_pk=b"\x09" * 32,
+        payload=Sum(sum_signature=b"\x0a" * 64, ephm_pk=b"\x0b" * 32),
+    )
+    wire = bytearray(msg.to_bytes(KEYS.secret))
+    rng = random.Random(0)
+    for _ in range(300):
+        mutated = bytearray(wire)
+        for _ in range(rng.randint(1, 8)):
+            mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+        try:
+            Message.from_bytes(bytes(mutated))
+        except DecodeError:
+            pass  # the only acceptable failure mode
+    for n in (0, 1, 64, 135, 137):
+        try:
+            Message.from_bytes(bytes(wire[:n]))
+        except DecodeError:
+            pass

@@ -57,8 +57,11 @@ class PetMessageHandler:
         self.events = events
         self.request_tx = request_tx
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="pet-msg")
-        # multipart reassembly buffers keyed by (participant_pk, message_id)
+        # multipart reassembly buffers keyed by (participant_pk, message_id);
+        # bounded: abandoned reassemblies are evicted oldest-first so a
+        # client cannot grow coordinator memory without completing messages
         self._multipart: dict[tuple[bytes, int], MessageBuilder] = {}
+        self.max_multipart_buffers = 4096
 
     async def handle_message(self, encrypted: bytes) -> None:
         """Decrypt, verify, validate and forward one message.
@@ -114,6 +117,9 @@ class PetMessageHandler:
         chunk = message.payload
         assert isinstance(chunk, Chunk)
         key = (message.participant_pk, chunk.message_id)
+        if key not in self._multipart and len(self._multipart) >= self.max_multipart_buffers:
+            evicted = next(iter(self._multipart))
+            del self._multipart[evicted]
         builder = self._multipart.setdefault(key, MessageBuilder())
         if not builder.add(chunk):
             return None
