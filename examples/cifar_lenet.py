@@ -1,0 +1,158 @@
+"""Baseline config #2: federated LeNet on CIFAR-10-shaped data.
+
+100 simulated participants (8 sum + 12 update per round drawn from the
+pool), f32 mask config, LeNet local training. Synthetic CIFAR-shaped data
+stands in for the dataset (zero-egress environment).
+
+Run:  python examples/cifar_lenet.py [--rounds 2] [--participants 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import threading
+import time
+from fractions import Fraction
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import os
+
+import jax
+
+# the TPU plugin's sitecustomize overrides jax_platforms; re-assert the
+# user's env choice so examples run wherever they're pointed
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from xaynet_tpu.models import lenet
+from xaynet_tpu.models.federated import FederatedTrainer, model_length
+from xaynet_tpu.sdk.api import spawn_participant
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+
+def synthetic_cifar(seed: int, n: int = 128):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def start_coordinator(model_len: int, n_sum: int, n_update: int):
+    settings = Settings(
+        pet=PetSettings(
+            sum=PhaseSettings(prob=0.2, count=CountSettings(n_sum, n_sum), time=TimeSettings(0, 300)),
+            update=PhaseSettings(prob=0.5, count=CountSettings(n_update, n_update), time=TimeSettings(0, 300)),
+            sum2=Sum2Settings(count=CountSettings(n_sum, n_sum), time=TimeSettings(0, 300)),
+        )
+    )
+    settings.model.length = model_len
+    info, started = {}, threading.Event()
+
+    def run():
+        async def main():
+            store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+            machine, tx, events = await StateMachineInitializer(settings, store).init()
+            rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
+            host, port = await rest.start("127.0.0.1", 0)
+            info["url"] = f"http://{host}:{port}"
+            started.set()
+            await machine.run()
+
+        asyncio.run(main())
+
+    threading.Thread(target=run, daemon=True).start()
+    started.wait(10)
+    return info["url"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--participants", type=int, default=20)
+    args = ap.parse_args()
+
+    template = lenet.init_params(jax.random.PRNGKey(0))
+    model_len = model_length(template)
+    n_sum, n_update = 2, max(3, args.participants - 2)
+    print(f"LeNet: {model_len} parameters; {n_sum} sum + {n_update} update per round")
+
+    url = start_coordinator(model_len, n_sum, n_update)
+    probe = HttpClient(url)
+
+    def sync(coro):
+        return asyncio.run(coro)
+
+    shared_step = lenet.make_train_step()
+    last_seed = None
+    threads = []
+    for round_no in range(1, args.rounds + 1):
+        t0 = time.time()
+        params = sync(probe.get_round_params())
+        while last_seed is not None and params.seed.as_bytes() == last_seed:
+            time.sleep(0.2)
+            params = sync(probe.get_round_params())
+        seed = params.seed.as_bytes()
+
+        def kwargs(i):
+            return dict(
+                init_params_fn=lambda: lenet.init_params(jax.random.PRNGKey(1)),
+                make_step=lambda: shared_step,
+                data=synthetic_cifar(i),
+                epochs=1,
+                batch_size=32,
+            )
+
+        for i in range(n_sum):
+            threads.append(
+                spawn_participant(
+                    url, FederatedTrainer, kwargs=kwargs(900 + i),
+                    keys=keys_for_task(seed, 0.2, 0.5, "sum", start=i * 1000),
+                )
+            )
+        for i in range(n_update):
+            threads.append(
+                spawn_participant(
+                    url, FederatedTrainer, kwargs=kwargs(i), scalar=Fraction(1, n_update),
+                    keys=keys_for_task(seed, 0.2, 0.5, "update", start=(500 + i) * 1000),
+                )
+            )
+
+        while True:
+            model = sync(probe.get_model())
+            fresh = sync(probe.get_round_params())
+            if model is not None and fresh.seed.as_bytes() != seed:
+                break
+            time.sleep(0.2)
+        last_seed = seed
+        print(f"round {round_no}: completed in {time.time() - t0:.1f}s "
+              f"(model norm {float(np.linalg.norm(model)):.2f})")
+
+    for t in threads:
+        t.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,12 @@ from fractions import Fraction
 import jax
 import numpy as np
 
+# the TPU plugin's sitecustomize overrides jax_platforms; re-assert the
+# user's env choice so examples run wherever they're pointed
+import os
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 sys.path.insert(0, ".")
 
 from xaynet_tpu.models import mlp
@@ -102,52 +108,59 @@ def main():
     def sync(coro):
         return asyncio.run(coro)
 
-    params = sync(probe.get_round_params())
-    seed = params.seed.as_bytes()
-
+    # Task eligibility re-draws every round (fresh seed), so the simulation
+    # pins role-matched participants per round; threads from earlier rounds
+    # stay alive (they idle or pick up whatever role the new seed gives them).
+    shared_step = mlp.make_train_step()
     threads = []
-    trainers = []
-    for i in range(N_SUM):
-        keys = keys_for_task(seed, 0.3, 0.7, "sum", start=i * 1000)
-        threads.append(
-            spawn_participant(
+    last_seed = None
+    for round_no in range(1, ROUNDS + 1):
+        params = sync(probe.get_round_params())
+        while last_seed is not None and params.seed.as_bytes() == last_seed:
+            time.sleep(0.2)
+            params = sync(probe.get_round_params())
+        seed = params.seed.as_bytes()
+
+        trainers = []
+        for i in range(N_SUM):
+            keys = keys_for_task(seed, 0.3, 0.7, "sum", start=i * 1000)
+            threads.append(
+                spawn_participant(
+                    url,
+                    FederatedTrainer,
+                    kwargs=dict(
+                        init_params_fn=lambda: mlp.init_params(jax.random.PRNGKey(1), INPUT_DIM),
+                        make_step=lambda: shared_step,
+                        data=make_data(rng),
+                    ),
+                    keys=keys,
+                )
+            )
+        for i in range(N_UPDATE):
+            keys = keys_for_task(seed, 0.3, 0.7, "update", start=(50 + i) * 1000)
+            t = spawn_participant(
                 url,
                 FederatedTrainer,
                 kwargs=dict(
-                    init_params_fn=lambda: mlp.init_params(jax.random.PRNGKey(1), INPUT_DIM),
-                    make_step=mlp.make_train_step,
+                    init_params_fn=lambda i=i: mlp.init_params(jax.random.PRNGKey(10 + i), INPUT_DIM),
+                    make_step=lambda: shared_step,
                     data=make_data(rng),
+                    epochs=2,
                 ),
+                scalar=Fraction(1, N_UPDATE),
                 keys=keys,
             )
-        )
-    for i in range(N_UPDATE):
-        keys = keys_for_task(seed, 0.3, 0.7, "update", start=(50 + i) * 1000)
-        t = spawn_participant(
-            url,
-            FederatedTrainer,
-            kwargs=dict(
-                init_params_fn=lambda i=i: mlp.init_params(jax.random.PRNGKey(10 + i), INPUT_DIM),
-                make_step=mlp.make_train_step,
-                data=make_data(rng),
-                epochs=2,
-            ),
-            scalar=Fraction(1, N_UPDATE),
-            keys=keys,
-        )
-        threads.append(t)
-        trainers.append(t)
+            threads.append(t)
+            trainers.append(t)
 
-    last_seed = seed
-    for round_no in range(1, ROUNDS + 1):
         deadline = time.time() + 120
         while time.time() < deadline:
             model = sync(probe.get_model())
             fresh = sync(probe.get_round_params())
-            if model is not None and fresh.seed.as_bytes() != last_seed:
-                last_seed = fresh.seed.as_bytes()
+            if model is not None and fresh.seed.as_bytes() != seed:
                 break
             time.sleep(0.2)
+        last_seed = seed
         losses = [t._participant.last_loss for t in trainers if t._participant.last_loss]
         print(f"round {round_no}: global model ready; local losses: "
               + ", ".join(f"{l:.4f}" for l in losses))

@@ -39,7 +39,16 @@ class InProcessClient(XaynetClient):
         return self.fetcher.model()
 
     async def send_message(self, encrypted: bytes) -> None:
-        await self.handler.handle_message(encrypted)
+        """Mirrors the REST semantics: drops/rejections are swallowed
+        (POST /message answers 200 regardless; clients learn outcomes from
+        round progression)."""
+        from ..server.requests import RequestError
+        from ..server.services import ServiceError
+
+        try:
+            await self.handler.handle_message(encrypted)
+        except (ServiceError, RequestError):
+            pass
 
 
 class HttpClient(XaynetClient):
