@@ -17,7 +17,6 @@ representation:
 
 from __future__ import annotations
 
-from fractions import Fraction
 from typing import Union
 
 import numpy as np

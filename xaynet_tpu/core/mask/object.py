@@ -12,7 +12,7 @@ wire codec consume — instead of python bignums.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -15,8 +15,8 @@ import os
 import struct
 from typing import Iterator
 
-from .message import HEADER_LENGTH, Message, Tag
-from .payloads import CHUNK_HEADER_LENGTH, Chunk, Payload
+from .message import HEADER_LENGTH, Message
+from .payloads import CHUNK_HEADER_LENGTH, Chunk
 
 # minimum sensible ceiling: header + chunk header + 1 byte of progress
 MIN_PAYLOAD_SIZE = CHUNK_HEADER_LENGTH + 1

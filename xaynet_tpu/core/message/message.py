@@ -15,7 +15,7 @@ signature, message.rs:336-358). Tags: Sum=1, Update=2, Sum2=3
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import IntEnum, IntFlag
 
 from ..crypto import sign as crypto_sign

@@ -16,7 +16,6 @@ coordinator and sum participants run:
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..core.crypto.prng import StreamSampler

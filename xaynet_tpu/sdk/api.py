@@ -23,7 +23,7 @@ from typing import Optional
 
 import numpy as np
 
-from .participant import Participant, Task
+from .participant import Participant
 
 logger = logging.getLogger("xaynet.sdk")
 

@@ -14,15 +14,14 @@ asyncio event loop, so ``tick()`` is synchronous for the caller.
 from __future__ import annotations
 
 import asyncio
-from enum import Enum
 from fractions import Fraction
 from typing import Optional, Union
 
 import numpy as np
 
 from ..core.crypto.sign import SigningKeyPair
-from .client import HttpClient, InProcessClient
-from .state_machine import PetSettings, PhaseKind, StateMachine, Task, TransitionOutcome
+from .client import HttpClient
+from .state_machine import PetSettings, StateMachine, Task, TransitionOutcome
 from .traits import ModelStore, Notify, XaynetClient
 
 

@@ -17,19 +17,18 @@ from __future__ import annotations
 import enum
 import json
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from fractions import Fraction
 from typing import Optional
 
 import numpy as np
 
 from ..core.common import RoundParameters
-from ..core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey, SecretEncryptKey
+from ..core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey
 from ..core.crypto.sign import SigningKeyPair, is_eligible
 from ..core.mask.masking import Aggregation, Masker
 from ..core.mask.model import Scalar
 from ..core.mask.object import MaskObject
-from ..core.mask.seed import MaskSeed
 from ..core.message import Message, Sum, Sum2, Update
 from ..core.message.encoder import DEFAULT_MAX_MESSAGE_SIZE, MessageEncoder
 from .traits import ModelStore, Notify, XaynetClient

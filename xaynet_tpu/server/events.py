@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from enum import Enum
 from typing import Generic, Optional, TypeVar
 
-from ..core.common import RoundParameters, SeedDict, SumDict
+from ..core.common import RoundParameters
 
 T = TypeVar("T")
 

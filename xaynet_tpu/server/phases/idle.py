@@ -16,7 +16,7 @@ from ...core.common import RoundSeed
 from ...core.crypto.encrypt import EncryptKeyPair
 from ...core.crypto.hash import sha256
 from ...core.crypto.sign import SigningKeyPair
-from ..events import DictionaryUpdate, ModelUpdate, PhaseName
+from ..events import DictionaryUpdate, PhaseName
 from .base import PhaseState, Shared
 
 

@@ -10,7 +10,7 @@ phases are purged with a rejection at phase end.
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
 from typing import Optional, Union
 
@@ -18,7 +18,7 @@ from ..utils import tracing
 
 from ..core.common import LocalSeedDict
 from ..core.mask.object import MaskObject
-from ..core.message import Message, Sum, Sum2, Tag, Update
+from ..core.message import Message, Sum, Sum2, Update
 
 
 class RequestError(Exception):

@@ -26,7 +26,6 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from ..sdk.traits import XaynetClient  # noqa: F401  (doc cross-reference)
 from .requests import RequestError
 from .services import Fetcher, PetMessageHandler, ServiceError
 

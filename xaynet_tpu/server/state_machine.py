@@ -17,7 +17,7 @@ from .coordinator import CoordinatorState
 from .events import EventPublisher, EventSubscriber, ModelUpdate, PhaseName
 from .phases import Idle, PhaseState, Shared
 from .requests import RequestReceiver, RequestSender
-from .settings import Settings, SettingsError
+from .settings import Settings
 
 logger = logging.getLogger("xaynet.coordinator")
 

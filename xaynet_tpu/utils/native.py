@@ -83,8 +83,6 @@ def as_u8p(buf) -> "ctypes.pointer":
 
 
 def np_u8p(arr):
-    import numpy as np
-
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
