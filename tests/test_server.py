@@ -286,3 +286,97 @@ def test_sdk_sum2_device_path_matches_host(monkeypatch):
     monkeypatch.setattr(StateMachine, "DEVICE_SUM2_THRESHOLD", 1)
     dev_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
     assert host_obj == dev_obj
+
+
+def test_round_failure_then_successful_round():
+    """A timed-out round restarts; the next round completes end to end."""
+    import numpy as np
+    from fractions import Fraction
+
+    from xaynet_tpu.sdk.client import InProcessClient
+    from xaynet_tpu.sdk.state_machine import PetSettings as SdkPet, StateMachine as P
+    from xaynet_tpu.sdk.traits import ModelStore
+
+    class MS(ModelStore):
+        def __init__(self, m):
+            self.m = m
+
+        async def load_model(self):
+            return self.m
+
+    async def run():
+        settings = _settings()
+        settings.pet.sum.time = TimeSettings(0, 0.3)  # round 1 will time out
+        settings.pet.update.count = CountSettings(3, 3)
+        settings.pet.update.time = TimeSettings(0, 20.0)
+        settings.pet.sum2.time = TimeSettings(0, 20.0)
+        store = _store()
+
+        from xaynet_tpu.server.metrics import Metrics
+
+        class PhaseRecorder(Metrics):
+            def __init__(self):
+                self.phases = []
+
+            def phase(self, round_id, phase):
+                self.phases.append((round_id, phase))
+
+        recorder = PhaseRecorder()
+        machine, tx, events = await StateMachineInitializer(settings, store, recorder).init()
+        handler = PetMessageHandler(events, tx)
+        machine_task = asyncio.create_task(machine.run())
+        from xaynet_tpu.server.services import Fetcher
+
+        fetcher = Fetcher(events)
+        try:
+            # round 1: nobody participates -> PhaseTimeout -> Failure -> Idle
+            while events.params.get_latest().round_id < 2:
+                await asyncio.sleep(0.02)
+            assert (1, "failure") in recorder.phases, recorder.phases
+
+            # restore the sum window so round 2 can complete
+            settings.pet.sum.time = TimeSettings(0, 20.0)
+
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.02)
+            params = fetcher.round_params()
+            seed = params.seed.as_bytes()
+            rng = np.random.default_rng(1)
+            parts = []
+            keys = keys_for_task(seed, params.sum, params.update, "sum")
+            parts.append(P(SdkPet(keys=keys), InProcessClient(fetcher, handler), MS(None)))
+            expected = np.zeros(4)
+            for i in range(3):
+                keys = keys_for_task(seed, params.sum, params.update, "update", start=(5 + i) * 1000)
+                local = rng.uniform(-1, 1, 4).astype(np.float32)
+                expected += local.astype(np.float64) / 3
+                parts.append(
+                    P(
+                        SdkPet(keys=keys, scalar=Fraction(1, 3)),
+                        InProcessClient(fetcher, handler),
+                        MS(local),
+                    )
+                )
+
+            async def drive(sm):
+                for _ in range(500):
+                    try:
+                        await sm.transition()
+                    except Exception:
+                        pass
+                    if fetcher.model() is not None:
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(*(drive(p) for p in parts))
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            np.testing.assert_allclose(np.asarray(fetcher.model()), expected, atol=1e-9)
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))

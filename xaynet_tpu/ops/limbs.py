@@ -148,16 +148,21 @@ def sub_limbs(a: np.ndarray, b: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def _native_binop(name: str, a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray):
-    """Run an elementwise modular op in the native library when possible."""
-    if a.ndim != 2 or a.shape != b.shape or a.shape[1] != order_limbs.shape[0]:
+    """Run an elementwise modular op in the native library when possible.
+
+    Any leading batch dimensions flatten into the element axis (the op is
+    elementwise over rows of L limbs).
+    """
+    if a.ndim < 2 or a.shape != b.shape or a.shape[-1] != order_limbs.shape[0]:
         return None
     from ..utils import native
 
     lib = native.load()
     if lib is None:
         return None
-    a = np.ascontiguousarray(a, dtype=_U32)
-    b = np.ascontiguousarray(b, dtype=_U32)
+    shape = a.shape
+    a = np.ascontiguousarray(a, dtype=_U32).reshape(-1, shape[-1])
+    b = np.ascontiguousarray(b, dtype=_U32).reshape(-1, shape[-1])
     ol = np.ascontiguousarray(order_limbs, dtype=_U32)
     out = np.empty_like(a)
     getattr(lib, name)(
@@ -168,7 +173,7 @@ def _native_binop(name: str, a: np.ndarray, b: np.ndarray, order_limbs: np.ndarr
         a.shape[1],
         native.np_u32p(ol),
     )
-    return out
+    return out.reshape(shape)
 
 
 def mod_add(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:

@@ -111,11 +111,15 @@ class PhaseState:
 
     # --- run loop ---------------------------------------------------------
 
-    async def run_phase(self) -> Optional["PhaseState"]:
+    def _announce(self) -> None:
+        """Broadcast + record the phase entry (every phase, every override)."""
         self.shared.events.broadcast_phase(self.NAME)
         if self.shared.metrics is not None:
             self.shared.metrics.phase(self.shared.round_id, self.NAME.value)
         logger.info("round %d: entering %s phase", self.shared.round_id, self.NAME.value)
+
+    async def run_phase(self) -> Optional["PhaseState"]:
+        self._announce()
         try:
             await self.process()
             await self.purge_outdated_requests()

@@ -33,7 +33,7 @@ class Failure(PhaseState):
             self.shared.metrics.event(self.shared.round_id, "phase_error", str(self.error))
 
     async def run_phase(self):
-        self.shared.events.broadcast_phase(self.NAME)
+        self._announce()
         await self.process()
         if isinstance(self.error, ChannelClosed):
             from .shutdown import Shutdown

@@ -27,6 +27,6 @@ class Shutdown(PhaseState):
             self._respond(env, RequestError(RequestError.Kind.INTERNAL, "shutting down"))
 
     async def run_phase(self):
-        self.shared.events.broadcast_phase(self.NAME)
+        self._announce()
         await self.process()
         return None
