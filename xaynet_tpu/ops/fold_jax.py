@@ -132,9 +132,13 @@ def fold_planar_batch(acc, stack_planar, order: int):
     if k > MAX_LAZY_BATCH:
         raise ValueError(f"batch of {k} exceeds lazy-carry headroom {MAX_LAZY_BATCH}")
     halves = jax.lax.bitcast_convert_type(stack_planar, jnp.uint16)  # [K, L, n, 2]
-    sums = jnp.sum(halves, axis=0, dtype=_U32)  # [L, n, 2]; reads batch once
-    lo = sums[:, :, 0]
-    hi = sums[:, :, 1]
+    # merge the u16 pair axis into the model axis BEFORE the reduction: a
+    # materialized tensor with a minor dimension of 2 tiles catastrophically
+    # on TPU (lane padding), while [.., 2n] keeps lanes full. The reshape is
+    # free (contiguous dims merge) and the batch is read exactly once.
+    sums = jnp.sum(halves.reshape(k, n_limb, n * 2), axis=0, dtype=_U32)  # [L, 2n]
+    lo = sums[:, 0::2]
+    hi = sums[:, 1::2]
     carry = jnp.zeros(n, dtype=_U32)
     limbs32 = []
     for j in range(n_limb):
