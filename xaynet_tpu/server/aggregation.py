@@ -72,10 +72,19 @@ class StagedAggregator:
         if not obj.is_valid():
             raise AggregationError("InvalidObject")
 
-    def aggregate(self, obj: MaskObject) -> None:
+    @property
+    def pending(self) -> int:
+        """Updates staged but not yet folded."""
+        return self._count
+
+    def stage(self, obj: MaskObject) -> None:
+        """Stage an update without folding (caller controls flush timing)."""
         self._staged_vect.append(obj.vect.data)
         self._staged_unit.append(obj.unit.data)
         self._count += 1
+
+    def aggregate(self, obj: MaskObject) -> None:
+        self.stage(obj)
         if self._count >= self.batch_size:
             self.flush()
 

@@ -16,6 +16,8 @@ per-update exactly as in the reference.
 
 from __future__ import annotations
 
+import asyncio
+
 from ...core.mask.masking import AggregationError
 from ..aggregation import StagedAggregator
 from ..events import DictionaryUpdate, PhaseName
@@ -64,4 +66,8 @@ class UpdatePhase(PhaseState):
         )
         if store_err is not None:
             raise RequestError(RequestError.Kind.MESSAGE_REJECTED, store_err.value)
-        self.aggregator.aggregate(req.masked_model)
+        self.aggregator.stage(req.masked_model)
+        if self.aggregator.pending >= self.aggregator.batch_size:
+            # fold off the event loop so the API stays responsive during
+            # large folds; handle_request awaits it, so folds serialize
+            await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
