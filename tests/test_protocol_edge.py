@@ -1,0 +1,170 @@
+"""Protocol edge behavior: window semantics, mask election, oversized input."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto.prng import uniform_ints
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    MaskObject,
+    ModelType,
+    UnmaskingError,
+)
+from xaynet_tpu.server.phases.base import PhaseState, PhaseTimeout, Shared, _Counter
+from xaynet_tpu.server.requests import RequestError, RequestReceiver, SumRequest
+from xaynet_tpu.server.settings import CountSettings, PhaseSettings, TimeSettings
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+
+
+class _AcceptAll(PhaseState):
+    from xaynet_tpu.server.events import PhaseName
+
+    NAME = PhaseName.SUM
+
+    async def handle_request(self, req):
+        if getattr(req, "participant_pk", b"") == b"reject":
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "test")
+
+
+def _shared():
+    from xaynet_tpu.server.events import EventPublisher, PhaseName
+    from xaynet_tpu.server.settings import Settings
+
+    class _State:
+        round_id = 1
+
+    settings = Settings.default()
+    events = EventPublisher(1, None, None, PhaseName.SUM)
+    return Shared(
+        state=_State(), request_rx=RequestReceiver(), events=events,
+        store=None, settings=settings,
+    )
+
+
+def _params(cmin, cmax, tmin, tmax):
+    return PhaseSettings(
+        prob=0.5, count=CountSettings(cmin, cmax), time=TimeSettings(tmin, tmax)
+    )
+
+
+def test_window_discards_beyond_count_max():
+    """During [0, time.min], requests beyond count.max are discarded."""
+
+    async def run():
+        shared = _shared()
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+
+        outcomes = []
+
+        async def send(pk):
+            try:
+                await sender.request(SumRequest(pk, b"e"))
+                outcomes.append("accepted")
+            except RequestError as e:
+                outcomes.append(e.kind.value)
+
+        senders = [asyncio.create_task(send(bytes([i]) * 4)) for i in range(5)]
+        await phase.process_requests(_params(1, 2, 0.3, 5.0))
+        await asyncio.gather(*senders)
+        assert outcomes.count("accepted") == 2
+        assert outcomes.count(RequestError.Kind.MESSAGE_DISCARDED.value) == 3
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_window_timeout_when_below_count_min():
+    async def run():
+        shared = _shared()
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        task = asyncio.create_task(sender.request(SumRequest(b"a", b"e")))
+        with pytest.raises(PhaseTimeout):
+            await phase.process_requests(_params(3, 5, 0.0, 0.4))
+        await task  # the single accepted request still gets its response
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_window_rejected_does_not_count():
+    async def run():
+        shared = _shared()
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+
+        async def send(pk):
+            try:
+                await sender.request(SumRequest(pk, b"e"))
+                return "ok"
+            except RequestError as e:
+                return e.kind.value
+
+        tasks = [asyncio.create_task(send(b"reject")) for _ in range(3)]
+        tasks.append(asyncio.create_task(send(b"good")))
+        await phase.process_requests(_params(1, 5, 0.0, 5.0))
+        results = await asyncio.gather(*tasks)
+        assert results.count("ok") == 1
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def _rand_mask(seed, n=6):
+    ints = uniform_ints(bytes([seed]) * 32, n + 1, CFG.order)
+    return MaskObject.new(CFG.pair(), ints[1:], ints[0])
+
+
+def test_wrong_mask_unmasks_to_garbage_but_safely():
+    """A structurally valid but wrong winning mask yields garbage, not a crash
+    (the reference documents the same property: validity checks are about
+    structure; correctness comes from the mask election)."""
+    masked = _rand_mask(1)
+    right = _rand_mask(1)  # identical derivation = the true mask
+    wrong = _rand_mask(2)
+    agg = Aggregation.from_object(masked)
+    agg.validate_unmasking(wrong)  # passes structural checks
+    out_wrong = agg.unmask_array(wrong)
+    assert np.all(np.isfinite(out_wrong))
+
+    agg2 = Aggregation.from_object(_rand_mask(1))
+    out_right = agg2.unmask_array(right)
+    # unmasking with the true mask gives exact zeros-shifted values;
+    # with the wrong mask it differs
+    assert not np.allclose(out_wrong, out_right)
+
+
+def test_unmask_length_mismatch_rejected():
+    masked = _rand_mask(1, n=6)
+    short_mask = _rand_mask(2, n=5)
+    agg = Aggregation.from_object(masked)
+    with pytest.raises(UnmaskingError):
+        agg.validate_unmasking(short_mask)
+
+
+def test_rest_rejects_oversized_body():
+    from xaynet_tpu.server import rest as rest_mod
+
+    async def run():
+        from xaynet_tpu.server.rest import RestServer
+
+        server = RestServer(fetcher=None, handler=None)
+        host, port = await server.start("127.0.0.1", 0)
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(
+                f"POST /message HTTP/1.1\r\nHost: x\r\nContent-Length: {1 << 33}\r\n\r\n".encode()
+            )
+            await writer.drain()
+            status = await reader.readline()
+            assert b"413" in status
+            writer.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 20))
