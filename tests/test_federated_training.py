@@ -137,3 +137,31 @@ def test_federated_mlp_learns():
 
     assert len(losses) >= 2, f"only {len(losses)} rounds completed"
     assert losses[-1] < losses[0], losses
+
+
+def test_local_federation_harness():
+    """The one-call simulation harness runs rounds and averages exactly."""
+    import numpy as np
+
+    from xaynet_tpu.sdk.api import ParticipantABC
+    from xaynet_tpu.sdk.federation import LocalFederation
+
+    MLEN = 9
+
+    class Const(ParticipantABC):
+        def __init__(self, v):
+            self.v = v
+
+        def train_round(self, training_input):
+            return np.full(MLEN, self.v, dtype=np.float32)
+
+    fed = LocalFederation(model_length=MLEN, n_sum=1, n_update=3)
+    # weights must respect the mask config's bound (default B0: |w| <= 1)
+    trainers = [Const(0.0), Const(0.3), Const(0.6), Const(0.9)]
+    try:
+        results = list(fed.rounds(trainers, n_rounds=2, round_timeout=60))
+    finally:
+        fed.stop()
+    assert len(results) == 2
+    np.testing.assert_allclose(results[0].global_model, np.full(MLEN, 0.6), atol=1e-8)
+    assert results[0].round_id == 1 and results[1].round_id == 2
