@@ -165,3 +165,30 @@ def test_local_federation_harness():
     assert len(results) == 2
     np.testing.assert_allclose(results[0].global_model, np.full(MLEN, 0.6), atol=1e-8)
     assert results[0].round_id == 1 and results[1].round_id == 2
+
+
+def test_ten_round_soak():
+    """Ten consecutive rounds: no drift in round ids, seeds, or averages."""
+    import numpy as np
+
+    from xaynet_tpu.sdk.api import ParticipantABC
+    from xaynet_tpu.sdk.federation import LocalFederation
+
+    MLEN = 5
+
+    class Const(ParticipantABC):
+        def __init__(self, v):
+            self.v = v
+
+        def train_round(self, training_input):
+            return np.full(MLEN, self.v, dtype=np.float32)
+
+    fed = LocalFederation(model_length=MLEN, n_sum=1, n_update=3)
+    trainers = [Const(0.0), Const(-0.9), Const(0.3), Const(0.9)]
+    try:
+        results = list(fed.rounds(trainers, n_rounds=10, round_timeout=60))
+    finally:
+        fed.stop()
+    assert [r.round_id for r in results] == list(range(1, 11))
+    for r in results:
+        np.testing.assert_allclose(r.global_model, np.full(MLEN, 0.1), atol=1e-8)

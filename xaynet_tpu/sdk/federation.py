@@ -45,8 +45,9 @@ from ..storage.memory import (
     NoOpTrustAnchor,
 )
 from ..storage.traits import Store
-from .api import ParticipantABC, spawn_participant
+from .api import ParticipantABC
 from .client import HttpClient
+from .participant import Participant
 from .simulation import keys_for_task
 
 
@@ -134,7 +135,6 @@ class LocalFederation:
         if len(trainers) < self.n_sum + self.n_update:
             raise ValueError("need at least n_sum + n_update trainers")
         last_seed: Optional[bytes] = None
-        last_model: Optional[np.ndarray] = None
         for round_no in range(n_rounds):
             t0 = time.time()
             params = self._sync(self._probe.get_round_params())
@@ -143,24 +143,42 @@ class LocalFederation:
                 params = self._sync(self._probe.get_round_params())
             seed = params.seed.as_bytes()
 
+            # Deterministic, single-threaded drive: fresh role-pinned
+            # participants each round (participants from prior rounds are
+            # dropped, so re-drawn eligibility can never steal round slots).
+            members: list[tuple[Participant, ParticipantABC]] = []
             for i in range(self.n_sum):
                 keys = keys_for_task(seed, self.sum_prob, self.update_prob, "sum", start=i * 1000)
-                self._threads.append(
-                    _spawn_instance(self.url, trainers[i], keys=keys)
-                )
+                members.append((Participant(self.url, keys=keys), trainers[i]))
             for i in range(self.n_update):
                 keys = keys_for_task(
                     seed, self.sum_prob, self.update_prob, "update", start=(1000 + i) * 1000
                 )
-                trainer = trainers[self.n_sum + (round_no * self.n_update + i) % (len(trainers) - self.n_sum)]
-                self._threads.append(
-                    _spawn_instance(
-                        self.url, trainer, keys=keys, scalar=Fraction(1, self.n_update)
+                trainer = trainers[
+                    self.n_sum + (round_no * self.n_update + i) % (len(trainers) - self.n_sum)
+                ]
+                members.append(
+                    (
+                        Participant(self.url, keys=keys, scalar=Fraction(1, self.n_update)),
+                        trainer,
                     )
                 )
 
+            global_model = self._sync(self._probe.get_model())
             deadline = time.time() + round_timeout
             while time.time() < deadline:
+                progressed = False
+                for participant, trainer in members:
+                    participant.tick()
+                    progressed = progressed or participant.made_progress()
+                    if participant.should_set_model() and trainer.participate_in_update_task():
+                        training_input = (
+                            trainer.deserialize_training_input(global_model)
+                            if global_model is not None
+                            else None
+                        )
+                        result = trainer.train_round(training_input)
+                        participant.set_model(trainer.serialize_training_result(result))
                 model = self._sync(self._probe.get_model())
                 fresh = self._sync(self._probe.get_round_params())
                 # the next round's parameters only appear after this round's
@@ -168,30 +186,22 @@ class LocalFederation:
                 # are legal, so the model itself is no progress signal)
                 if model is not None and fresh.seed.as_bytes() != seed:
                     break
-                time.sleep(0.05)
+                if not progressed:
+                    time.sleep(0.05)
             else:
                 raise TimeoutError(f"round {round_no + 1} did not complete")
             last_seed = seed
-            last_model = np.asarray(model)  # noqa: F841 — kept for debugging
+            for trainer in {id(t): t for _, t in members}.values():
+                trainer.on_new_global_model(trainer.deserialize_training_input(np.asarray(model)))
             yield RoundResult(
-                round_id=round_no + 1, global_model=last_model, wall_seconds=time.time() - t0
+                round_id=round_no + 1,
+                global_model=np.asarray(model),
+                wall_seconds=time.time() - t0,
             )
 
     def global_model(self) -> Optional[np.ndarray]:
         return self._sync(self._probe.get_model())
 
     def stop(self) -> None:
-        for t in self._threads:
-            try:
-                t.stop()
-            except Exception:
-                pass
+        """The coordinator thread is a daemon; nothing else to stop."""
         self._threads.clear()
-
-
-def _spawn_instance(url: str, trainer: ParticipantABC, keys, scalar: Fraction = Fraction(1)):
-    from .api import InternalParticipant
-
-    thread = InternalParticipant(url, trainer, state=None, scalar=scalar, keys=keys)
-    thread.start()
-    return thread
