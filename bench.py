@@ -81,17 +81,48 @@ def main() -> None:
     stack = jax.device_put(host_stack)
     del host_stack
 
+    # candidate kernels: XLA fold and (on real accelerators) the Pallas fold;
+    # calibrate quickly and measure with the faster one
+    candidates = {"xla": lambda a, s: fold_planar_batch(a, s, order)}
+    if on_tpu:
+        try:
+            from xaynet_tpu.ops.fold_pallas import fold_planar_batch_pallas
+
+            candidates["pallas"] = lambda a, s: fold_planar_batch_pallas(a, s, order)
+        except Exception:
+            pass
+
+    def calibrate(fn):
+        acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+        acc = fn(acc, stack)  # compile
+        _sync(acc)
+        t0 = time.perf_counter()
+        for _ in range(2):
+            acc = fn(acc, stack)
+        _sync(acc)
+        return time.perf_counter() - t0
+
+    timings = {}
+    for name, fn in candidates.items():
+        try:
+            timings[name] = calibrate(fn)
+        except Exception as e:  # a kernel variant failing must not sink the bench
+            print(f"kernel {name} unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+    best = min(timings, key=timings.get)
+    fold = candidates[best]
+    print(f"kernel selection: {timings} -> {best}", file=sys.stderr)
+
     acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
-    acc = fold_planar_batch(acc, stack, order)  # compile
+    acc = fold(acc, stack)  # compile against the zeroed accumulator shape
     _sync(acc)
 
     for _ in range(warmup):
-        acc = fold_planar_batch(acc, stack, order)
+        acc = fold(acc, stack)
     _sync(acc)
 
     t0 = time.perf_counter()
     for _ in range(n_batches):
-        acc = fold_planar_batch(acc, stack, order)
+        acc = fold(acc, stack)
     _sync(acc)
     dt = time.perf_counter() - t0
 

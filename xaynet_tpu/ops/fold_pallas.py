@@ -108,18 +108,24 @@ def _fold_kernel(acc_ref, stack_ref, out_ref, *, k: int, n_limb: int, order: int
 
 @partial(jax.jit, static_argnames=("order", "interpret"), donate_argnums=(0,))
 def fold_planar_batch_pallas(acc, stack_planar, order: int, interpret: bool = False):
-    """Pallas version of ``fold_jax.fold_planar_batch`` (same contract)."""
+    """Pallas version of ``fold_jax.fold_planar_batch`` (same contract).
+
+    Model lengths that don't divide the tile are zero-padded internally
+    (zeros are valid group elements) and sliced back afterwards.
+    """
     k, n_limb, n = stack_planar.shape
     if k > MAX_LAZY_BATCH:
         raise ValueError(f"batch of {k} exceeds lazy-carry headroom {MAX_LAZY_BATCH}")
     tile = min(TILE, n)
-    if n % tile != 0:
-        # shapes are padded by the aggregator; guard anyway
-        raise ValueError(f"model axis {n} not divisible by tile {tile}")
-    grid = (n // tile,)
-    return pl.pallas_call(
+    padded_n = -(-n // tile) * tile
+    if padded_n != n:
+        pad = padded_n - n
+        acc = jnp.pad(acc, ((0, 0), (0, pad)))
+        stack_planar = jnp.pad(stack_planar, ((0, 0), (0, 0), (0, pad)))
+    grid = (padded_n // tile,)
+    out = pl.pallas_call(
         partial(_fold_kernel, k=k, n_limb=n_limb, order=order),
-        out_shape=jax.ShapeDtypeStruct((n_limb, n), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((n_limb, padded_n), jnp.uint32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((n_limb, tile), lambda i: (0, i)),
@@ -128,3 +134,4 @@ def fold_planar_batch_pallas(acc, stack_planar, order: int, interpret: bool = Fa
         out_specs=pl.BlockSpec((n_limb, tile), lambda i: (0, i)),
         interpret=interpret,
     )(acc, stack_planar)
+    return out[:, :n] if padded_n != n else out
