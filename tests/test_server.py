@@ -380,3 +380,68 @@ def test_round_failure_then_successful_round():
                 pass
 
     asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_storage_fault_routes_to_failure_and_recovers():
+    """A storage backend outage fails the round; the machine recovers once
+    the store is healthy again (reference: failure.rs wait_for_store_readiness)."""
+
+    class FlakyStorage(InMemoryCoordinatorStorage):
+        def __init__(self):
+            super().__init__()
+            self.broken = False
+
+        async def add_sum_participant(self, pk, ephm_pk):
+            if self.broken:
+                raise RuntimeError("backend down")
+            return await super().add_sum_participant(pk, ephm_pk)
+
+        async def is_ready(self):
+            if self.broken:
+                from xaynet_tpu.storage.traits import StorageError
+
+                raise StorageError("backend down")
+
+    async def run():
+        from xaynet_tpu.server.phases import failure as failure_mod
+
+        failure_mod.STORE_READY_RETRY_SECONDS = 0.05
+        flaky = FlakyStorage()
+        store = Store(flaky, InMemoryModelStorage(), NoOpTrustAnchor())
+        settings = _settings(5.0)
+        machine, tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, tx)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while events.phase.get_latest().event.value != "sum":
+                await asyncio.sleep(0.01)
+            params = events.params.get_latest().event
+            keys = keys_for_task(params.seed.as_bytes(), params.sum, params.update, "sum")
+            payload = Sum(
+                sum_signature=keys.sign(params.seed.as_bytes() + b"sum").as_bytes(),
+                ephm_pk=b"\x02" * 32,
+            )
+            msg = Message(participant_pk=keys.public, coordinator_pk=params.pk, payload=payload)
+            wire = PublicEncryptKey(params.pk).encrypt(msg.to_bytes(keys.secret))
+
+            flaky.broken = True
+            with pytest.raises(Exception):
+                await handler.handle_message(wire)
+            # the failing handler crashed the sum phase -> failure -> waits
+            # for store readiness; heal the store and watch the next round
+            start_round = events.params.get_latest().round_id
+            await asyncio.sleep(0.2)
+            flaky.broken = False
+            deadline = asyncio.get_running_loop().time() + 10
+            while events.params.get_latest().round_id <= start_round:
+                assert asyncio.get_running_loop().time() < deadline, "no recovery"
+                await asyncio.sleep(0.02)
+            assert events.phase.get_latest().event.value in ("idle", "sum")
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(asyncio.wait_for(run(), timeout=30))

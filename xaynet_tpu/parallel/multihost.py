@@ -1,0 +1,53 @@
+"""Multi-host mesh initialization (pods over ICI/DCN).
+
+The aggregation kernels are collective-free, so scaling to a multi-host pod
+is purely a placement question: initialize the JAX distributed runtime,
+build one global mesh, and keep using the same sharded aggregator. The
+coordinator process runs on host 0; other hosts run ingest workers feeding
+their local shard (staged work — see docs/ROADMAP.md).
+
+    from xaynet_tpu.parallel.multihost import initialize, global_mesh
+    initialize(coordinator_address="host0:1234", num_processes=4, process_id=i)
+    mesh = global_mesh()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime (no-op for single-process)."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """A 1-D mesh over every device of every host (model-axis sharding)."""
+    return make_mesh(jax.devices())
+
+
+def local_slice(model_length: int) -> tuple[int, int]:
+    """This host's contiguous [start, end) slice of the model axis.
+
+    Ingest workers parse and stage only their slice of each wire update, so
+    host->device traffic stays local to each host's ICI domain.
+    """
+    n_proc = jax.process_count()
+    idx = jax.process_index()
+    per = -(-model_length // n_proc)
+    start = min(idx * per, model_length)
+    return start, min(start + per, model_length)

@@ -209,6 +209,12 @@ class PhaseState:
                 self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
             self._respond(env, err)
             return
+        except Exception as err:
+            # infrastructure failure (e.g. storage outage): resolve the
+            # requester's future before the phase error propagates, or the
+            # client would wait forever on a round that already failed
+            self._respond(env, RequestError(RequestError.Kind.INTERNAL, str(err)))
+            raise
         counter.accepted += 1
         if self.shared.metrics is not None:
             self.shared.metrics.message_accepted(self.shared.round_id, self.NAME.value)
