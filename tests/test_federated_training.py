@@ -192,3 +192,32 @@ def test_ten_round_soak():
     assert [r.round_id for r in results] == list(range(1, 11))
     for r in results:
         np.testing.assert_allclose(r.global_model, np.full(MLEN, 0.1), atol=1e-8)
+
+
+def test_moderate_scale_round():
+    """33 participants in one round (3 sum + 30 update) with exact averaging."""
+    import numpy as np
+
+    from xaynet_tpu.sdk.api import ParticipantABC
+    from xaynet_tpu.sdk.federation import LocalFederation
+
+    MLEN = 32
+    N_SUM, N_UPD = 3, 30
+
+    class Const(ParticipantABC):
+        def __init__(self, v):
+            self.v = v
+
+        def train_round(self, training_input):
+            return np.full(MLEN, self.v, dtype=np.float32)
+
+    values = [round(-0.9 + 0.06 * i, 6) for i in range(N_UPD)]
+    trainers = [Const(0.0)] * N_SUM + [Const(v) for v in values]
+    fed = LocalFederation(model_length=MLEN, n_sum=N_SUM, n_update=N_UPD)
+    try:
+        (result,) = list(fed.rounds(trainers, n_rounds=1, round_timeout=120))
+    finally:
+        fed.stop()
+    np.testing.assert_allclose(
+        result.global_model, np.full(MLEN, float(np.mean(values))), atol=1e-7
+    )

@@ -215,3 +215,84 @@ def test_round_with_chunked_updates_and_device_aggregation():
 
     got, expected = asyncio.run(asyncio.wait_for(run(), timeout=90))
     np.testing.assert_allclose(got, expected, atol=1e-9)
+
+
+def test_sum_participant_save_restore_mid_round():
+    """A sum participant suspended after Sum resumes and completes Sum2
+    (the ephemeral decryption key must survive serialization)."""
+
+    async def run():
+        settings = _settings()
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, request_tx)
+        fetcher = Fetcher(events)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.01)
+            params = fetcher.round_params()
+            seed = params.seed.as_bytes()
+            rng = np.random.default_rng(7)
+
+            # one extra summer that will be suspended/resumed
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=50_000)
+            suspended = ParticipantSM(
+                PetSettings(keys=keys), InProcessClient(fetcher, handler), ArrayModelStore(None)
+            )
+            # drive it through NewRound + Sum (it sends its ephemeral key)
+            for _ in range(10):
+                await suspended.transition()
+                if suspended.phase.value == "sum2":
+                    break
+            assert suspended.phase.value == "sum2"
+            blob = suspended.save()
+
+            participants = []
+            for i in range(1, N_SUM):
+                k2 = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+                participants.append(
+                    ParticipantSM(PetSettings(keys=k2), InProcessClient(fetcher, handler), ArrayModelStore(None))
+                )
+            expected = np.zeros(MODEL_LEN)
+            for i in range(N_UPDATE):
+                k2 = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(10 + i) * 1000)
+                local = rng.uniform(-1, 1, MODEL_LEN).astype(np.float32)
+                expected += local.astype(np.float64) / N_UPDATE
+                participants.append(
+                    ParticipantSM(
+                        PetSettings(keys=k2, scalar=Fraction(1, N_UPDATE)),
+                        InProcessClient(fetcher, handler),
+                        ArrayModelStore(local),
+                    )
+                )
+
+            # resume the suspended summer in a "new process"
+            resumed = ParticipantSM.restore(
+                blob, InProcessClient(fetcher, handler), ArrayModelStore(None)
+            )
+            assert resumed.phase.value == "sum2"
+            participants.append(resumed)
+
+            async def drive(sm):
+                for _ in range(500):
+                    try:
+                        await sm.transition()
+                    except Exception:
+                        pass
+                    if fetcher.model() is not None:
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(*(drive(p) for p in participants))
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            np.testing.assert_allclose(np.asarray(fetcher.model()), expected, atol=1e-9)
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
