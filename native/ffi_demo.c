@@ -1,0 +1,88 @@
+/* Demo host program for the C FFI: drives a PET participant from C.
+ *
+ * Usage: ffi_demo <coordinator_url> <repo_path>
+ *
+ * Creates a participant, ticks it a few times against the coordinator,
+ * reports task/progress, exercises set_model and save/restore, and prints
+ * one status line per step (consumed by tests/test_ffi.py).
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef struct XnParticipant XnParticipant;
+
+extern int xaynet_ffi_init(const char* repo_path);
+extern uint32_t xaynet_ffi_abi_version(void);
+extern XnParticipant* xaynet_ffi_participant_new(const char* url);
+extern XnParticipant* xaynet_ffi_participant_restore(const char* url, const uint8_t* state,
+                                                     size_t state_len);
+extern int xaynet_ffi_participant_tick(XnParticipant* p);
+extern int xaynet_ffi_participant_made_progress(XnParticipant* p);
+extern int xaynet_ffi_participant_should_set_model(XnParticipant* p);
+extern int xaynet_ffi_participant_task(XnParticipant* p);
+extern int xaynet_ffi_participant_set_model(XnParticipant* p, const float* w, size_t len);
+extern long xaynet_ffi_participant_global_model(XnParticipant* p, float* out, size_t cap);
+extern long xaynet_ffi_participant_save(XnParticipant* p, uint8_t* out, size_t cap);
+extern void xaynet_ffi_participant_destroy(XnParticipant* p);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <coordinator_url> <repo_path>\n", argv[0]);
+    return 2;
+  }
+  if (xaynet_ffi_init(argv[2]) != 0) {
+    fprintf(stderr, "init failed\n");
+    return 1;
+  }
+  printf("abi=%u\n", xaynet_ffi_abi_version());
+
+  XnParticipant* p = xaynet_ffi_participant_new(argv[1]);
+  if (!p) {
+    fprintf(stderr, "participant_new failed\n");
+    return 1;
+  }
+
+  for (int i = 0; i < 5; i++) {
+    if (xaynet_ffi_participant_tick(p) != 0) {
+      fprintf(stderr, "tick failed\n");
+      return 1;
+    }
+    printf("tick=%d progress=%d task=%d should_set_model=%d\n", i,
+           xaynet_ffi_participant_made_progress(p), xaynet_ffi_participant_task(p),
+           xaynet_ffi_participant_should_set_model(p));
+  }
+
+  float model[4] = {0.1f, 0.2f, 0.3f, 0.4f};
+  if (xaynet_ffi_participant_set_model(p, model, 4) != 0) {
+    fprintf(stderr, "set_model failed\n");
+    return 1;
+  }
+  printf("set_model=ok\n");
+
+  long n = xaynet_ffi_participant_global_model(p, NULL, 0);
+  printf("global_model_len=%ld\n", n);
+
+  uint8_t state[4096];
+  long len = xaynet_ffi_participant_save(p, state, sizeof(state));
+  if (len <= 0) {
+    fprintf(stderr, "save failed: %ld\n", len);
+    return 1;
+  }
+  printf("saved=%ld\n", len);
+
+  XnParticipant* q = xaynet_ffi_participant_restore(argv[1], state, (size_t)len);
+  if (!q) {
+    fprintf(stderr, "restore failed\n");
+    return 1;
+  }
+  if (xaynet_ffi_participant_tick(q) != 0) {
+    fprintf(stderr, "tick after restore failed\n");
+    return 1;
+  }
+  printf("restored_tick=ok task=%d\n", xaynet_ffi_participant_task(q));
+  xaynet_ffi_participant_destroy(q);
+  printf("done\n");
+  return 0;
+}

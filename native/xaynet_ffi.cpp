@@ -1,0 +1,237 @@
+// C FFI for embedding a PET participant in non-python hosts.
+//
+// Functional analogue of the reference's mobile FFI surface (reference:
+// rust/xaynet-mobile/src/ffi/ — xaynet_ffi_participant_{new,tick,set_model,
+// global_model,save,restore,destroy} and error codes). The participant
+// logic lives in the python package; this library embeds a CPython
+// interpreter and drives `xaynet_tpu.sdk.participant.Participant`, so a
+// C/C++/Dart host links one shared library and needs no python code of its
+// own (a python runtime with the package installed must be present).
+//
+// Thread-model: all calls must come from one thread (the embedded
+// interpreter owns the participant; the reference has the same
+// single-caller contract for its tick loop).
+//
+// Build:  make -C native ffi    ->  libxaynet_ffi.so
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+#define XN_EXPORT extern "C" __attribute__((visibility("default")))
+
+// error codes (shape mirrors the reference's 0..n_ codes)
+enum {
+  XN_OK = 0,
+  XN_ERR_INIT = 1,
+  XN_ERR_NULL = 2,
+  XN_ERR_PYTHON = 3,
+  XN_ERR_BUFFER_TOO_SMALL = 4,
+};
+
+namespace {
+
+bool g_initialized = false;
+
+struct XnParticipant {
+  PyObject* obj;  // xaynet_tpu.sdk.participant.Participant
+};
+
+int clear_error() {
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    return XN_ERR_PYTHON;
+  }
+  return XN_OK;
+}
+
+PyObject* participant_class() {
+  PyObject* mod = PyImport_ImportModule("xaynet_tpu.sdk.participant");
+  if (!mod) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(mod, "Participant");
+  Py_DECREF(mod);
+  return cls;
+}
+
+}  // namespace
+
+// Initialize the embedded interpreter. `repo_path` (optional, may be NULL)
+// is prepended to sys.path so the package resolves without installation.
+XN_EXPORT int xaynet_ffi_init(const char* repo_path) {
+  if (g_initialized) return XN_OK;
+  Py_Initialize();
+  if (repo_path && *repo_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_path);
+    if (sys_path && p) PyList_Insert(sys_path, 0, p);
+    Py_XDECREF(p);
+  }
+  g_initialized = true;
+  return clear_error();
+}
+
+// Create a participant for the coordinator at `url`. Returns NULL on error.
+XN_EXPORT XnParticipant* xaynet_ffi_participant_new(const char* url) {
+  if (!g_initialized || !url) return nullptr;
+  PyObject* cls = participant_class();
+  if (!cls) {
+    clear_error();
+    return nullptr;
+  }
+  PyObject* obj = PyObject_CallFunction(cls, "s", url);
+  Py_DECREF(cls);
+  if (!obj) {
+    clear_error();
+    return nullptr;
+  }
+  auto* p = new XnParticipant{obj};
+  return p;
+}
+
+// Restore a participant from a saved state blob. Returns NULL on error.
+XN_EXPORT XnParticipant* xaynet_ffi_participant_restore(const char* url,
+                                                        const uint8_t* state,
+                                                        size_t state_len) {
+  if (!g_initialized || !url || !state) return nullptr;
+  PyObject* cls = participant_class();
+  if (!cls) {
+    clear_error();
+    return nullptr;
+  }
+  PyObject* restore = PyObject_GetAttrString(cls, "restore");
+  Py_DECREF(cls);
+  if (!restore) {
+    clear_error();
+    return nullptr;
+  }
+  PyObject* obj = PyObject_CallFunction(restore, "y#s", (const char*)state,
+                                        (Py_ssize_t)state_len, url);
+  Py_DECREF(restore);
+  if (!obj) {
+    clear_error();
+    return nullptr;
+  }
+  return new XnParticipant{obj};
+}
+
+// One state-machine transition.
+XN_EXPORT int xaynet_ffi_participant_tick(XnParticipant* p) {
+  if (!p) return XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "tick", nullptr);
+  Py_XDECREF(r);
+  return clear_error();
+}
+
+// 1 if the last tick made progress, 0 if pending, negative on error.
+XN_EXPORT int xaynet_ffi_participant_made_progress(XnParticipant* p) {
+  if (!p) return -XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "made_progress", nullptr);
+  if (!r) return -clear_error();
+  int v = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return v;
+}
+
+// 1 if the FSM wants a trained model, 0 otherwise, negative on error.
+XN_EXPORT int xaynet_ffi_participant_should_set_model(XnParticipant* p) {
+  if (!p) return -XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "should_set_model", nullptr);
+  if (!r) return -clear_error();
+  int v = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return v;
+}
+
+// Current task: 0 none, 1 sum, 2 update; negative on error.
+XN_EXPORT int xaynet_ffi_participant_task(XnParticipant* p) {
+  if (!p) return -XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "task", nullptr);
+  if (!r) return -clear_error();
+  PyObject* v = PyObject_GetAttrString(r, "value");
+  Py_DECREF(r);
+  if (!v) return -clear_error();
+  const char* s = PyUnicode_AsUTF8(v);
+  int code = 0;
+  if (s && strcmp(s, "sum") == 0) code = 1;
+  if (s && strcmp(s, "update") == 0) code = 2;
+  Py_DECREF(v);
+  return code;
+}
+
+// Provide the locally trained model (float32 weights).
+XN_EXPORT int xaynet_ffi_participant_set_model(XnParticipant* p, const float* weights,
+                                               size_t len) {
+  if (!p || !weights) return XN_ERR_NULL;
+  PyObject* list = PyList_New((Py_ssize_t)len);
+  if (!list) return clear_error();
+  for (size_t i = 0; i < len; i++) {
+    PyList_SET_ITEM(list, (Py_ssize_t)i, PyFloat_FromDouble((double)weights[i]));
+  }
+  PyObject* r = PyObject_CallMethod(p->obj, "set_model", "O", list);
+  Py_DECREF(list);
+  Py_XDECREF(r);
+  return clear_error();
+}
+
+// Fetch the latest global model into `out` (float32). Returns the model
+// length, 0 when no model is available, or a negative error code. When the
+// buffer is too small, returns -XN_ERR_BUFFER_TOO_SMALL.
+XN_EXPORT long xaynet_ffi_participant_global_model(XnParticipant* p, float* out,
+                                                   size_t capacity) {
+  if (!p) return -XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "global_model", nullptr);
+  if (!r) return -clear_error();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return 0;
+  }
+  PyObject* seq = PySequence_Fast(r, "global model is not a sequence");
+  Py_DECREF(r);
+  if (!seq) return -clear_error();
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (out == nullptr || (size_t)n > capacity) {
+    Py_DECREF(seq);
+    return out == nullptr ? (long)n : -(long)XN_ERR_BUFFER_TOO_SMALL;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    out[i] = (float)PyFloat_AsDouble(PySequence_Fast_GET_ITEM(seq, i));
+  }
+  Py_DECREF(seq);
+  if (PyErr_Occurred()) return -clear_error();
+  return (long)n;
+}
+
+// Serialize the participant into `out`; the instance is consumed (mirrors
+// the reference's move semantics). Returns the state length or negative.
+XN_EXPORT long xaynet_ffi_participant_save(XnParticipant* p, uint8_t* out,
+                                           size_t capacity) {
+  if (!p) return -XN_ERR_NULL;
+  PyObject* r = PyObject_CallMethod(p->obj, "save", nullptr);
+  if (!r) return -clear_error();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    return -clear_error();
+  }
+  if (out != nullptr && (size_t)n <= capacity) {
+    memcpy(out, buf, (size_t)n);
+  }
+  long result = (out == nullptr || (size_t)n <= capacity)
+                    ? (long)n
+                    : -(long)XN_ERR_BUFFER_TOO_SMALL;
+  Py_DECREF(r);
+  Py_DECREF(p->obj);
+  delete p;
+  return result;
+}
+
+XN_EXPORT void xaynet_ffi_participant_destroy(XnParticipant* p) {
+  if (!p) return;
+  Py_XDECREF(p->obj);
+  delete p;
+}
+
+XN_EXPORT uint32_t xaynet_ffi_abi_version(void) { return 1; }

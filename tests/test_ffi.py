@@ -1,0 +1,76 @@
+"""C FFI: a pure-C host drives a participant against a live coordinator."""
+
+import asyncio
+import os
+import subprocess
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "native", "ffi_demo")
+
+
+def _build_demo() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", os.path.join(REPO, "native"), "ffi", "ffi_demo"],
+            check=True,
+            capture_output=True,
+            timeout=180,
+        )
+        return os.path.exists(DEMO)
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _build_demo(), reason="C toolchain/libpython unavailable")
+
+
+def _start_coordinator():
+    from xaynet_tpu.server.rest import RestServer
+    from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+    from xaynet_tpu.server.settings import Settings
+    from xaynet_tpu.server.state_machine import StateMachineInitializer
+    from xaynet_tpu.storage.memory import (
+        InMemoryCoordinatorStorage,
+        InMemoryModelStorage,
+        NoOpTrustAnchor,
+    )
+    from xaynet_tpu.storage.traits import Store
+
+    settings = Settings.default()
+    settings.model.length = 4
+    info, started = {}, threading.Event()
+
+    def run():
+        async def main():
+            store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+            machine, tx, events = await StateMachineInitializer(settings, store).init()
+            rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
+            host, port = await rest.start("127.0.0.1", 0)
+            info["url"] = f"http://{host}:{port}"
+            started.set()
+            await machine.run()
+
+        asyncio.run(main())
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return info["url"]
+
+
+def test_c_host_drives_participant():
+    url = _start_coordinator()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XAYNET_TPU_NO_NATIVE="")
+    result = subprocess.run(
+        [DEMO, url, REPO], capture_output=True, text=True, timeout=120, env=env
+    )
+    assert result.returncode == 0, result.stderr[-800:]
+    out = result.stdout
+    assert "abi=1" in out
+    assert "tick=4" in out
+    assert "set_model=ok" in out
+    assert "saved=" in out
+    assert "restored_tick=ok" in out
+    assert "done" in out
