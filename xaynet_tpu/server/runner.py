@@ -33,7 +33,16 @@ logger = logging.getLogger("xaynet.coordinator")
 
 
 def init_store(settings: Settings) -> Store:
-    coordinator = InMemoryCoordinatorStorage()
+    if settings.storage.coordinator == "redis":
+        from ..storage.redis import RedisCoordinatorStorage
+
+        coordinator = RedisCoordinatorStorage(
+            host=settings.storage.redis_host,
+            port=settings.storage.redis_port,
+            db=settings.storage.redis_db,
+        )
+    else:
+        coordinator = InMemoryCoordinatorStorage()
     if settings.storage.backend == "filesystem":
         models = FilesystemModelStorage(settings.storage.model_dir)
     else:

@@ -105,8 +105,13 @@ class ApiSettings:
 
 @dataclass
 class StorageSettings:
-    backend: str = "memory"  # memory | filesystem
+    backend: str = "memory"  # memory | filesystem (models) ...
     model_dir: str = "./global_models"
+    # coordinator dictionary backend: memory | redis
+    coordinator: str = "memory"
+    redis_host: str = "127.0.0.1"
+    redis_port: int = 6379
+    redis_db: int = 0
 
 
 @dataclass
@@ -247,6 +252,10 @@ class Settings:
             storage=StorageSettings(
                 backend=str(storage_raw.get("backend", base.storage.backend)),
                 model_dir=str(storage_raw.get("model_dir", base.storage.model_dir)),
+                coordinator=str(storage_raw.get("coordinator", base.storage.coordinator)),
+                redis_host=str(storage_raw.get("redis_host", base.storage.redis_host)),
+                redis_port=int(storage_raw.get("redis_port", base.storage.redis_port)),
+                redis_db=int(storage_raw.get("redis_db", base.storage.redis_db)),
             ),
             restore=RestoreSettings(enable=bool(restore_raw.get("enable", False))),
             metrics=MetricsSettings(
