@@ -1,0 +1,66 @@
+"""Primitive conversion edges (reference parity: model.rs / scalar.rs)."""
+
+import math
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.mask import DataType, MaskConfigPair, Model, PrimitiveCastError
+from xaynet_tpu.core.mask.config import BoundType, GroupType, MaskConfig, ModelType
+from xaynet_tpu.core.mask.model import Scalar
+
+
+def test_from_primitives_rejects_non_finite():
+    with pytest.raises(PrimitiveCastError):
+        Model.from_primitives([1.0, float("inf")], DataType.F32)
+    with pytest.raises(PrimitiveCastError):
+        Model.from_primitives([float("nan")], DataType.F64)
+
+
+def test_from_primitives_bounded_clamps():
+    m = Model.from_primitives_bounded(
+        [float("inf"), float("-inf"), float("nan"), 1.5], DataType.F32
+    )
+    fmax = Fraction(float(np.finfo(np.float32).max))
+    assert m[0] == fmax
+    assert m[1] == -fmax
+    assert m[2] == 0
+    assert m[3] == Fraction(1.5)
+
+
+def test_into_primitives_roundtrip_exactness():
+    vals = [-1.25, 0.0, 0.1, 123.456]
+    m = Model.from_primitives(vals, DataType.F32)
+    back = m.into_primitives(DataType.F32)
+    assert back == [float(np.float32(v)) for v in vals]
+
+    ints = [-(2**31), 2**31 - 1, 0, 42]
+    mi = Model.from_primitives(ints, DataType.I32)
+    assert mi.into_primitives(DataType.I32) == ints
+
+
+def test_scalar_bounded_conversion():
+    assert Scalar.from_float_bounded(float("nan")).value == 0
+    assert Scalar.from_float_bounded(-3.0).value == 0
+    assert Scalar.from_float_bounded(float("inf")).value == Fraction(
+        float(np.finfo(np.float64).max)
+    )
+    with pytest.raises(ValueError):
+        Scalar.from_float(float("inf"))
+    with pytest.raises(ValueError):
+        Scalar.from_float(-1.0)
+
+
+def test_mask_config_pair_wire_roundtrip():
+    pair = MaskConfigPair(
+        vect=MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3),
+        unit=MaskConfig(GroupType.INTEGER, DataType.F64, BoundType.B6, ModelType.M9),
+    )
+    assert MaskConfigPair.from_bytes(pair.to_bytes()) == pair
+
+
+def test_model_array_bridges():
+    arr = np.asarray([0.5, -0.25, 0.125], dtype=np.float32)
+    m = Model.from_array(arr)
+    np.testing.assert_array_equal(m.to_array(DataType.F32), arr)

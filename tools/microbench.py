@@ -1,0 +1,96 @@
+"""Micro-benchmarks mirroring the reference's criterion harness.
+
+The reference benches model<->primitive conversion at 4B/100kB/1MB and
+update-message serde at sizes up to ~10MB with 10k-entry seed dicts
+(reference: rust/benches/). This prints the same matrix for this
+implementation so regressions in the host paths are visible over commits.
+
+Run:  python tools/microbench.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair
+from xaynet_tpu.core.crypto.prng import StreamSampler
+from xaynet_tpu.core.crypto.sign import SigningKeyPair
+from xaynet_tpu.core.mask import (
+    BoundType,
+    DataType,
+    GroupType,
+    Masker,
+    MaskConfig,
+    MaskObject,
+    MaskSeed,
+    MaskUnit,
+    MaskVect,
+    ModelType,
+    Scalar,
+)
+from xaynet_tpu.core.mask.serialization import parse_mask_object, serialize_mask_object
+from xaynet_tpu.core.message import Message, Update
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+
+
+def timeit(label: str, fn, repeat: int = 3) -> None:
+    best = min(_once(fn) for _ in range(repeat))
+    print(f"{label:<56} {best * 1e3:10.2f} ms")
+
+
+def _once(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def masked_object(n: int) -> MaskObject:
+    sampler = StreamSampler(b"\x05" * 32)
+    unit = sampler.draw_limbs(1, CFG.order)[0]
+    vect = sampler.draw_limbs(n, CFG.order)
+    return MaskObject(MaskVect(CFG, vect), MaskUnit(CFG, unit))
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+
+    # --- model <-> wire conversion (reference: benches/models/) -----------
+    for n in (1, 25_000, 250_000, 2_500_000):  # ~4B / 100kB / 1MB / 10MB wire
+        w = rng.uniform(-1, 1, n).astype(np.float32)
+        masker = Masker(CFG.pair(), MaskSeed(b"\x01" * 32))
+        timeit(f"mask model (fixed-point + PRNG + mod add), n={n}", lambda: masker.mask(Scalar.unit(), w))
+
+    # --- mask object serde (reference: benches/messages/) -----------------
+    for n in (1, 25_000, 250_000, 2_500_000):
+        obj = masked_object(n)
+        wire = serialize_mask_object(obj)
+        timeit(f"serialize mask object, n={n} ({len(wire)} B)", lambda: serialize_mask_object(obj))
+        timeit(f"parse mask object, n={n}", lambda: parse_mask_object(wire))
+
+    # --- update message with a 10k-entry seed dict ------------------------
+    keys = SigningKeyPair.generate()
+    ephm = EncryptKeyPair.generate()
+    seed = MaskSeed.generate()
+    enc = seed.encrypt(ephm.public)
+    seed_dict = {i.to_bytes(32, "little"): enc for i in range(10_000)}
+    obj = masked_object(250_000)
+    upd = Update(
+        sum_signature=b"\x01" * 64,
+        update_signature=b"\x02" * 64,
+        masked_model=obj,
+        local_seed_dict=seed_dict,
+    )
+    msg = Message(participant_pk=keys.public, coordinator_pk=b"\x09" * 32, payload=upd)
+    wire = msg.to_bytes(keys.secret)
+    timeit(f"update message serialize+sign ({len(wire)} B, 10k seeds)", lambda: msg.to_bytes(keys.secret))
+    timeit("update message parse+verify", lambda: Message.from_bytes(wire))
+
+
+if __name__ == "__main__":
+    main()
