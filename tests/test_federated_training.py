@@ -37,11 +37,15 @@ FEATURES = (8,)
 
 
 def _start(model_len):
+    # count slack + a short time.min keep the round robust against stopped
+    # participants from the previous round stealing slots (their roles
+    # re-draw on the new seed); the phase stays open long enough for the
+    # pinned participants to register even if a leftover got in first
     settings = Settings(
         pet=PetSettings(
-            sum=PhaseSettings(prob=0.3, count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
-            update=PhaseSettings(prob=0.6, count=CountSettings(N_UPDATE, N_UPDATE), time=TimeSettings(0, 30)),
-            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 30)),
+            sum=PhaseSettings(prob=0.3, count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 30)),
+            update=PhaseSettings(prob=0.6, count=CountSettings(N_UPDATE, N_UPDATE + 3), time=TimeSettings(1.0, 30)),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 30)),
         )
     )
     settings.model.length = model_len
@@ -132,8 +136,14 @@ def test_federated_mlp_learns():
             time.sleep(0.1)
         for t in threads:
             t.stop()
-        # the next round's seed
-        seed = sync(probe.get_round_params()).seed.as_bytes()
+        # the next round's seed (Idle may not have republished params yet
+        # at the moment the model broadcast is observed — wait for it)
+        while True:
+            fresh = sync(probe.get_round_params()).seed.as_bytes()
+            if fresh != seed:
+                seed = fresh
+                break
+            time.sleep(0.05)
 
     assert len(losses) >= 2, f"only {len(losses)} rounds completed"
     assert losses[-1] < losses[0], losses

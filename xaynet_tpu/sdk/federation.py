@@ -112,8 +112,15 @@ class LocalFederation:
             rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
             host, port = await rest.start("127.0.0.1", 0)
             self.url = f"http://{host}:{port}"
+            self._loop = asyncio.get_running_loop()
+            self._machine_task = asyncio.create_task(machine.run())
             self._started.set()
-            await machine.run()
+            try:
+                await self._machine_task
+            except asyncio.CancelledError:
+                pass
+            finally:
+                await rest.stop()
 
         asyncio.run(main())
 
@@ -203,5 +210,10 @@ class LocalFederation:
         return self._sync(self._probe.get_model())
 
     def stop(self) -> None:
-        """The coordinator thread is a daemon; nothing else to stop."""
+        """Stops the coordinator loop (participants are per-round, already gone)."""
+        loop = getattr(self, "_loop", None)
+        task = getattr(self, "_machine_task", None)
+        if loop is not None and task is not None:
+            loop.call_soon_threadsafe(task.cancel)
+            self._runner.join(timeout=5)
         self._threads.clear()
