@@ -19,7 +19,8 @@ from .message import HEADER_LENGTH, Message
 from .payloads import CHUNK_HEADER_LENGTH, Chunk
 
 # minimum sensible ceiling: header + chunk header + 1 byte of progress
-MIN_PAYLOAD_SIZE = CHUNK_HEADER_LENGTH + 1
+# (reference: rust/xaynet-sdk/src/settings/max_message_size.rs:4-80)
+MIN_MESSAGE_SIZE = HEADER_LENGTH + CHUNK_HEADER_LENGTH + 1
 DEFAULT_MAX_MESSAGE_SIZE = 4096
 
 

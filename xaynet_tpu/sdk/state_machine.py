@@ -30,7 +30,7 @@ from ..core.mask.masking import Aggregation, Masker
 from ..core.mask.model import Scalar
 from ..core.mask.object import MaskObject
 from ..core.message import Message, Sum, Sum2, Update
-from ..core.message.encoder import DEFAULT_MAX_MESSAGE_SIZE, MessageEncoder
+from ..core.message.encoder import DEFAULT_MAX_MESSAGE_SIZE, MIN_MESSAGE_SIZE, MessageEncoder
 from .traits import ModelStore, Notify, XaynetClient
 
 logger = logging.getLogger("xaynet.participant")
@@ -66,6 +66,13 @@ class PetSettings:
     # (kept explicit — initializing an accelerator backend inside an edge
     # participant must be the embedder's decision)
     device_sum2: bool = False
+
+    def __post_init__(self):
+        if self.max_message_size is not None and self.max_message_size < MIN_MESSAGE_SIZE:
+            raise ValueError(
+                f"max_message_size must be None or >= {MIN_MESSAGE_SIZE} "
+                "(header + chunk header + 1 byte of progress)"
+            )
 
 
 class StateMachine:

@@ -102,3 +102,21 @@ class JsonlMetrics(Metrics):
 
     def event(self, round_id: int, kind: str, detail: str = "") -> None:
         self._emit("event_" + kind, detail, round_id)
+
+
+class InfluxLineMetrics(JsonlMetrics):
+    """InfluxDB line-protocol sink (append to a file; telegraf/collectors
+    tail it). Same eight measurements as the reference's Influx recorder."""
+
+    def _emit(self, measurement: str, value, round_id: int, phase: str = "") -> None:
+        tags = f",round_id={round_id}"
+        if phase:
+            tags += f",phase={phase}"
+        if isinstance(value, (int, float)):
+            field = f"value={value}"
+        else:
+            escaped = str(value).replace('"', '\\"')
+            field = f'value="{escaped}"'
+        line = f"xaynet_{measurement}{tags} {field} {int(time.time() * 1e9)}"
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")

@@ -23,7 +23,7 @@ from ..storage.memory import (
     NoOpTrustAnchor,
 )
 from ..storage.traits import Store
-from .metrics import JsonlMetrics, LogMetrics
+from .metrics import InfluxLineMetrics, JsonlMetrics, LogMetrics
 from .rest import RestServer
 from .services import Fetcher, PetMessageHandler
 from .settings import Settings
@@ -55,6 +55,8 @@ def init_metrics(settings: Settings):
         return None
     if settings.metrics.sink == "jsonl":
         return JsonlMetrics(settings.metrics.path)
+    if settings.metrics.sink == "influx":
+        return InfluxLineMetrics(settings.metrics.path)
     return LogMetrics()
 
 
