@@ -33,16 +33,20 @@ class StagedAggregator:
         object_size: int,
         device: bool = False,
         batch_size: int = 64,
+        ingest_workers: int = 4,
     ):
         self.config = config
         self.object_size = object_size
         self.batch_size = max(1, batch_size)
-        self._staged_vect: list[np.ndarray] = []
+        self._staged_vect: list = []  # device: futures of planar arrays
         self._staged_unit: list[np.ndarray] = []
         self._count = 0
         self._host = Aggregation(config, object_size)
         self._device = None
+        self._ingest_pool = None
         if device:
+            from concurrent.futures import ThreadPoolExecutor
+
             from ..ops import limbs as limb_ops
             from ..parallel.aggregator import ShardedAggregator
 
@@ -50,6 +54,12 @@ class StagedAggregator:
             # tiny unit part stays on host
             self._unit_acc = np.zeros(
                 limb_ops.n_limbs_for_order(config.unit.order), dtype=np.uint32
+            )
+            # wire->planar transposes overlap across workers: at 25M params
+            # each update is a ~200MB relayout, which would serialize the
+            # ingest path if done at flush time on one thread
+            self._ingest_pool = ThreadPoolExecutor(
+                max_workers=max(1, ingest_workers), thread_name_prefix="xn-ingest"
             )
 
     @property
@@ -79,7 +89,20 @@ class StagedAggregator:
 
     def stage(self, obj: MaskObject) -> None:
         """Stage an update without folding (caller controls flush timing)."""
-        self._staged_vect.append(obj.vect.data)
+        if self._ingest_pool is not None:
+            from ..ops.fold_jax import wire_to_planar
+
+            padded = self._device.padded_length
+
+            def to_planar(data=obj.vect.data):
+                planar = wire_to_planar(data)
+                if planar.shape[1] != padded:
+                    planar = np.pad(planar, ((0, 0), (0, padded - planar.shape[1])))
+                return planar
+
+            self._staged_vect.append(self._ingest_pool.submit(to_planar))
+        else:
+            self._staged_vect.append(obj.vect.data)
         self._staged_unit.append(obj.unit.data)
         self._count += 1
 
@@ -91,12 +114,15 @@ class StagedAggregator:
     def flush(self) -> None:
         if self._count == 0:
             return
-        stack = np.stack(self._staged_vect)
+        stack = None if self._ingest_pool is not None else np.stack(self._staged_vect)
         units = np.stack(self._staged_unit)
         if self._device is not None:
+            import jax
+
             from ..ops import limbs as limb_ops
 
-            self._device.add_batch(stack)
+            planar = np.stack([f.result() for f in self._staged_vect])
+            self._device.add_planar_batch(jax.device_put(planar, self._device._batch_sharding))
             order_limbs = limb_ops.order_limbs_for(self.config.unit.order)
             batch_unit = limb_ops.batch_mod_sum(units[:, None, :], order_limbs)[0]
             self._unit_acc = limb_ops.mod_add(
