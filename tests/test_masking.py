@@ -197,3 +197,42 @@ def test_batch_aggregation_matches_sequential():
 
     assert seq.nb_models == bat.nb_models == k
     assert seq.object == bat.object
+
+
+@pytest.mark.parametrize("model_type", [ModelType.M6, ModelType.M9, ModelType.M12])
+@pytest.mark.parametrize("group", GROUPS)
+def test_masking_roundtrip_larger_capacities(group, model_type):
+    """The M6/M9/M12 capacity tiers round-trip like M3 (bigger orders/limbs)."""
+    config = MaskConfig(group, DataType.F32, BoundType.B2, model_type)
+    rng = random.Random(hash((group, model_type)) & 0xFFFF)
+    n = 8
+    weights = [rng.uniform(-100, 100) for _ in range(n)]
+    model = Model.from_primitives([float(np.float32(w)) for w in weights], DataType.F32)
+    seed, masked = Masker(config.pair()).mask(Scalar.unit(), model)
+    assert masked.is_valid()
+    mask = seed.derive_mask(n, config.pair())
+    unmasked = Aggregation.from_object(masked).unmask(mask)
+    tol = Fraction(1, config.exp_shift)
+    for w, u in zip(model, unmasked):
+        assert abs(w - u) <= tol
+
+
+def test_aggregation_capacity_bound():
+    """validate_aggregation/unmasking enforce max_nb_models (M3 -> 1000)."""
+    from xaynet_tpu.core.mask import AggregationError, UnmaskingError
+    from xaynet_tpu.core.crypto.prng import uniform_ints
+    from xaynet_tpu.core.mask import MaskObject
+
+    config = _config(GroupType.PRIME, DataType.F32, BoundType.B0)
+    ints = uniform_ints(b"\x01" * 32, 4, config.order)
+    obj = MaskObject.new(config.pair(), ints[1:], ints[0])
+    agg = Aggregation(config.pair(), 3)
+    agg.aggregate(obj)
+    agg.nb_models = config.max_nb_models  # at capacity
+    with pytest.raises(AggregationError) as e:
+        agg.validate_aggregation(obj)
+    assert e.value.kind == "TooManyModels"
+    agg.nb_models = config.max_nb_models + 1
+    with pytest.raises(UnmaskingError) as e2:
+        agg.validate_unmasking(obj)
+    assert e2.value.kind == "TooManyModels"
