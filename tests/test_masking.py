@@ -236,3 +236,28 @@ def test_aggregation_capacity_bound():
     with pytest.raises(UnmaskingError) as e2:
         agg.validate_unmasking(obj)
     assert e2.value.kind == "TooManyModels"
+
+
+def test_fast_path_matches_exact_with_scalar():
+    """Non-unit scalars: dd fast encode == exact rational encode."""
+    config = _config(GroupType.PRIME, DataType.F32, BoundType.B4)
+    rng = np.random.default_rng(5)
+    weights32 = rng.uniform(-10_000, 10_000, size=512).astype(np.float32)
+    model = Model.from_primitives(weights32.tolist(), DataType.F32)
+    seed = MaskSeed(b"\x2f" * 32)
+    scalar = Scalar(3, 7)  # awkward rational
+
+    _, fast = Masker(config.pair(), seed).mask(scalar, weights32)
+    _, exact = Masker(config.pair(), seed).mask(scalar, model)
+    assert fast == exact
+
+
+def test_fast_path_clamping_matches_exact():
+    """Weights beyond the bound clamp identically on both paths."""
+    config = _config(GroupType.INTEGER, DataType.F32, BoundType.B0)
+    weights32 = np.asarray([-5.0, -1.0, -0.5, 0.0, 0.5, 1.0, 5.0], dtype=np.float32)
+    model = Model.from_primitives(weights32.tolist(), DataType.F32)
+    seed = MaskSeed(b"\x3c" * 32)
+    _, fast = Masker(config.pair(), seed).mask(Scalar.unit(), weights32)
+    _, exact = Masker(config.pair(), seed).mask(Scalar.unit(), model)
+    assert fast == exact
