@@ -73,3 +73,30 @@ def test_25m_mask_aggregate_unmask():
     t_unmask = time.time() - t0
     print(f"unmask subtract (25M): {t_unmask:.1f}s; total {time.time() - t_all:.1f}s")
     assert unmasked_limbs.shape == (N, n_limb)
+
+
+def test_1m_param_full_round_wall_clock():
+    """Full PET round at 1M parameters through the REST stack (stress)."""
+    import time
+
+    from xaynet_tpu.sdk.api import ParticipantABC
+    from xaynet_tpu.sdk.federation import LocalFederation
+
+    MLEN = 1_000_000
+
+    class Const(ParticipantABC):
+        def __init__(self, v):
+            self.v = v
+
+        def train_round(self, training_input):
+            return np.full(MLEN, self.v, dtype=np.float32)
+
+    fed = LocalFederation(model_length=MLEN, n_sum=1, n_update=3)
+    trainers = [Const(0.0), Const(-0.6), Const(0.0), Const(0.6)]
+    try:
+        t0 = time.time()
+        (result,) = list(fed.rounds(trainers, n_rounds=1, round_timeout=300))
+        print(f"1M-param round wall-clock: {time.time() - t0:.1f}s")
+    finally:
+        fed.stop()
+    np.testing.assert_allclose(result.global_model, np.zeros(MLEN), atol=1e-9)
