@@ -33,6 +33,8 @@ class Metrics:
 
     def masks_total(self, round_id: int, count: int) -> None: ...
 
+    def phase_duration(self, round_id: int, phase: str, seconds: float) -> None: ...
+
     def event(self, round_id: int, kind: str, detail: str = "") -> None: ...
 
 
@@ -57,6 +59,9 @@ class LogMetrics(Metrics):
 
     def masks_total(self, round_id: int, count: int) -> None:
         self._emit("masks_total_number", count, round_id)
+
+    def phase_duration(self, round_id: int, phase: str, seconds: float) -> None:
+        self._emit("phase_duration_seconds", round(seconds, 4), round_id, phase)
 
     def event(self, round_id: int, kind: str, detail: str = "") -> None:
         logger.warning("event %s round_id=%d: %s", kind, round_id, detail)
@@ -99,6 +104,9 @@ class JsonlMetrics(Metrics):
 
     def masks_total(self, round_id: int, count: int) -> None:
         self._emit("masks_total_number", count, round_id)
+
+    def phase_duration(self, round_id: int, phase: str, seconds: float) -> None:
+        self._emit("phase_duration_seconds", round(seconds, 4), round_id, phase)
 
     def event(self, round_id: int, kind: str, detail: str = "") -> None:
         self._emit("event_" + kind, detail, round_id)

@@ -120,15 +120,25 @@ class PhaseState:
 
     async def run_phase(self) -> Optional["PhaseState"]:
         self._announce()
+        t0 = time_mod.monotonic()
         try:
             await self.process()
             await self.purge_outdated_requests()
         except (PhaseError, ChannelClosed) as err:
+            self._record_duration(t0)
             return await self._into_failure(err)
         except Exception as err:  # storage or internal errors
+            self._record_duration(t0)
             return await self._into_failure(PhaseError(type(err).__name__, str(err)))
+        self._record_duration(t0)
         self.broadcast()
         return await self.next()
+
+    def _record_duration(self, t0: float) -> None:
+        if self.shared.metrics is not None and hasattr(self.shared.metrics, "phase_duration"):
+            self.shared.metrics.phase_duration(
+                self.shared.round_id, self.NAME.value, time_mod.monotonic() - t0
+            )
 
     async def _into_failure(self, err: Exception) -> "PhaseState":
         from .failure import Failure

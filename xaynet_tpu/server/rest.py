@@ -122,6 +122,12 @@ class RestServer:
                     json.dumps({k.hex(): v.as_bytes().hex() for k, v in seeds.items()}).encode(),
                     "application/json",
                 )
+            if method == "GET" and path == "/health":
+                body = json.dumps(
+                    {"phase": self.fetcher.phase().value,
+                     "round_id": self.fetcher.events.params.get_latest().round_id}
+                ).encode()
+                return 200, body, "application/json"
             if method == "GET" and path == "/model":
                 model = self.fetcher.model()
                 if model is None:
