@@ -9,7 +9,7 @@ aggregation is carried forward to Unmask.
 from __future__ import annotations
 
 from ..aggregation import StagedAggregator
-from ..events import PhaseName
+from ..events import DictionaryUpdate, PhaseName
 from ..requests import RequestError, StateMachineRequest, Sum2Request
 from .base import PhaseState
 
@@ -23,6 +23,12 @@ class Sum2Phase(PhaseState):
 
     async def process(self) -> None:
         await self.process_requests(self.shared.settings.pet.sum2)
+
+    def broadcast(self) -> None:
+        # the round's dictionaries are spent once the masks are in
+        # (reference: sum2.rs invalidates the dicts on exit)
+        self.shared.events.broadcast_sum_dict(DictionaryUpdate.invalidate())
+        self.shared.events.broadcast_seed_dict(DictionaryUpdate.invalidate())
 
     async def next(self):
         from .unmask import Unmask
