@@ -124,3 +124,55 @@ def test_spawn_participants_two_rounds():
 
     for t in threads:
         t.stop()
+
+
+def test_async_participant_round():
+    """AsyncParticipant: queue a model any time, receive the global model."""
+    from xaynet_tpu.sdk.api import spawn_async_participant
+    from xaynet_tpu.sdk.participant import Participant
+
+    url = _start_coordinator()
+    probe = HttpClient(url)
+
+    def sync(coro):
+        return asyncio.run(asyncio.wait_for(coro, 20))
+
+    for _ in range(200):
+        try:
+            params = sync(probe.get_round_params())
+            break
+        except Exception:
+            time.sleep(0.05)
+    seed = params.seed.as_bytes()
+
+    # role-pinned summer driven manually; async updaters
+    sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=0)
+    summer = Participant(url, keys=sum_keys)
+
+    handles = []
+    for i in range(N_UPDATE):
+        keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(70 + i) * 1000)
+        h = spawn_async_participant(url, scalar=Fraction(1, N_UPDATE))
+        # the async API takes the model whenever the caller has one
+        h._inner._sm.keys = keys  # pin role for the simulation
+        h._inner._sm.round_params = None  # re-evaluate with pinned keys
+        h.set_model(np.full(MODEL_LEN, float(i + 1), dtype=np.float32))
+        handles.append(h)
+
+    deadline = time.time() + 45
+    model = None
+    while time.time() < deadline:
+        summer.tick()
+        model = sync(probe.get_model())
+        if model is not None:
+            break
+        time.sleep(0.05)
+    assert model is not None
+    np.testing.assert_allclose(model, np.full(MODEL_LEN, 2.0), atol=1e-8)
+
+    # the async handle surfaces the new global model
+    got = handles[0].get_global_model(timeout=20)
+    assert got is not None
+    np.testing.assert_allclose(got, model)
+    for h in handles:
+        h.stop()
