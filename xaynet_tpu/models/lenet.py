@@ -1,5 +1,8 @@
 """LeNet-style CNN — baseline config #2 (CIFAR-10, 100 participants).
 
+Baseline analogue: BASELINE.md config #2 (the reference exposes models
+through its python SDK; this family is the CIFAR-10 equivalent).
+
 Convolutions run on the MXU; the local step is fully jittable and the
 parameter vector plugs straight into the masking pipeline via
 ``flatten_params``.

@@ -1,5 +1,7 @@
 """Federated LoRA adapters — baseline config #5 (stretch).
 
+Baseline analogue: BASELINE.md config #5.
+
 Instead of masking a full LLM, each participant trains low-rank adapters
 (A: [d, r], B: [r, k]) over frozen base weights and federates only the
 adapter deltas. The deltas are quantized to int32 fixed-point before

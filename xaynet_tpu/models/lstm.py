@@ -1,5 +1,7 @@
 """Character-level LSTM — baseline config #3 (LEAF-Shakespeare shaped).
 
+Baseline analogue: BASELINE.md config #3.
+
 Next-character prediction over an 80-symbol vocabulary (the LEAF benchmark
 shape): embedding -> 2-layer LSTM (via ``flax.linen.scan`` — compiler-
 friendly sequence recurrence, no python loops under jit) -> projection.

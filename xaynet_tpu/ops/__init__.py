@@ -1,0 +1,12 @@
+"""Compute kernels for the PET hot loops.
+
+Host (numpy, the conformance oracle) and device (JAX/XLA + Pallas)
+implementations of what the reference runs as sequential big-int loops
+(reference: rust/xaynet-core/src/mask/masking.rs, crypto/prng.rs):
+
+- ``limbs`` / ``limbs_jax`` — modular limb arithmetic
+- ``fold_jax`` / ``fold_pallas`` — single-pass lazy-carry batch aggregation
+- ``chacha_jax`` — device ChaCha20 mask expansion
+- ``masking_jax`` — protocol-level device ops (derive/sum masks, unmask)
+- ``dd`` — vectorized double-double arithmetic for fixed-point codecs
+"""

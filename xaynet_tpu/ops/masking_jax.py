@@ -1,5 +1,10 @@
 """Device-side masking operations: mask expansion, aggregation, unmask.
 
+Device counterparts of the reference hot loops (reference:
+rust/xaynet-core/src/mask/seed.rs:61-78 derive_mask,
+rust/xaynet-sdk/src/state_machine/phases/sum2.rs:170-193 mask aggregation,
+rust/xaynet-server/src/state_machine/phases/unmask.rs unmask subtract).
+
 Composes the ChaCha20 and limb kernels into the protocol-level device ops the
 coordinator and sum participants run:
 

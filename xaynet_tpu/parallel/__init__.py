@@ -1,0 +1,12 @@
+"""Device meshes and sharded aggregation.
+
+The TPU answer to the reference's scaling story (reference:
+rust/xaynet-server's single-threaded in-memory `Aggregation`): HBM-resident
+accumulators sharded over the model axis of a `jax.sharding.Mesh`, with
+zero-collective elementwise kernels and multi-host extensions.
+"""
+
+from .aggregator import ShardedAggregator
+from .mesh import MODEL_AXIS, make_mesh, model_sharding
+
+__all__ = ["ShardedAggregator", "MODEL_AXIS", "make_mesh", "model_sharding"]

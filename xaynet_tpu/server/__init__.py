@@ -1,0 +1,5 @@
+"""Coordinator runtime: state machine, services, REST API, settings, metrics.
+
+Reference surface: rust/xaynet-server/src/ (state_machine, services, rest,
+settings, metrics); see docs/PARITY.md for the component-level map.
+"""
