@@ -84,8 +84,10 @@ def _words_to_bytes(words: jax.Array) -> jax.Array:
 
 def provision_candidates(count: int, order: int) -> int:
     """Candidates to draw so that P(accepted < count) < ~2^-60."""
+    from fractions import Fraction
+
     bpn = (order.bit_length() + 7) // 8
-    p = order / float(1 << (8 * bpn)) if order.bit_length() <= 1000 else 1.0
+    p = float(Fraction(order, 1 << (8 * bpn)))  # exact for any order size
     p = max(min(p, 1.0), 1e-9)
     # Chernoff: need C with C*p - 7*sqrt(C*p*(1-p)) >= count
     c = count / p
