@@ -84,10 +84,9 @@ def _words_to_bytes(words: jax.Array) -> jax.Array:
 
 def provision_candidates(count: int, order: int) -> int:
     """Candidates to draw so that P(accepted < count) < ~2^-60."""
-    from fractions import Fraction
-
     bpn = (order.bit_length() + 7) // 8
-    p = float(Fraction(order, 1 << (8 * bpn)))  # exact for any order size
+    # int/int true division is correctly rounded at any magnitude
+    p = order / (1 << (8 * bpn))
     p = max(min(p, 1.0), 1e-9)
     # Chernoff: need C with C*p - 7*sqrt(C*p*(1-p)) >= count
     c = count / p
