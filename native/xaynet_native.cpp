@@ -200,3 +200,39 @@ XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
 }
 
 XN_EXPORT uint32_t xn_abi_version(void) { return 1; }
+
+// Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
+// double-double, where value_i is the unmasked group element (wire-layout
+// uint32 limbs, n_limbs <= 4 so values fit __int128), C = nb_models *
+// add_shift * exp_shift (integer, little-endian bytes), and (inv_hi,
+// inv_lo) is the double-double reciprocal of exp_shift * scalar_sum.
+// This is the unmask decode hot loop (python fallback: double-double
+// numpy in xaynet_tpu/core/mask/encode.py).
+XN_EXPORT int xn_decode_f64(const uint32_t* limbs, uint64_t n, uint32_t n_limbs,
+                            const uint8_t* c_le, uint32_t c_len, double inv_hi,
+                            double inv_lo, double* out) {
+  if (n_limbs == 0 || n_limbs > 4 || c_len > 15) return 1;
+  __int128 c = 0;
+  for (int i = (int)c_len - 1; i >= 0; i--) c = (c << 8) | c_le[i];
+
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t* v = limbs + i * n_limbs;
+    unsigned __int128 val = 0;
+    for (int j = (int)n_limbs - 1; j >= 0; j--) val = (val << 32) | v[j];
+    __int128 diff = (__int128)val - c;
+    // exact double-double of diff (|diff| < 2^127)
+    double d_hi = (double)diff;
+    double d_lo = (double)(diff - (__int128)d_hi);
+    // dd multiply (d_hi, d_lo) * (inv_hi, inv_lo), Dekker two_prod
+    double p = d_hi * inv_hi;
+    const double split = 134217729.0;  // 2^27 + 1
+    double ah = split * d_hi, bh = split * inv_hi;
+    ah = ah - (ah - d_hi);
+    bh = bh - (bh - inv_hi);
+    double al = d_hi - ah, bl = inv_hi - bh;
+    double err = ((ah * bh - p) + ah * bl + al * bh) + al * bl;
+    err += d_hi * inv_lo + d_lo * inv_hi;
+    out[i] = p + err;
+  }
+  return 0;
+}

@@ -121,6 +121,39 @@ def decode_vect_exact(
     return [(Fraction(v, e) - shift) / scalar_sum for v in values]
 
 
+def _decode_native(limbs: np.ndarray, c_int: int, recip: Fraction):
+    """Native double-double decode; None when unavailable/out of range."""
+    from ...utils import native
+
+    lib = native.load()
+    n, n_limb = limbs.shape
+    if (
+        lib is None
+        or not hasattr(lib, "xn_decode_f64")
+        or n_limb > 4
+        or c_int < 0
+        or c_int.bit_length() > 120
+    ):
+        return None
+    inv_hi, inv_lo = dd.from_fraction(recip)
+    c_le = c_int.to_bytes((c_int.bit_length() + 7) // 8 or 1, "little")
+    arr = np.ascontiguousarray(limbs, dtype=np.uint32)
+    out = np.empty(n, dtype=np.float64)
+    import ctypes
+
+    rc = lib.xn_decode_f64(
+        native.np_u32p(arr),
+        n,
+        n_limb,
+        native.as_u8p(c_le),
+        len(c_le),
+        ctypes.c_double(inv_hi),
+        ctypes.c_double(inv_lo),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out if rc == 0 else None
+
+
 def decode_vect_fast(
     limbs: np.ndarray, config: MaskConfig, nb_models: int, scalar_sum: Fraction
 ) -> np.ndarray:
@@ -133,6 +166,11 @@ def decode_vect_fast(
     """
     assert has_fast_path(config)
     n, n_limb = limbs.shape
+    c_int = nb_models * int(config.add_shift) * config.exp_shift
+    recip = Fraction(1, 1) / (config.exp_shift * scalar_sum)
+    native_out = _decode_native(limbs, c_int, recip)
+    if native_out is not None:
+        return native_out
     # limbs -> double-double value (high to low; power-of-two scaling exact)
     hi = limbs[:, n_limb - 1].astype(np.float64)
     lo = np.zeros(n)

@@ -71,6 +71,17 @@ def load() -> Optional[ctypes.CDLL]:
         lib.xn_mod_add.restype = None
         lib.xn_mod_sub.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_mod_sub.restype = None
+        lib.xn_decode_f64.argtypes = [
+            u32p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.xn_decode_f64.restype = ctypes.c_int
         _lib = lib
     except OSError as e:
         logger.debug("native library load failed: %s", e)
