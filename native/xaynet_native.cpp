@@ -236,3 +236,120 @@ XN_EXPORT int xn_decode_f64(const uint32_t* limbs, uint64_t n, uint32_t n_limbs,
   }
   return 0;
 }
+
+namespace {
+
+// Dekker double-double helpers (same sequences as xaynet_tpu/ops/dd.py,
+// so results are bit-identical to the numpy fast path).
+inline void two_sum(double x, double y, double& s, double& err) {
+  s = x + y;
+  double bb = s - x;
+  err = (x - (s - bb)) + (y - bb);
+}
+inline void quick_two_sum(double x, double y, double& s, double& err) {
+  s = x + y;
+  err = y - (s - x);
+}
+inline void two_prod(double x, double y, double& p, double& err) {
+  p = x * y;
+  const double split = 134217729.0;
+  double xh = split * x, yh = split * y;
+  xh = xh - (xh - x);
+  yh = yh - (yh - y);
+  double xl = x - xh, yl = y - yh;
+  err = ((xh * yh - p) + xh * yl + xl * yh) + xl * yl;
+}
+
+}  // namespace
+
+// Fused participant masking for bounded-f32 configs with orders <= 128 bits:
+// per element, draw the next uniform mask value from the seed's keystream
+// (rejection sampling, byte-stream compatible with the other samplers),
+// fixed-point-encode the weight in double-double (bit-identical to the
+// numpy fast path), add modulo the order, and emit the wire-layout element.
+// Returns the new keystream byte offset, or 0 on unsupported parameters.
+XN_EXPORT uint64_t xn_mask_f32(const uint8_t key_bytes[32], uint64_t byte_offset,
+                               const float* weights, uint64_t n,
+                               const uint8_t* order_le, uint32_t draw_nbytes,
+                               uint32_t elem_nbytes, double a, double e,
+                               double s_hi, double s_lo, uint8_t* out) {
+  if (draw_nbytes == 0 || draw_nbytes > 16 || elem_nbytes > 16 ||
+      elem_nbytes > draw_nbytes)
+    return 0;
+  uint32_t key[8];
+  std::memcpy(key, key_bytes, 32);
+  unsigned __int128 order = 0;
+  for (int i = (int)draw_nbytes - 1; i >= 0; i--) order = (order << 8) | order_le[i];
+
+  constexpr uint64_t CHUNK_BLOCKS = 1024;
+  std::vector<uint8_t> buf(CHUNK_BLOCKS * 64 + 64);
+  uint64_t avail = 0, pos = 0;
+  uint64_t next_block = byte_offset / 64;
+  uint64_t intra = byte_offset % 64;
+  if (intra) {
+    uint8_t first[64];
+    chacha20_block(key, next_block, first);
+    next_block++;
+    avail = 64 - intra;
+    std::memcpy(buf.data(), first + intra, avail);
+  }
+  uint64_t offset = byte_offset;
+
+  for (uint64_t i = 0; i < n; i++) {
+    // 1. next accepted uniform draw below the order
+    unsigned __int128 rnd;
+    for (;;) {
+      if (avail - pos < draw_nbytes) {
+        uint64_t tail = avail - pos;
+        std::memmove(buf.data(), buf.data() + pos, tail);
+        for (uint64_t b = 0; b < CHUNK_BLOCKS; b++)
+          chacha20_block(key, next_block + b, buf.data() + tail + b * 64);
+        next_block += CHUNK_BLOCKS;
+        avail = tail + CHUNK_BLOCKS * 64;
+        pos = 0;
+      }
+      const uint8_t* cand = buf.data() + pos;
+      pos += draw_nbytes;
+      offset += draw_nbytes;
+      rnd = 0;
+      for (int j = (int)draw_nbytes - 1; j >= 0; j--) rnd = (rnd << 8) | cand[j];
+      if (rnd < order) break;
+    }
+
+    // 2. double-double fixed-point encode of the weight
+    double w = (double)weights[i];
+    double hi, lo;
+    two_prod(w, s_hi, hi, lo);
+    lo += w * s_lo;
+    quick_two_sum(hi, lo, hi, lo);
+    if (hi > a || (hi == a && lo > 0)) {
+      hi = a;
+      lo = 0;
+    } else if (hi < -a || (hi == -a && lo < 0)) {
+      hi = -a;
+      lo = 0;
+    }
+    double t, terr;
+    two_sum(hi, a, t, terr);
+    terr += lo;
+    quick_two_sum(t, terr, hi, lo);
+    double p, perr;
+    two_prod(hi, e, p, perr);
+    perr += lo * e;
+    quick_two_sum(p, perr, hi, lo);
+    double f = __builtin_floor(hi);
+    f += __builtin_floor((hi - f) + lo);
+    long long shifted = (long long)f;
+    if (shifted < 0) shifted = 0;
+
+    // 3. modular add + wire emit (little-endian fixed width)
+    unsigned __int128 masked = rnd + (unsigned __int128)shifted;
+    if (masked >= order) masked -= order;
+    uint8_t* dst = out + i * elem_nbytes;
+    for (uint32_t j = 0; j < elem_nbytes; j++) {
+      dst[j] = (uint8_t)(masked & 0xff);
+      masked >>= 8;
+    }
+  }
+  return offset;
+}

@@ -52,13 +52,22 @@ class StreamSampler:
         return self._block * BLOCK_BYTES - len(self._leftover)
 
     def skip_bytes(self, n: int) -> None:
-        """Advance the stream cursor by ``n`` bytes without drawing."""
-        while n > 0:
-            if len(self._leftover) == 0:
-                self._leftover = self._more_keystream(n)
-            take = min(n, len(self._leftover))
-            self._leftover = self._leftover[take:]
-            n -= take
+        """Advance the stream cursor by ``n`` bytes without drawing.
+
+        Whole blocks are skipped by advancing the counter (ChaCha20 is
+        seekable); only a trailing partial block is generated.
+        """
+        take = min(n, len(self._leftover))
+        self._leftover = self._leftover[take:]
+        n -= take
+        if n <= 0:
+            return
+        self._block += n // BLOCK_BYTES
+        intra = n % BLOCK_BYTES
+        if intra:
+            blk = keystream_blocks(self._seed, self._block, 1)
+            self._block += 1
+            self._leftover = blk[intra:]
 
     def _more_keystream(self, nbytes: int) -> np.ndarray:
         nblocks = max(4, -(-nbytes // BLOCK_BYTES))

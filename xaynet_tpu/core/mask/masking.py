@@ -33,6 +33,7 @@ from .encode import (
     encode_vect_limbs,
     has_fast_path,
 )
+from fractions import Fraction
 from .model import Model, Scalar
 from .object import MaskObject, MaskUnit, MaskVect
 from .seed import MaskSeed
@@ -58,6 +59,46 @@ def _order_limbs(config: MaskConfig) -> np.ndarray:
     return limb_ops.order_limbs_for(config.order)
 
 
+def _mask_native(seed: bytes, sampler: StreamSampler, weights: np.ndarray,
+                 s_clamped: Fraction, config: MaskConfig):
+    """Fused native mask (draw + dd encode + mod add); None when unavailable."""
+    from ...ops import dd
+    from ...utils import native
+
+    lib = native.load()
+    if lib is None or not hasattr(lib, "xn_mask_f32"):
+        return None
+    order = config.order
+    draw_nbytes = (order.bit_length() + 7) // 8
+    elem_nbytes = config.bytes_per_number
+    if draw_nbytes > 16:
+        return None
+    import ctypes
+
+    n = weights.shape[0]
+    s_hi, s_lo = dd.from_fraction(s_clamped)
+    out = np.empty(n * elem_nbytes, dtype=np.uint8)
+    w = np.ascontiguousarray(weights, dtype=np.float32)
+    new_offset = lib.xn_mask_f32(
+        native.as_u8p(seed),
+        sampler.consumed_bytes,
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        native.as_u8p(order.to_bytes(draw_nbytes, "little")),
+        draw_nbytes,
+        elem_nbytes,
+        ctypes.c_double(float(int(config.add_shift))),
+        ctypes.c_double(float(config.exp_shift)),
+        ctypes.c_double(s_hi),
+        ctypes.c_double(s_lo),
+        native.np_u8p(out),
+    )
+    if new_offset == 0:
+        return None
+    sampler.skip_bytes(new_offset - sampler.consumed_bytes)
+    return limb_ops.bytes_le_to_limbs(out, n, elem_nbytes)
+
+
 class Masker:
     """Masks a model with a (possibly given) random 32-byte seed."""
 
@@ -76,13 +117,23 @@ class Masker:
         # draw order matters: one unit draw first, then the vector draws
         rand_1 = sampler.draw_limbs(1, config_1.order)[0]
         length = len(model)
-        rand_n = sampler.draw_limbs(length, config_n.order)
 
         s_clamped = clamp_scalar(scalar.value, config_1)
 
         weights = model if isinstance(model, np.ndarray) else model.weights
-        encoded = encode_vect_limbs(weights, s_clamped, config_n)
-        masked_vect = limb_ops.mod_add(encoded, rand_n, _order_limbs(config_n))
+        masked_vect = None
+        if (
+            isinstance(weights, np.ndarray)
+            and weights.dtype == np.float32
+            and has_fast_path(config_n)
+        ):
+            masked_vect = _mask_native(
+                self.seed.as_bytes(), sampler, weights, s_clamped, config_n
+            )
+        if masked_vect is None:
+            rand_n = sampler.draw_limbs(length, config_n.order)
+            encoded = encode_vect_limbs(weights, s_clamped, config_n)
+            masked_vect = limb_ops.mod_add(encoded, rand_n, _order_limbs(config_n))
 
         shifted_1 = encode_unit(s_clamped, config_1)
         n_limb_1 = limb_ops.n_limbs_for_order(config_1.order)

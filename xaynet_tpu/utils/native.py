@@ -82,6 +82,21 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),
         ]
         lib.xn_decode_f64.restype = ctypes.c_int
+        lib.xn_mask_f32.argtypes = [
+            u8p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            u8p,
+        ]
+        lib.xn_mask_f32.restype = ctypes.c_uint64
         _lib = lib
     except OSError as e:
         logger.debug("native library load failed: %s", e)
