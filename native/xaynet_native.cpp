@@ -17,6 +17,10 @@
 #include <cstring>
 #include <vector>
 
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
 #define XN_EXPORT extern "C" __attribute__((visibility("default")))
 
 namespace {
@@ -63,6 +67,86 @@ void chacha20_block(const uint32_t key[8], uint64_t counter, uint8_t out[64]) {
   }
 }
 
+#ifdef __AVX2__
+namespace {
+
+inline __m256i rotl8v(__m256i x, int n) {
+  return _mm256_or_si256(_mm256_slli_epi32(x, n), _mm256_srli_epi32(x, 32 - n));
+}
+
+#define XN_QUARTER8(a, b, c, d)            \
+  a = _mm256_add_epi32(a, b);              \
+  d = rotl8v(_mm256_xor_si256(d, a), 16);  \
+  c = _mm256_add_epi32(c, d);              \
+  b = rotl8v(_mm256_xor_si256(b, c), 12);  \
+  a = _mm256_add_epi32(a, b);              \
+  d = rotl8v(_mm256_xor_si256(d, a), 8);   \
+  c = _mm256_add_epi32(c, d);              \
+  b = rotl8v(_mm256_xor_si256(b, c), 7)
+
+// Eight consecutive ChaCha20 blocks in parallel (one block per SIMD lane).
+void chacha20_blocks8(const uint32_t key[8], uint64_t counter0, uint8_t out[512]) {
+  const uint32_t consts[4] = {0x61707865u, 0x3320646eu, 0x79622d32u, 0x6b206574u};
+  __m256i s[16];
+  for (int i = 0; i < 4; i++) s[i] = _mm256_set1_epi32((int)consts[i]);
+  for (int i = 0; i < 8; i++) s[4 + i] = _mm256_set1_epi32((int)key[i]);
+  alignas(32) uint32_t ctr_lo[8], ctr_hi[8];
+  for (int l = 0; l < 8; l++) {
+    uint64_t c = counter0 + (uint64_t)l;
+    ctr_lo[l] = (uint32_t)(c & 0xffffffffu);
+    ctr_hi[l] = (uint32_t)(c >> 32);
+  }
+  s[12] = _mm256_load_si256((const __m256i*)ctr_lo);
+  s[13] = _mm256_load_si256((const __m256i*)ctr_hi);
+  s[14] = _mm256_setzero_si256();
+  s[15] = _mm256_setzero_si256();
+
+  __m256i w0 = s[0], w1 = s[1], w2 = s[2], w3 = s[3], w4 = s[4], w5 = s[5],
+          w6 = s[6], w7 = s[7], w8 = s[8], w9 = s[9], w10 = s[10], w11 = s[11],
+          w12 = s[12], w13 = s[13], w14 = s[14], w15 = s[15];
+  for (int r = 0; r < 10; r++) {
+    XN_QUARTER8(w0, w4, w8, w12);
+    XN_QUARTER8(w1, w5, w9, w13);
+    XN_QUARTER8(w2, w6, w10, w14);
+    XN_QUARTER8(w3, w7, w11, w15);
+    XN_QUARTER8(w0, w5, w10, w15);
+    XN_QUARTER8(w1, w6, w11, w12);
+    XN_QUARTER8(w2, w7, w8, w13);
+    XN_QUARTER8(w3, w4, w9, w14);
+  }
+  __m256i v[16] = {w0, w1, w2, w3, w4, w5, w6, w7, w8, w9, w10, w11, w12, w13, w14, w15};
+  alignas(32) uint32_t lanes[16][8];
+  for (int i = 0; i < 16; i++) {
+    v[i] = _mm256_add_epi32(v[i], s[i]);
+    _mm256_store_si256((__m256i*)lanes[i], v[i]);
+  }
+  // transpose: block l = words 0..15, lane l
+  for (int l = 0; l < 8; l++) {
+    uint32_t* dst = (uint32_t*)(out + l * 64);
+    for (int i = 0; i < 16; i++) dst[i] = lanes[i][l];
+  }
+}
+
+}  // namespace
+#endif  // __AVX2__
+
+namespace {
+
+// Fill `nblocks` consecutive blocks starting at `counter0` into `out`,
+// using the 8-way kernel where possible.
+void chacha20_fill(const uint32_t key[8], uint64_t counter0, uint64_t nblocks,
+                   uint8_t* out) {
+  uint64_t b = 0;
+#ifdef __AVX2__
+  for (; b + 8 <= nblocks; b += 8) {
+    chacha20_blocks8(key, counter0 + b, out + b * 64);
+  }
+#endif
+  for (; b < nblocks; b++) chacha20_block(key, counter0 + b, out + b * 64);
+}
+
+}  // namespace
+
 // value < order over fixed-width little-endian byte strings.
 inline bool lt_le(const uint8_t* value, const uint8_t* order, uint32_t n) {
   for (int i = (int)n - 1; i >= 0; i--) {
@@ -80,9 +164,7 @@ XN_EXPORT void xn_chacha20_blocks(const uint8_t key_bytes[32], uint64_t block_st
                                   uint64_t nblocks, uint8_t* out) {
   uint32_t key[8];
   std::memcpy(key, key_bytes, 32);
-  for (uint64_t i = 0; i < nblocks; i++) {
-    chacha20_block(key, block_start + i, out + i * 64);
-  }
+  chacha20_fill(key, block_start, nblocks, out);
 }
 
 // Draw `count` uniform values below `order` (little-endian, `order_nbytes`
@@ -302,8 +384,7 @@ XN_EXPORT uint64_t xn_mask_f32(const uint8_t key_bytes[32], uint64_t byte_offset
       if (avail - pos < draw_nbytes) {
         uint64_t tail = avail - pos;
         std::memmove(buf.data(), buf.data() + pos, tail);
-        for (uint64_t b = 0; b < CHUNK_BLOCKS; b++)
-          chacha20_block(key, next_block + b, buf.data() + tail + b * 64);
+        chacha20_fill(key, next_block, CHUNK_BLOCKS, buf.data() + tail);
         next_block += CHUNK_BLOCKS;
         avail = tail + CHUNK_BLOCKS * 64;
         pos = 0;

@@ -23,18 +23,15 @@ _tried = False
 
 
 def _build() -> bool:
-    try:
-        subprocess.run(
-            ["make", "-s"],
-            cwd=_NATIVE_DIR,
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception as e:  # toolchain missing — fall back to python
-        logger.debug("native build failed: %s", e)
-        return False
+    for args in (["make", "-s"], ["make", "-s", "ARCHFLAGS="]):
+        try:
+            subprocess.run(
+                args, cwd=_NATIVE_DIR, check=True, capture_output=True, timeout=120
+            )
+            return True
+        except Exception as e:  # retry without SIMD flags, then give up
+            logger.debug("native build failed (%s): %s", args, e)
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:
