@@ -156,6 +156,20 @@ inline bool lt_le(const uint8_t* value, const uint8_t* order, uint32_t n) {
   return false;  // equal
 }
 
+inline unsigned __int128 load_le16(const uint8_t* p, uint32_t nbytes) {
+  uint64_t lo, hi;
+  std::memcpy(&lo, p, 8);
+  if (nbytes <= 8) {
+    if (nbytes == 8) return lo;
+    return lo & ((1ull << (8 * nbytes)) - 1);
+  }
+  std::memcpy(&hi, p + 8, 8);
+  unsigned __int128 v = ((unsigned __int128)hi << 64) | lo;
+  if (nbytes == 16) return v;
+  unsigned __int128 mask = ((unsigned __int128)1 << (8 * nbytes)) - 1;
+  return v & mask;
+}
+
 }  // namespace
 
 // Generate `nblocks` keystream blocks starting at `block_start` into `out`
@@ -178,6 +192,12 @@ XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_
                                      uint32_t order_nbytes, uint8_t* out) {
   uint32_t key[8];
   std::memcpy(key, key_bytes, 32);
+  unsigned __int128 order128 = 0;
+  const bool small_order = order_nbytes <= 16;
+  if (small_order) {
+    for (int i = (int)order_nbytes - 1; i >= 0; i--)
+      order128 = (order128 << 8) | order_le[i];
+  }
 
   // Buffered keystream: generate CHUNK_BLOCKS blocks at a time and slice
   // candidates out of the flat buffer (carrying the partial tail between
@@ -214,13 +234,17 @@ XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_
     const uint8_t* candidate = buf.data() + pos;
     pos += order_nbytes;
     offset += order_nbytes;
-    if (lt_le(candidate, order_le, order_nbytes)) {
+    const bool accept = small_order ? (load_le16(candidate, order_nbytes) < order128)
+                                    : lt_le(candidate, order_le, order_nbytes);
+    if (accept) {
       std::memcpy(out + got * order_nbytes, candidate, order_nbytes);
       got++;
     }
   }
   return offset;
 }
+
+
 
 // (a + b) mod order, elementwise over `n` values of `n_limbs` uint32 limbs
 // (little-endian limb order, wire layout [n, L]); a, b < order.
@@ -392,8 +416,7 @@ XN_EXPORT uint64_t xn_mask_f32(const uint8_t key_bytes[32], uint64_t byte_offset
       const uint8_t* cand = buf.data() + pos;
       pos += draw_nbytes;
       offset += draw_nbytes;
-      rnd = 0;
-      for (int j = (int)draw_nbytes - 1; j >= 0; j--) rnd = (rnd << 8) | cand[j];
+      rnd = load_le16(cand, draw_nbytes);
       if (rnd < order) break;
     }
 
