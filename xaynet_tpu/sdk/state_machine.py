@@ -247,9 +247,17 @@ class StateMachine:
                 )
             except Exception:
                 logger.warning("device mask aggregation failed; using host path", exc_info=True)
+        # mask derivations are independent per seed and the native sampler
+        # releases the GIL, so they parallelize across threads
+        from concurrent.futures import ThreadPoolExecutor
+
         mask_agg = Aggregation(config, length)
-        for mask_seed in mask_seeds:
-            mask = mask_seed.derive_mask(length, config)
+        if len(mask_seeds) > 1:
+            with ThreadPoolExecutor(max_workers=min(8, len(mask_seeds))) as pool:
+                masks = list(pool.map(lambda s: s.derive_mask(length, config), mask_seeds))
+        else:
+            masks = [s.derive_mask(length, config) for s in mask_seeds]
+        for mask in masks:
             mask_agg.validate_aggregation(mask)
             mask_agg.aggregate(mask)
         return mask_agg.object
