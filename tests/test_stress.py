@@ -100,3 +100,104 @@ def test_1m_param_full_round_wall_clock():
     finally:
         fed.stop()
     np.testing.assert_allclose(result.global_model, np.zeros(MLEN), atol=1e-9)
+
+
+def test_25m_param_full_round_wall_clock():
+    """Baseline config #4 shape: a complete PET round at 25M parameters
+    (ResNet-50 scale) through the full protocol stack, host kernels only."""
+    import asyncio
+    import time
+    from fractions import Fraction
+
+    from xaynet_tpu.sdk.client import InProcessClient
+    from xaynet_tpu.sdk.simulation import keys_for_task
+    from xaynet_tpu.sdk.state_machine import PetSettings as SdkPet, StateMachine as P
+    from xaynet_tpu.sdk.traits import ModelStore
+    from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+    from xaynet_tpu.server.settings import (
+        CountSettings,
+        PhaseSettings,
+        PetSettings,
+        Settings,
+        Sum2Settings,
+        TimeSettings,
+    )
+    from xaynet_tpu.server.state_machine import StateMachineInitializer
+    from xaynet_tpu.storage.memory import (
+        InMemoryCoordinatorStorage,
+        InMemoryModelStorage,
+        NoOpTrustAnchor,
+    )
+    from xaynet_tpu.storage.traits import Store
+
+    MLEN = 25_000_000
+
+    class MS(ModelStore):
+        def __init__(self, m):
+            self.m = m
+
+        async def load_model(self):
+            return self.m
+
+    async def run():
+        st = Settings(
+            pet=PetSettings(
+                sum=PhaseSettings(prob=0.4, count=CountSettings(1, 1), time=TimeSettings(0, 600)),
+                update=PhaseSettings(prob=0.5, count=CountSettings(3, 3), time=TimeSettings(0, 600)),
+                sum2=Sum2Settings(count=CountSettings(1, 1), time=TimeSettings(0, 600)),
+            )
+        )
+        st.model.length = MLEN
+        st.mask.model_type = st.mask.model_type.__class__.M6
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, tx, events = await StateMachineInitializer(st, store).init()
+        handler = PetMessageHandler(events, tx)
+        fetcher = Fetcher(events)
+        mt = asyncio.create_task(machine.run())
+        while fetcher.phase().value != "sum":
+            await asyncio.sleep(0.01)
+        seed = fetcher.round_params().seed.as_bytes()
+        rng = np.random.default_rng(0)
+        parts = [
+            P(
+                SdkPet(keys=keys_for_task(seed, 0.4, 0.5, "sum", start=0), max_message_size=None),
+                InProcessClient(fetcher, handler),
+                MS(None),
+            )
+        ]
+        expected_mean = 0.0
+        for i in range(3):
+            k = keys_for_task(seed, 0.4, 0.5, "update", start=(10 + i) * 1000)
+            local = rng.uniform(-1, 1, MLEN).astype(np.float32)
+            expected_mean += float(local.astype(np.float64).mean()) / 3
+            parts.append(
+                P(
+                    SdkPet(keys=k, scalar=Fraction(1, 3), max_message_size=None),
+                    InProcessClient(fetcher, handler),
+                    MS(local),
+                )
+            )
+        t0 = time.time()
+
+        async def drive(sm):
+            for _ in range(600):
+                try:
+                    await sm.transition()
+                except Exception:
+                    pass
+                if fetcher.model() is not None and sm.phase.value == "awaiting":
+                    return
+                await asyncio.sleep(0.05)
+
+        await asyncio.gather(*(drive(p) for p in parts))
+        while fetcher.model() is None:
+            await asyncio.sleep(0.05)
+        wall = time.time() - t0
+        model = np.asarray(fetcher.model())
+        print(f"25M-param full PET round wall-clock: {wall:.1f}s")
+        assert model.shape == (MLEN,)
+        assert abs(float(model.mean()) - expected_mean) < 1e-6
+        mt.cancel()
+        return wall
+
+    asyncio.run(asyncio.wait_for(run(), 900))
