@@ -35,9 +35,12 @@ MAX_BODY = 1 << 32  # u32 length field ceiling, as in the reference
 
 
 class RestServer:
-    def __init__(self, fetcher: Fetcher, handler: PetMessageHandler):
+    def __init__(
+        self, fetcher: Fetcher, handler: PetMessageHandler, read_timeout: float = 120.0
+    ):
         self.fetcher = fetcher
         self.handler = handler
+        self.read_timeout = read_timeout  # slow-client defense
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(
@@ -58,7 +61,7 @@ class RestServer:
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             while True:
-                request_line = await reader.readline()
+                request_line = await asyncio.wait_for(reader.readline(), self.read_timeout)
                 if not request_line:
                     break
                 try:
@@ -68,7 +71,7 @@ class RestServer:
                     break
                 headers = {}
                 while True:
-                    line = await reader.readline()
+                    line = await asyncio.wait_for(reader.readline(), self.read_timeout)
                     if line in (b"\r\n", b"\n", b""):
                         break
                     name, _, value = line.decode().partition(":")
@@ -77,13 +80,17 @@ class RestServer:
                 if length > MAX_BODY:
                     await self._respond(writer, 413, b"body too large")
                     break
-                body = await reader.readexactly(length) if length else b""
+                body = (
+                    await asyncio.wait_for(reader.readexactly(length), self.read_timeout)
+                    if length
+                    else b""
+                )
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
                 status, payload, ctype = await self._route(method, target, body)
                 await self._respond(writer, status, payload, ctype, keep_alive)
                 if not keep_alive:
                     break
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.TimeoutError):
             pass
         finally:
             writer.close()
