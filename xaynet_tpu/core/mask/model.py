@@ -159,6 +159,10 @@ class Scalar:
             return cls(0, 1)
         return cls.from_fraction(Fraction(min(f, _F64_MAX)))
 
+    def to_float(self) -> float:
+        """Correctly-rounded primitive conversion (IntoPrimitive analogue)."""
+        return float(self.value)
+
     def __eq__(self, other) -> bool:
         return isinstance(other, Scalar) and self.value == other.value
 
