@@ -1,0 +1,98 @@
+"""TLS: coordinator serves HTTPS; client verifies against a private CA."""
+
+import asyncio
+import datetime
+import ssl
+
+import pytest
+
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import Settings
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+
+def _self_signed(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "server.pem"
+    key_path = tmp_path / "server.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def test_https_round_params(tmp_path):
+    cert_path, key_path = _self_signed(tmp_path)
+
+    async def run():
+        settings = Settings.default()
+        settings.model.length = 4
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, tx, events = await StateMachineInitializer(settings, store).init()
+        rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
+
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(cert_path, key_path)
+        host, port = await rest.start("127.0.0.1", 0, tls=server_ctx)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            client_ctx = ssl.create_default_context(cafile=cert_path)
+            client = HttpClient(f"https://{host}:{port}", tls_context=client_ctx)
+            while events.phase.get_latest().event.value != "sum":
+                await asyncio.sleep(0.01)
+            params = await client.get_round_params()
+            assert params.model_length == 4
+
+            # plaintext to the TLS port must fail
+            plain = HttpClient(f"http://{host}:{port}", timeout=3.0)
+            with pytest.raises(Exception):
+                await plain.get_round_params()
+
+            # wrong CA must fail the handshake
+            bad_ctx = ssl.create_default_context()
+            bad = HttpClient(f"https://{host}:{port}", tls_context=bad_ctx, timeout=3.0)
+            with pytest.raises(Exception):
+                await bad.get_round_params()
+        finally:
+            machine_task.cancel()
+            await rest.stop()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
