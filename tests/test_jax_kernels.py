@@ -183,3 +183,14 @@ def test_fold_pallas_matches_oracle(k):
     got = host_limbs.limbs_to_ints(np.ascontiguousarray(np.asarray(out).T))
     want = [(acc0[j] + sum(rows[i][j] for i in range(k))) % order for j in range(n)]
     assert got == want
+
+
+def test_multihost_local_slice():
+    """Per-host model-axis slices tile the model exactly (single-process: 1)."""
+    from xaynet_tpu.parallel import multihost
+
+    start, end = multihost.local_slice(1000)
+    assert (start, end) == (0, 1000)  # one process owns everything
+    multihost.initialize()  # no-op without num_processes
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size >= 1
