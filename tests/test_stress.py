@@ -201,3 +201,25 @@ def test_25m_param_full_round_wall_clock():
         return wall
 
     asyncio.run(asyncio.wait_for(run(), 900))
+
+
+def test_1m_device_mesh_aggregation():
+    """Sharded device aggregation at 1M params on the 8-device mesh."""
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+    n, k = 1_000_000, 8
+    order = CFG.order
+    n_limb = limb_ops.n_limbs_for_order(order)
+    rng = np.random.default_rng(1)
+    stack = rng.integers(0, 2**32, size=(k, n, n_limb), dtype=np.uint32)
+    stack[..., n_limb - 1] &= (1 << 20) - 1  # keep elements < order
+
+    dev = ShardedAggregator(CFG, n)
+    t0 = time.time()
+    dev.add_batch(stack)
+    got = dev.snapshot()
+    print(f"device mesh fold 8 x 1M: {time.time() - t0:.2f}s")
+
+    acc = np.zeros((n, n_limb), dtype=np.uint32)
+    want = limb_ops.batch_mod_sum(stack.copy(), limb_ops.order_limbs_for(order))
+    assert np.array_equal(got, want)
