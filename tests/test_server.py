@@ -445,3 +445,28 @@ def test_storage_fault_routes_to_failure_and_recovers():
                 pass
 
     asyncio.run(asyncio.wait_for(run(), timeout=30))
+
+
+def test_file_coordinator_storage_survives_restart(tmp_path):
+    """Durable state (coordinator state + model pointer) survives a new
+    process generation; round dictionaries are volatile by design."""
+    from xaynet_tpu.storage.memory import FileCoordinatorStorage
+
+    path = str(tmp_path / "state.json")
+
+    async def run():
+        a = FileCoordinatorStorage(path)
+        await a.set_coordinator_state(b"gen1-state")
+        await a.set_latest_global_model_id("5_abc")
+        await a.add_sum_participant(b"p" * 32, b"e" * 32)
+
+        b = FileCoordinatorStorage(path)  # "new process"
+        assert await b.coordinator_state() == b"gen1-state"
+        assert await b.latest_global_model_id() == "5_abc"
+        assert await b.sum_dict() is None  # volatile
+
+        await b.delete_coordinator_data()
+        c = FileCoordinatorStorage(path)
+        assert await c.coordinator_state() is None
+
+    asyncio.run(run())

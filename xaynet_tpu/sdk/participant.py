@@ -129,8 +129,19 @@ class Participant:
     def save(self) -> bytes:
         """Serializes the participant; the instance must not be used after."""
         state = self._sm.save()
-        self._loop.close()
+        self.close()
         return state
+
+    def close(self) -> None:
+        """Releases the private event loop (idempotent)."""
+        if not self._loop.is_closed():
+            self._loop.close()
+
+    def __del__(self):  # noqa: D105 — deterministic teardown beats GC races
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @classmethod
     def restore(cls, state: bytes, client: Union[str, XaynetClient]) -> "Participant":

@@ -17,6 +17,7 @@ import signal
 from typing import Optional
 
 from ..storage.memory import (
+    FileCoordinatorStorage,
     FilesystemModelStorage,
     InMemoryCoordinatorStorage,
     InMemoryModelStorage,
@@ -40,6 +41,12 @@ def init_store(settings: Settings) -> Store:
             host=settings.storage.redis_host,
             port=settings.storage.redis_port,
             db=settings.storage.redis_db,
+        )
+    elif settings.storage.coordinator == "file":
+        import os
+
+        coordinator = FileCoordinatorStorage(
+            os.path.join(settings.storage.model_dir, "coordinator_state.json")
         )
     else:
         coordinator = InMemoryCoordinatorStorage()

@@ -107,7 +107,7 @@ class ApiSettings:
 class StorageSettings:
     backend: str = "memory"  # memory | filesystem (models) ...
     model_dir: str = "./global_models"
-    # coordinator dictionary backend: memory | redis
+    # coordinator dictionary backend: memory | file | redis
     coordinator: str = "memory"
     redis_host: str = "127.0.0.1"
     redis_port: int = 6379

@@ -201,3 +201,55 @@ class NoOpTrustAnchor(TrustAnchor):
 
     async def is_ready(self) -> None:
         return None
+
+
+class FileCoordinatorStorage(InMemoryCoordinatorStorage):
+    """In-memory round dictionaries + file-persisted durable state.
+
+    The reference keeps everything in Redis; for single-node deployments
+    without an external store, the *durable* subset (coordinator state and
+    the latest-global-model pointer — exactly what restore reads,
+    reference: initializer.rs:162-271) persists to a JSON file. Round
+    dictionaries are round-volatile by design: after a crash the round
+    restarts, which is the protocol's own recovery semantics.
+    """
+
+    def __init__(self, path: str):
+        super().__init__()
+        import json
+        import os
+
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                saved = json.load(f)
+            if saved.get("state") is not None:
+                self._state = bytes.fromhex(saved["state"])
+            self._latest_global_model_id = saved.get("latest_global_model_id")
+
+    def _persist(self) -> None:
+        import json
+        import os
+
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "state": self._state.hex() if self._state else None,
+                    "latest_global_model_id": self._latest_global_model_id,
+                },
+                f,
+            )
+        os.replace(tmp, self.path)
+
+    async def set_coordinator_state(self, state: bytes) -> None:
+        await super().set_coordinator_state(state)
+        self._persist()
+
+    async def set_latest_global_model_id(self, model_id: str) -> None:
+        await super().set_latest_global_model_id(model_id)
+        self._persist()
+
+    async def delete_coordinator_data(self) -> None:
+        await super().delete_coordinator_data()
+        self._persist()
