@@ -11,6 +11,25 @@ import threading
 import time
 from fractions import Fraction
 
+_COORDINATORS: list = []
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _stop_coordinators():
+    yield
+    while _COORDINATORS:
+        info = _COORDINATORS.pop()
+        loop, task = info.get("loop"), info.get("task")
+        if loop is not None and task is not None:
+            try:
+                loop.call_soon_threadsafe(task.cancel)
+            except Exception:
+                pass
+
+
 import numpy as np
 
 from xaynet_tpu.sdk.api import ParticipantABC, spawn_participant
@@ -71,14 +90,21 @@ def _start_coordinator():
             rest = RestServer(fetcher, handler)
             host, port = await rest.start("127.0.0.1", 0)
             info["url"] = f"http://{host}:{port}"
+            info["loop"] = asyncio.get_running_loop()
+            task = asyncio.ensure_future(machine.run())
+            info["task"] = task
             started.set()
-            await machine.run()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
 
         asyncio.run(main())
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     assert started.wait(10)
+    _COORDINATORS.append(info)
     return info["url"]
 
 

@@ -5,6 +5,25 @@ import os
 import subprocess
 import threading
 
+_COORDINATORS: list = []
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _stop_coordinators():
+    yield
+    while _COORDINATORS:
+        info = _COORDINATORS.pop()
+        loop, task = info.get("loop"), info.get("task")
+        if loop is not None and task is not None:
+            try:
+                loop.call_soon_threadsafe(task.cancel)
+            except Exception:
+                pass
+
+
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -50,13 +69,20 @@ def _start_coordinator():
             rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
             host, port = await rest.start("127.0.0.1", 0)
             info["url"] = f"http://{host}:{port}"
+            info["loop"] = asyncio.get_running_loop()
+            task = asyncio.ensure_future(machine.run())
+            info["task"] = task
             started.set()
-            await machine.run()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
 
         asyncio.run(main())
 
     threading.Thread(target=run, daemon=True).start()
     assert started.wait(10)
+    _COORDINATORS.append(info)
     return info["url"]
 
 
