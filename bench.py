@@ -26,29 +26,64 @@ def _sync(x) -> None:
     np.asarray(x[:1, :8])
 
 
-def _device_probe_ok(timeout: float = 120.0) -> bool:
-    """Probe the accelerator in a subprocess (a wedged tunnel hangs forever)."""
+def _device_probe_ok(timeout: float = 180.0, attempts: int = 3) -> bool:
+    """Probe the accelerator in a subprocess (a wedged tunnel hangs forever).
+
+    Retries with fresh subprocesses: a tunnel that is briefly down at t=0
+    must not silently turn a TPU run into a CPU run. Probe stderr is echoed
+    so a dead tunnel is diagnosable from the bench log.
+    """
     import subprocess
 
     code = (
         "import jax, jax.numpy as jnp, numpy as np;"
+        "d = jax.devices();"
         "x = jax.device_put(np.ones(8, np.float32));"
-        "print(float(jnp.sum(x)))"
+        "print('probe-platform:', d[0].platform, float(jnp.sum(x)))"
     )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, timeout=timeout, text=True
-        )
-        return r.returncode == 0 and "8.0" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, timeout=timeout, text=True
+            )
+            # success requires a NON-cpu platform: a fast-failing accelerator
+            # init that silently falls back to CPU must count as a failed
+            # probe, not as success (this function is only called when an
+            # accelerator is expected)
+            if (
+                r.returncode == 0
+                and "8.0" in r.stdout
+                and "probe-platform:" in r.stdout
+                and "probe-platform: cpu" not in r.stdout
+            ):
+                print(f"probe attempt {i + 1}: OK — {r.stdout.strip()}", file=sys.stderr)
+                return True
+            tail = (r.stderr or "")[-2000:]
+            print(
+                f"probe attempt {i + 1}: rc={r.returncode} stdout={r.stdout.strip()!r} "
+                f"stderr tail:\n{tail}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired as e:
+            tail = (e.stderr or b"")[-2000:] if e.stderr else b""
+            print(
+                f"probe attempt {i + 1}: TIMEOUT after {timeout}s "
+                f"(backend init hung — tunnel likely dead) stderr tail:\n"
+                f"{tail.decode(errors='replace') if isinstance(tail, bytes) else tail}",
+                file=sys.stderr,
+            )
+    return False
 
 
 def main() -> None:
     import os
 
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _device_probe_ok():
-        print("accelerator unreachable; falling back to CPU", file=sys.stderr)
+        print(
+            "accelerator unreachable after retries; falling back to CPU "
+            "(headline JSON will be tagged platform=cpu)",
+            file=sys.stderr,
+        )
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -138,6 +173,9 @@ def main() -> None:
                 "value": round(scaled_ups, 2),
                 "unit": "updates/s",
                 "vs_baseline": round(scaled_ups / baseline, 3),
+                "platform": platform,
+                "kernel": best,
+                "model_len": model_len,
             }
         )
     )

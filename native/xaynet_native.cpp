@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #ifdef __AVX2__
@@ -305,7 +306,7 @@ XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
   }
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 1; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 2; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout
@@ -367,6 +368,86 @@ inline void two_prod(double x, double y, double& p, double& err) {
 }
 
 }  // namespace
+
+// Exact-path unmask decode for ANY config family (arbitrary limb width,
+// including i64/f64/Bmax where C = nb_models * add_shift * exp_shift can be
+// hundreds of bits): out[i] = (value_i - C) * inv. The subtraction is exact
+// multi-limb integer arithmetic; the difference (which has no cancellation
+// left) is then rounded to ~96 bits and multiplied by the double-double
+// *normalized mantissa* (inv_hi, inv_lo) of the reciprocal of
+// exp_shift * scalar_sum, whose binary exponent `inv_exp` is applied by one
+// final ldexp — so reciprocals far outside float64 range (BMAX exp_shifts)
+// stay exact. Total relative error ~2^-95, far below the protocol tolerance
+// of 1/exp_shift (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
+// Returns nonzero on unsupported widths.
+XN_EXPORT int xn_decode_exact(const uint32_t* limbs, uint64_t n, uint32_t n_limbs,
+                              const uint32_t* c_limbs, uint32_t c_nlimbs,
+                              double inv_hi, double inv_lo, int32_t inv_exp,
+                              double* out) {
+  constexpr uint32_t MAX_LIMBS = 96;  // catalogue orders cap at 2143 bits = 67 limbs
+  if (n_limbs == 0 || n_limbs > MAX_LIMBS || c_nlimbs > MAX_LIMBS) return 1;
+  const uint32_t L = (n_limbs > c_nlimbs ? n_limbs : c_nlimbs);
+  uint32_t c_ext[MAX_LIMBS];
+  for (uint32_t j = 0; j < L; j++) c_ext[j] = (j < c_nlimbs) ? c_limbs[j] : 0;
+
+  // embarrassingly parallel over elements: split across hardware threads for
+  // large inputs (the 25M x 67-limb worst case is ~6.6 GB of limb reads)
+  auto decode_range = [&](uint64_t i_lo, uint64_t i_hi) {
+    for (uint64_t i = i_lo; i < i_hi; i++) {
+    const uint32_t* v = limbs + i * n_limbs;
+    uint32_t d[MAX_LIMBS];
+    uint64_t borrow = 0;
+    for (uint32_t j = 0; j < L; j++) {
+      uint64_t vj = (j < n_limbs) ? v[j] : 0;
+      uint64_t s = vj - c_ext[j] - borrow;
+      d[j] = (uint32_t)s;
+      borrow = (s >> 63) & 1;
+    }
+    double sign = 1.0;
+    if (borrow) {  // negative: two's-complement negate to the magnitude
+      sign = -1.0;
+      uint64_t carry = 1;
+      for (uint32_t j = 0; j < L; j++) {
+        uint64_t s = (uint64_t)(uint32_t)~d[j] + carry;
+        d[j] = (uint32_t)s;
+        carry = s >> 32;
+      }
+    }
+    // top three limbs -> <= 96-bit chunk, exactly scaled by 2^(32*low)
+    int t = (int)L - 1;
+    while (t > 0 && d[t] == 0) t--;
+    unsigned __int128 chunk = d[t];
+    int low = t;
+    if (t >= 1) { chunk = (chunk << 32) | d[t - 1]; low = t - 1; }
+    if (t >= 2) { chunk = (chunk << 32) | d[t - 2]; low = t - 2; }
+    double d_hi = (double)chunk;  // <= 2^96: cast back below cannot overflow
+    double d_lo = (double)(__int128)(chunk - (unsigned __int128)d_hi);
+    // dd multiply (d_hi, d_lo) * (inv_hi, inv_lo); scale once at the end so
+    // neither the limb value nor the reciprocal needs to fit float64 range
+    double p, err;
+    two_prod(d_hi, inv_hi, p, err);
+    err += d_hi * inv_lo + d_lo * inv_hi;
+    out[i] = __builtin_ldexp(sign * (p + err), 32 * low + inv_exp);
+    }
+  };
+
+  const uint64_t work = n * (uint64_t)L;
+  unsigned nthreads = std::thread::hardware_concurrency();
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads < 2 || work < (1u << 22)) {
+    decode_range(0, n);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  uint64_t per = (n + nthreads - 1) / nthreads;
+  for (unsigned ti = 0; ti < nthreads; ti++) {
+    uint64_t lo = ti * per, hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    pool.emplace_back(decode_range, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
 
 // Fused participant masking for bounded-f32 configs with orders <= 128 bits:
 // per element, draw the next uniform mask value from the seed's keystream

@@ -1,0 +1,98 @@
+"""Vectorized exact-path unmask decode vs the Fraction oracle.
+
+``decode_vect_any`` replaces the per-element Python ``Fraction`` loop for
+every config family outside the bounded-f32 fast path (i32/i64/f64/Bmax).
+The reference computes these decodes in exact big-rational arithmetic
+(reference: rust/xaynet-core/src/mask/masking.rs:190-231); here the
+cancellation step is exact multi-limb integer arithmetic and the final
+rounding is double-double, verified against the Fraction oracle on every
+family, with both the native C++ kernel and the numpy fallback.
+"""
+
+import math
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.mask.config import (
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    ModelType,
+)
+from xaynet_tpu.core.mask.encode import decode_vect_any, decode_vect_exact
+from xaynet_tpu.ops import limbs as limb_ops
+
+CASES = [
+    MaskConfig(GroupType.INTEGER, DataType.I32, BoundType.B0, ModelType.M3),
+    MaskConfig(GroupType.INTEGER, DataType.I64, BoundType.B0, ModelType.M3),
+    MaskConfig(GroupType.PRIME, DataType.F64, BoundType.B6, ModelType.M6),
+    MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.BMAX, ModelType.M3),
+    MaskConfig(GroupType.POWER2, DataType.F64, BoundType.BMAX, ModelType.M9),
+    MaskConfig(GroupType.PRIME, DataType.I32, BoundType.B2, ModelType.M12),
+]
+
+
+def _check(cfg: MaskConfig, force_numpy: bool, monkeypatch):
+    if force_numpy:
+        monkeypatch.setenv("XAYNET_TPU_NO_NATIVE", "1")
+        import xaynet_tpu.utils.native as nat
+
+        monkeypatch.setattr(nat, "_tried", False)
+        monkeypatch.setattr(nat, "_lib", None)
+
+    rng = np.random.default_rng(7)
+    order = cfg.order
+    L = limb_ops.n_limbs_for_order(order)
+    nb, ssum = 3, Fraction(3, 7)
+    c = nb * int(cfg.add_shift) * cfg.exp_shift
+    # realistic unmasked values: near C (small decoded weights), plus extremes
+    vals = [min(order - 1, max(0, c + int(d))) for d in rng.integers(-(10**12), 10**12, 64)]
+    vals += [0, order - 1, min(order - 1, c)]
+    limbs = limb_ops.ints_to_limbs(vals, L)
+
+    want = decode_vect_exact(vals, cfg, nb, ssum)
+    got = decode_vect_any(limbs, cfg, nb, ssum)
+
+    for g, w in zip(got, want):
+        g = float(g)
+        if math.isinf(g):
+            # decoded magnitude exceeds float64 range (Bmax extremes): the
+            # oracle must agree it's out of range
+            assert abs(w) > Fraction(2) ** 1024
+            continue
+        err = abs(Fraction(g) - w)
+        # ~2^-95 relative from the top-96-bit rounding, plus the float64
+        # output rounding itself (2^-53 relative, or denormal absolute ulp)
+        tol = max(abs(w) * Fraction(1, 2**50), Fraction(1, 2**1070))
+        assert err <= tol, (cfg, float(w), g, float(err))
+
+
+@pytest.mark.parametrize("cfg", CASES, ids=lambda c: f"{c.group_type.name}-{c.data_type.name}-{c.bound_type.name}")
+def test_decode_native(cfg, monkeypatch):
+    _check(cfg, force_numpy=False, monkeypatch=monkeypatch)
+
+
+@pytest.mark.parametrize("cfg", CASES, ids=lambda c: f"{c.group_type.name}-{c.data_type.name}-{c.bound_type.name}")
+def test_decode_numpy_fallback(cfg, monkeypatch):
+    _check(cfg, force_numpy=True, monkeypatch=monkeypatch)
+
+
+def test_unmask_array_uses_vectorized_exact_path():
+    """Full unmask on an i64 config (no fast path) stays within tolerance."""
+    from xaynet_tpu.core.mask import Aggregation, Masker, MaskSeed, Scalar
+    from xaynet_tpu.core.mask.model import Model
+
+    # B2 bounds clamp weights to [-100, 100]; keep test values inside
+    cfg = MaskConfig(GroupType.INTEGER, DataType.I64, BoundType.B2, ModelType.M3)
+    pair = cfg.pair()
+    values = [-3, 0, 1, 2, 5, -1]
+    model = Model([Fraction(v) for v in values])
+    masker = Masker(pair, MaskSeed(b"\x17" * 32))
+    seed, masked = masker.mask(Scalar.unit(), model)
+    agg = Aggregation.from_object(masked)
+    mask = seed.derive_mask(len(values), pair)
+    out = agg.unmask_array(mask)
+    assert np.allclose(out, values, atol=2.0 / cfg.exp_shift)

@@ -82,6 +82,24 @@ def test_device_sampler_with_offset():
     assert got == expected
 
 
+def test_device_sampler_chunked_multi_chunk():
+    """A tiny chunk size forces many chunks; result must stay bit-exact."""
+    seed = b"\x0c" * 32
+    for order in (ORDERS[0], ORDERS[2]):
+        want = host_limbs.limbs_to_ints(StreamSampler(seed).draw_limbs(500, order))
+        got = host_limbs.limbs_to_ints(
+            np.asarray(chacha_jax.derive_uniform_limbs(seed, 500, order, chunk_candidates=97))
+        )
+        assert got == want
+
+
+def test_device_sampler_chunked_memory_bound():
+    """Chunk size is capped independently of count (the Sum2 memory fix)."""
+    order = ORDERS[0]
+    bpn = (order.bit_length() + 7) // 8
+    assert chacha_jax._CHUNK_BYTES_CAP // bpn < chacha_jax.provision_candidates(10**9, order)
+
+
 def test_derive_mask_device_matches_host():
     seed = MaskSeed(b"\x21" * 32)
     mask_host = seed.derive_mask(100, CFG.pair())

@@ -154,6 +154,83 @@ def _decode_native(limbs: np.ndarray, c_int: int, recip: Fraction):
     return out if rc == 0 else None
 
 
+def decode_vect_any(
+    limbs: np.ndarray, config: MaskConfig, nb_models: int, scalar_sum: Fraction
+) -> np.ndarray:
+    """Unmask decode -> float64 for ANY config family (arbitrary limb width).
+
+    Replaces the per-element ``Fraction`` loop for i32/i64/f64/Bmax configs:
+    the cancellation-prone step ``v - nb_models * A * E`` is done in exact
+    multi-limb integer arithmetic (native C++ when available, vectorized
+    numpy otherwise); the cancellation-free difference is then decoded in
+    double-double. Relative error ~2^-95 ≪ the 1/exp_shift protocol
+    tolerance (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
+    """
+    n, n_limb = limbs.shape
+    c_int = nb_models * int(config.add_shift) * config.exp_shift
+    recip = Fraction(1, 1) / (config.exp_shift * scalar_sum)
+    c_nlimbs = max(1, (c_int.bit_length() + 31) // 32)
+    c_limbs = limb_ops.int_to_limbs(c_int, c_nlimbs)
+    # normalized mantissa + exponent: BMAX reciprocals don't fit float64
+    inv_hi, inv_lo, inv_exp = dd.from_fraction_scaled(recip)
+
+    from ...utils import native
+
+    lib = native.load()
+    if lib is not None and hasattr(lib, "xn_decode_exact") and n_limb <= 96 and c_nlimbs <= 96:
+        arr = np.ascontiguousarray(limbs, dtype=np.uint32)
+        c_arr = np.ascontiguousarray(c_limbs, dtype=np.uint32)
+        out = np.empty(n, dtype=np.float64)
+        import ctypes
+
+        rc = lib.xn_decode_exact(
+            native.np_u32p(arr),
+            n,
+            n_limb,
+            native.np_u32p(c_arr),
+            c_nlimbs,
+            ctypes.c_double(inv_hi),
+            ctypes.c_double(inv_lo),
+            ctypes.c_int32(inv_exp),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        if rc == 0:
+            return out
+
+    # numpy fallback: exact vectorized limb subtract, then top-96-bit decode
+    ell = max(n_limb, c_nlimbs) + 1
+    c_ext = limb_ops.int_to_limbs(c_int, ell)
+    d = np.zeros((n, ell), dtype=np.uint32)
+    borrow = np.zeros(n, dtype=np.int64)
+    for j in range(ell):
+        vj = limbs[:, j].astype(np.int64) if j < n_limb else np.zeros(n, dtype=np.int64)
+        s = vj - int(c_ext[j]) - borrow
+        d[:, j] = (s & 0xFFFFFFFF).astype(np.uint32)
+        borrow = (s < 0).astype(np.int64)
+    neg = borrow == 1
+    if neg.any():  # two's-complement negate the negative rows
+        carry = neg.astype(np.int64)
+        for j in range(ell):
+            inv = np.where(neg, (~d[:, j]).astype(np.int64) & 0xFFFFFFFF, d[:, j].astype(np.int64))
+            s = inv + carry
+            d[:, j] = (s & 0xFFFFFFFF).astype(np.uint32)
+            carry = s >> 32
+    # top three limbs -> <= 96-bit double-double, exponent applied via ldexp
+    # (same scheme as the native kernel: no intermediate over/underflow)
+    rows = np.arange(n)
+    t = ell - 1 - np.argmax((d != 0)[:, ::-1], axis=1)  # top nonzero limb (0 if none)
+    l0 = d[rows, t].astype(np.float64)
+    l1 = np.where(t >= 1, d[rows, np.maximum(t - 1, 0)], 0).astype(np.float64)
+    l2 = np.where(t >= 2, d[rows, np.maximum(t - 2, 0)], 0).astype(np.float64)
+    hi = l0 * 18446744073709551616.0  # * 2^64, exact
+    hi, lo = dd.add_f(hi, np.zeros(n), l1 * 4294967296.0)  # + l1 * 2^32, exact
+    hi, lo = dd.add(hi, lo, l2, np.zeros(n))
+    hi, lo = dd.mul(hi, lo, np.full(n, inv_hi), np.full(n, inv_lo))
+    exp = (32 * (t.astype(np.int64) - 2) + inv_exp).astype(np.int32)
+    out = np.ldexp(hi, exp) + np.ldexp(lo, exp)
+    return np.where(neg, -out, out)
+
+
 def decode_vect_fast(
     limbs: np.ndarray, config: MaskConfig, nb_models: int, scalar_sum: Fraction
 ) -> np.ndarray:

@@ -27,6 +27,7 @@ from .config import MaskConfig, MaskConfigPair
 from .encode import (
     clamp_scalar,
     decode_scalar_sum,
+    decode_vect_any,
     decode_vect_exact,
     decode_vect_fast,
     encode_unit,
@@ -268,6 +269,4 @@ class Aggregation:
         scalar_sum = decode_scalar_sum(n_unit, config_1, self.nb_models)
         if has_fast_path(config_n):
             return decode_vect_fast(n_vect, config_n, self.nb_models, scalar_sum)
-        values = limb_ops.limbs_to_ints(n_vect)
-        decoded = decode_vect_exact(values, config_n, self.nb_models, scalar_sum)
-        return np.asarray([float(v) for v in decoded], dtype=np.float64)
+        return decode_vect_any(n_vect, config_n, self.nb_models, scalar_sum)

@@ -88,6 +88,59 @@ def parse_mask_unit(data: bytes, offset: int = 0) -> tuple[MaskUnit, int]:
     return unit, MASK_CONFIG_LENGTH + bpn
 
 
+def parse_mask_vect_stream(reader) -> MaskVect:
+    """Streaming MaskVect parse from a ``ChunkReader``.
+
+    The element block is copied chunk-by-chunk into one staging array
+    (consumed chunk buffers are freed as the reader advances), so peak
+    memory is ~1x the element block instead of the 2x of a concatenate-
+    then-parse (reference streaming parse:
+    rust/xaynet-core/src/mask/object/serialization/vect.rs + traits.rs).
+    """
+    head = reader.read(MASK_CONFIG_LENGTH + 4)
+    try:
+        config = MaskConfig.from_bytes(head[:MASK_CONFIG_LENGTH])
+    except ValueError as e:
+        raise DecodeError(f"invalid mask config: {e}") from e
+    (count,) = struct.unpack_from(">I", head, MASK_CONFIG_LENGTH)
+    bpn = config.bytes_per_number
+    nbytes = count * bpn
+    if nbytes > reader.remaining:
+        raise DecodeError("mask vector data truncated")
+    # segmented convert: fixed-size wire segments go straight into the limb
+    # tensor, so the transient staging is bounded (never O(payload))
+    n_limb = max(1, (bpn + 3) // 4)
+    limbs = np.empty((count, n_limb), dtype=np.uint32)
+    seg_elems = max(1, (2 << 20) // max(bpn, 1))
+    for s in range(0, count, seg_elems):
+        k = min(seg_elems, count - s)
+        staging = np.empty(k * bpn, dtype=np.uint8)
+        reader.read_into(staging)
+        limbs[s : s + k] = limb_ops.bytes_le_to_limbs(staging, k, bpn)
+    vect = MaskVect(config, limbs)
+    if not vect.is_valid():
+        raise DecodeError("mask vector element >= group order")
+    return vect
+
+
+def parse_mask_unit_stream(reader) -> MaskUnit:
+    """Streaming MaskUnit parse from a ``ChunkReader``."""
+    head = reader.read(MASK_CONFIG_LENGTH)
+    try:
+        config = MaskConfig.from_bytes(head)
+    except ValueError as e:
+        raise DecodeError(f"invalid mask config: {e}") from e
+    bpn = config.bytes_per_number
+    if bpn > reader.remaining:
+        raise DecodeError("mask unit data truncated")
+    data = np.frombuffer(reader.read(bpn), dtype=np.uint8)
+    limbs = limb_ops.bytes_le_to_limbs(data, 1, bpn)
+    unit = MaskUnit(config, limbs[0])
+    if not unit.is_valid():
+        raise DecodeError("mask unit element >= group order")
+    return unit
+
+
 def serialize_mask_object(obj: MaskObject) -> bytes:
     return serialize_mask_vect(obj.vect) + serialize_mask_unit(obj.unit)
 

@@ -1,4 +1,4 @@
-"""Message encoding with multipart chunking.
+"""Message encoding with multipart chunking and streaming reassembly.
 
 Reference behavior (rust/xaynet-sdk/src/message_encoder/encoder.rs:14-180):
 a payload larger than ``max_payload_size`` is split into signed ``Chunk``
@@ -6,14 +6,21 @@ messages (8-byte chunk header, shared random ``message_id``, ascending
 chunk ids, LAST_CHUNK flag on the final part); each part is an
 independently signed PET message carrying the original tag with the
 MULTIPART flag set. The receiver reassembles by (participant_pk,
-message_id) and re-parses the concatenated payload.
+message_id) and re-parses the payload *incrementally* through a
+``ChunkReader`` — the analogue of the reference's chunkable byte-iterator
+(rust/xaynet-core/src/message/utils/chunkable_iterator.rs:17-60): chunk
+buffers are consumed (and freed) as the parser advances, so a payload near
+the protocol's 4 GiB message ceiling never needs a second contiguous copy.
 """
 
 from __future__ import annotations
 
 import os
 import struct
+from collections import deque
 from typing import Iterator
+
+import numpy as np
 
 from .message import HEADER_LENGTH, Message
 from .payloads import CHUNK_HEADER_LENGTH, Chunk
@@ -71,6 +78,56 @@ class MessageEncoder:
             yield part.to_bytes(self.secret_signing_key)
 
 
+class ChunkReader:
+    """Sequential reader over an ordered sequence of chunk buffers.
+
+    The streaming-parse analogue of the reference's ``ChunkableIterator``
+    (rust/xaynet-core/src/message/utils/chunkable_iterator.rs:17-60): small
+    header reads may join a few bytes across a chunk boundary, but bulk
+    element blocks are copied chunk-by-chunk straight into their destination
+    array (``read_into``), and consumed chunks are dropped immediately — the
+    payload is never materialized contiguously a second time.
+    """
+
+    def __init__(self, chunks: list[bytes]):
+        self._chunks: deque[bytes] = deque(chunks)
+        self._pos = 0  # read offset within the head chunk
+        self.remaining = sum(len(c) for c in chunks)
+
+    def _advance(self, take: int) -> None:
+        self._pos += take
+        self.remaining -= take
+        if self._pos >= len(self._chunks[0]):
+            self._chunks.popleft()  # frees the consumed chunk buffer
+            self._pos = 0
+
+    def read(self, n: int) -> bytes:
+        """``n`` bytes as a (small) contiguous value — for headers/dicts."""
+        if n > self.remaining:
+            raise ValueError(f"chunk stream truncated: need {n}, have {self.remaining}")
+        parts = []
+        while n > 0:
+            head = self._chunks[0]
+            take = min(n, len(head) - self._pos)
+            parts.append(head[self._pos : self._pos + take])
+            self._advance(take)
+            n -= take
+        return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    def read_into(self, out: np.ndarray) -> None:
+        """Fill a preallocated ``uint8[n]`` array — for bulk element blocks."""
+        n = out.size
+        if n > self.remaining:
+            raise ValueError(f"chunk stream truncated: need {n}, have {self.remaining}")
+        off = 0
+        while off < n:
+            head = self._chunks[0]
+            take = min(n - off, len(head) - self._pos)
+            out[off : off + take] = np.frombuffer(head, np.uint8, take, self._pos)
+            self._advance(take)
+            off += take
+
+
 class MessageBuilder:
     """Server-side reassembly of one multipart message's chunks.
 
@@ -94,6 +151,18 @@ class MessageBuilder:
         if self._last_id is None:
             return False
         return all(i in self._chunks for i in range(1, self._last_id + 1))
+
+    def take_reader(self) -> ChunkReader:
+        """Hand the buffered chunks off to a streaming reader.
+
+        The builder's own references are dropped so each chunk's memory is
+        owned solely by the reader and freed as parsing consumes it.
+        """
+        if not self.is_complete():
+            raise ValueError("message is not complete")
+        assert self._last_id is not None
+        chunks = [self._chunks.pop(i) for i in range(1, self._last_id + 1)]
+        return ChunkReader(chunks)
 
     def payload_bytes(self) -> bytes:
         if not self.is_complete():

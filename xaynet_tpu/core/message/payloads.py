@@ -24,6 +24,8 @@ from ..mask.seed import ENCRYPTED_MASK_SEED_LENGTH, EncryptedMaskSeed
 from ..mask.serialization import (
     DecodeError,
     parse_mask_object,
+    parse_mask_unit_stream,
+    parse_mask_vect_stream,
     serialize_mask_object,
 )
 
@@ -68,6 +70,10 @@ def serialize_local_seed_dict(seed_dict: dict) -> bytes:
 
 def parse_local_seed_dict(data: bytes, offset: int = 0) -> tuple[dict, int]:
     value, consumed = lv_decode(data, offset)
+    return _seed_dict_from_value(value), consumed
+
+
+def _seed_dict_from_value(value: bytes) -> dict:
     if len(value) % SEED_DICT_ENTRY_LENGTH != 0:
         raise DecodeError("seed dict length not a multiple of the entry size")
     out: dict = {}
@@ -77,7 +83,16 @@ def parse_local_seed_dict(data: bytes, offset: int = 0) -> tuple[dict, int]:
         if pk in out:
             raise DecodeError("duplicate sum pk in seed dict")
         out[pk] = seed
-    return out, consumed
+    return out
+
+
+def parse_local_seed_dict_stream(reader) -> dict:
+    (length,) = struct.unpack(">I", reader.read(4))
+    if length < 4:
+        raise DecodeError("LV length below minimum")
+    if length - 4 > reader.remaining:
+        raise DecodeError("LV value truncated")
+    return _seed_dict_from_value(reader.read(length - 4))
 
 
 # --- payloads ---------------------------------------------------------------
@@ -142,6 +157,19 @@ class Update:
             local_seed_dict=seed_dict,
         )
 
+    @classmethod
+    def from_stream(cls, reader) -> "Update":
+        sigs = reader.read(2 * SIGNATURE_LENGTH)
+        vect = parse_mask_vect_stream(reader)
+        unit = parse_mask_unit_stream(reader)
+        seed_dict = parse_local_seed_dict_stream(reader)
+        return cls(
+            sum_signature=sigs[:SIGNATURE_LENGTH],
+            update_signature=sigs[SIGNATURE_LENGTH:],
+            masked_model=MaskObject(vect, unit),
+            local_seed_dict=seed_dict,
+        )
+
 
 @dataclass
 class Sum2:
@@ -164,6 +192,13 @@ class Sum2:
             raise DecodeError("sum2 payload too short")
         mask, _ = parse_mask_object(data, SIGNATURE_LENGTH)
         return cls(sum_signature=data[:SIGNATURE_LENGTH], model_mask=mask)
+
+    @classmethod
+    def from_stream(cls, reader) -> "Sum2":
+        sig = reader.read(SIGNATURE_LENGTH)
+        vect = parse_mask_vect_stream(reader)
+        unit = parse_mask_unit_stream(reader)
+        return cls(sum_signature=sig, model_mask=MaskObject(vect, unit))
 
 
 @dataclass
@@ -211,4 +246,26 @@ def parse_payload(tag, is_multipart: bool, data: bytes) -> Payload:
         return Update.from_bytes(data)
     if tag == Tag.SUM2:
         return Sum2.from_bytes(data)
+    raise DecodeError(f"unknown tag {tag}")
+
+
+def parse_payload_stream(tag, reader) -> Payload:
+    """Streaming payload parse from a ``ChunkReader`` (multipart reassembly).
+
+    Reference analogue: the stream variants of ``FromBytes``
+    (rust/xaynet-core/src/message/traits.rs) used by the multipart service.
+    """
+    from .message import Tag  # local import to avoid cycle
+
+    try:
+        if tag == Tag.SUM:
+            return Sum.from_bytes(reader.read(reader.remaining))
+        if tag == Tag.UPDATE:
+            return Update.from_stream(reader)
+        if tag == Tag.SUM2:
+            return Sum2.from_stream(reader)
+    except ValueError as e:
+        if isinstance(e, DecodeError):
+            raise
+        raise DecodeError(str(e)) from e
     raise DecodeError(f"unknown tag {tag}")

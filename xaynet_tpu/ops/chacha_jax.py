@@ -5,16 +5,20 @@ Device counterpart of ``xaynet_tpu.core.crypto.chacha`` /
 work — and mask derivation (seed -> ``len`` uniform group elements,
 reference: rust/xaynet-core/src/mask/seed.rs:61-78) becomes:
 
-1. generate a statically over-provisioned batch of keystream blocks
-   (all blocks in parallel: lanes = blocks);
+1. generate a fixed-size chunk of keystream blocks (all blocks in parallel:
+   lanes = blocks);
 2. chop into fixed-width little-endian candidates;
 3. rejection-filter (candidate < order) with a scatter compaction instead of
-   a data-dependent loop, keeping shapes static under jit.
+   a data-dependent loop, keeping shapes static under jit;
+4. repeat from the next keystream byte offset until ``count`` accepted.
 
-The over-provisioning factor is chosen so the probability of producing fewer
-than ``count`` accepted candidates is < 2^-60; the (astronomically rare)
-shortfall is detected by the caller and falls back to the host sampler,
-preserving bit-exactness unconditionally.
+Each chunk consumes exactly ``chunk_candidates * bpn`` keystream bytes
+regardless of how many candidates are accepted, so the byte-offset handoff
+between chunks is deterministic; only the number accepted so far (one scalar
+per chunk) syncs to the host. Memory is bounded by the chunk size, never by
+``count`` — a 25M-element mask derives in ~4M-candidate steps instead of one
+31M-candidate allocation. The per-chunk size is provisioned so that small
+draws complete in a single chunk with probability > 1 - 2^-60.
 """
 
 from __future__ import annotations
@@ -46,14 +50,16 @@ def _quarter(s, a, b, c, d):
     return s
 
 
-@partial(jax.jit, static_argnames=("nblocks", "block_start"))
-def keystream_words(key_words: jax.Array, block_start: int, nblocks: int) -> jax.Array:
-    """ChaCha20 keystream as ``uint32[nblocks, 16]`` little-endian words."""
+@partial(jax.jit, static_argnames=("nblocks",))
+def keystream_words(key_words: jax.Array, block_start, nblocks: int) -> jax.Array:
+    """ChaCha20 keystream as ``uint32[nblocks, 16]`` little-endian words.
+
+    ``block_start`` may be a traced uint32 scalar (chunked derivation passes
+    a fresh offset every chunk without recompiling).
+    """
     # 64-bit block counter in words 12-13; counters stay below 2^32 here
     # (2^32 blocks = 256 GiB of keystream per seed), so word 13 is constant.
-    if block_start + nblocks > 0xFFFFFFFF:
-        raise ValueError("keystream longer than 2^32 blocks is not supported on device")
-    counters = _U32(block_start) + jnp.arange(nblocks, dtype=_U32)
+    counters = jnp.asarray(block_start, dtype=_U32) + jnp.arange(nblocks, dtype=_U32)
     state = [jnp.broadcast_to(_U32(c), (nblocks,)) for c in _CONSTANTS]
     state += [jnp.broadcast_to(key_words[i], (nblocks,)) for i in range(8)]
     state.append(counters)
@@ -94,30 +100,39 @@ def provision_candidates(count: int, order: int) -> int:
     return int(c)
 
 
+# Per-chunk keystream budget: bounds device memory independently of `count`.
+# 32 MiB of candidate bytes ≈ 5.6M candidates at the common bpn=6.
+_CHUNK_BYTES_CAP = 32 * 1024 * 1024
+
+
 @partial(
     jax.jit,
-    static_argnames=("count", "n_cand", "bpn", "out_limbs", "order_tuple", "byte_offset"),
+    static_argnames=("n_cand", "bpn", "out_limbs", "order_tuple"),
+    donate_argnums=(0,),
 )
-def _derive_kernel(
+def _derive_chunk(
+    out: jax.Array,
+    base: jax.Array,
     key_words: jax.Array,
-    count: int,
+    block_start: jax.Array,
+    intra: jax.Array,
     n_cand: int,
     bpn: int,
     out_limbs: int,
     order_tuple: tuple[int, ...],
-    byte_offset: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
-    """Keystream -> candidates -> compacted accepted limbs (static shapes).
+    """One fixed-size chunk of keystream -> accepted limbs scattered into ``out``.
 
-    ``byte_offset`` skips keystream bytes already consumed by earlier draws
-    on the same stream (e.g. the unit draw preceding the vector draws).
+    ``base`` (elements accepted by previous chunks), ``block_start`` and
+    ``intra`` (keystream cursor) are traced scalars, so every chunk reuses one
+    compiled kernel. Accepted candidate ``i`` lands at ``out[base + rank(i)]``;
+    rejected candidates and overflow past ``len(out)`` are scatter-dropped.
     """
     nbytes = n_cand * bpn
-    block_start = byte_offset // 64
-    intra = byte_offset % 64
-    nblocks = -(-(intra + nbytes) // 64)
+    nblocks = nbytes // 64 + 2  # +2 covers any intra-block offset in [0, 64)
     words = keystream_words(key_words, block_start, nblocks)
-    stream = _words_to_bytes(words).reshape(-1)[intra : intra + nbytes]
+    stream = _words_to_bytes(words).reshape(-1)
+    stream = jax.lax.dynamic_slice(stream, (intra,), (nbytes,))
 
     cand_limbs = max(1, (bpn + 3) // 4)
     padded = jnp.zeros((n_cand, cand_limbs * 4), dtype=jnp.uint8)
@@ -132,53 +147,66 @@ def _derive_kernel(
     )
 
     # acceptance: lexicographic candidate < order
-    order_arr = np.asarray(order_tuple, dtype=np.uint32)
     lt = jnp.zeros(n_cand, dtype=bool)
     decided = jnp.zeros(n_cand, dtype=bool)
     for j in range(cand_limbs - 1, -1, -1):
         col = cand[:, j]
-        o = _U32(int(order_arr[j]))
+        o = _U32(int(order_tuple[j]))
         lt = lt | (~decided & (col < o))
         decided = decided | (col != o)
 
-    # compaction: accepted candidate i goes to slot rank(i); drop overflow
+    count = out.shape[0]
     rank = jnp.cumsum(lt.astype(jnp.int32)) - 1
-    slot = jnp.where(lt, rank, count)  # rejected -> out-of-range slot
-    out = jnp.zeros((count + 1, cand_limbs), dtype=_U32)
-    out = out.at[slot].set(cand, mode="drop")
+    slot = jnp.where(lt, base + rank, count)  # rejected -> dropped
+    out = out.at[slot].set(cand[:, :out_limbs], mode="drop")
     n_accepted = rank[-1] + 1
-    return out[:count, :out_limbs], n_accepted
+    return out, n_accepted
 
 
 def derive_uniform_limbs(
-    seed: bytes, count: int, order: int, byte_offset: int = 0
+    seed: bytes,
+    count: int,
+    order: int,
+    byte_offset: int = 0,
+    chunk_candidates: int | None = None,
 ) -> jax.Array:
     """Device mask expansion: ``count`` uniform elements below ``order``.
 
     Bit-identical to the host ``StreamSampler`` (same keystream, same
-    rejection rule). Falls back to the host sampler on the ~2^-60 shortfall.
+    rejection rule, same acceptance order), derived in fixed-size keystream
+    chunks so device memory is bounded by the chunk size, not by ``count``.
+    Small draws are provisioned to finish in one chunk w.p. > 1 - 2^-60; the
+    loop simply continues on the next chunk otherwise, so the result is
+    unconditionally exact with no host fallback.
     """
-    from ..core.crypto import prng as host_prng
     from . import limbs as host_limbs
 
     bpn = (order.bit_length() + 7) // 8
     cand_limbs = max(1, (bpn + 3) // 4)
     out_limbs = host_limbs.n_limbs_for_order(order)
-    order_cl = host_limbs.int_to_limbs(order, cand_limbs)
-    n_cand = provision_candidates(count, order)
+    order_cl = tuple(int(x) for x in host_limbs.int_to_limbs(order, cand_limbs))
+    if chunk_candidates is None:
+        chunk_candidates = provision_candidates(count, order)
+    chunk_candidates = max(64, min(chunk_candidates, _CHUNK_BYTES_CAP // bpn))
+
     key_words = jnp.asarray(np.frombuffer(seed, dtype="<u4"))
-    out, n_accepted = _derive_kernel(
-        key_words,
-        count,
-        n_cand,
-        bpn,
-        out_limbs,
-        tuple(int(x) for x in order_cl),
-        byte_offset,
-    )
-    if int(n_accepted) < count:  # pragma: no cover — probability < 2^-60
-        sampler = host_prng.StreamSampler(seed)
-        if byte_offset:
-            sampler.skip_bytes(byte_offset)
-        return jnp.asarray(sampler.draw_limbs(count, order))
+    out = jnp.zeros((count, out_limbs), dtype=_U32)
+    base, offset = 0, byte_offset
+    while base < count:
+        block_start, intra = divmod(offset, 64)
+        if block_start + chunk_candidates * bpn // 64 + 2 > 0xFFFFFFFF:
+            raise ValueError("keystream longer than 2^32 blocks is not supported on device")
+        out, n_acc = _derive_chunk(
+            out,
+            jnp.asarray(base, dtype=jnp.int32),
+            key_words,
+            jnp.asarray(block_start, dtype=_U32),
+            jnp.asarray(intra, dtype=jnp.int32),
+            chunk_candidates,
+            bpn,
+            out_limbs,
+            order_cl,
+        )
+        base += int(n_acc)
+        offset += chunk_candidates * bpn
     return out

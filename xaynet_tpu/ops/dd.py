@@ -61,6 +61,26 @@ def from_fraction(f) -> tuple[float, float]:
     return hi, lo
 
 
+def from_fraction_scaled(f) -> tuple[float, float, int]:
+    """Scalar Fraction -> (m_hi, m_lo, k) with value = (m_hi + m_lo) * 2^k.
+
+    The mantissa is normalized into [1/2, 2), so fractions whose magnitude
+    over- or under-flows float64 (e.g. the reciprocal of a BMAX exp_shift)
+    are still represented exactly to ~106 bits; the caller applies ``2^k``
+    via ldexp after its multiplications.
+    """
+    from fractions import Fraction
+
+    f = Fraction(f)
+    if f == 0:
+        return 0.0, 0.0, 0
+    k = f.numerator.bit_length() - f.denominator.bit_length()
+    m = f / Fraction(2) ** k  # |m| in [1/2, 2)
+    hi = float(m)
+    lo = float(m - Fraction(hi))
+    return hi, lo, k
+
+
 def add(a_hi, a_lo, b_hi, b_lo):
     s, e = two_sum(a_hi, b_hi)
     e = e + a_lo + b_lo

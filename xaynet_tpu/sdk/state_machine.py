@@ -14,6 +14,7 @@ at any point.
 
 from __future__ import annotations
 
+import base64
 import enum
 import json
 import logging
@@ -99,7 +100,12 @@ class StateMachine:
         self.sum_signature: Optional[bytes] = None
         self.update_signature: Optional[bytes] = None
         self.ephm_keys: Optional[EncryptKeyPair] = None
-        self._message_sent = False
+        # chunk-level send retry (reference: sending.rs:96-113): encrypted
+        # parts not yet accepted by the coordinator; on a send failure only
+        # the failed part (and its successors) are retried on later ticks,
+        # never the parts that already went through
+        self._pending_sends: list[bytes] = []
+        self._after_send_phase: Optional[PhaseKind] = None
 
     # --- driving ----------------------------------------------------------
 
@@ -116,6 +122,9 @@ class StateMachine:
             self.phase = PhaseKind.NEW_ROUND
             self.notify.new_round()
 
+        if self._pending_sends:
+            return await self._drain_sends()
+
         handler = {
             PhaseKind.AWAITING: self._step_awaiting,
             PhaseKind.NEW_ROUND: self._step_new_round,
@@ -130,7 +139,8 @@ class StateMachine:
         self.sum_signature = None
         self.update_signature = None
         self.ephm_keys = None
-        self._message_sent = False
+        self._pending_sends = []
+        self._after_send_phase = None
 
     # --- phases -----------------------------------------------------------
 
@@ -164,16 +174,11 @@ class StateMachine:
         assert self.round_params is not None and self.sum_signature is not None
         if self.ephm_keys is None:
             self.ephm_keys = EncryptKeyPair.generate()
-        if not self._message_sent:
-            payload = Sum(
-                sum_signature=self.sum_signature,
-                ephm_pk=self.ephm_keys.public.as_bytes(),
-            )
-            await self._send(payload)
-            self._message_sent = True
-        self.phase = PhaseKind.SUM2
-        self._message_sent = False
-        return TransitionOutcome.COMPLETE
+        payload = Sum(
+            sum_signature=self.sum_signature,
+            ephm_pk=self.ephm_keys.public.as_bytes(),
+        )
+        return await self._send(payload, PhaseKind.SUM2)
 
     async def _step_update(self) -> TransitionOutcome:
         """Train, mask, encrypt seeds, upload (update.rs:134-258)."""
@@ -203,9 +208,7 @@ class StateMachine:
             masked_model=masked_model,
             local_seed_dict=local_seed_dict,
         )
-        await self._send(payload)
-        self.phase = PhaseKind.AWAITING
-        return TransitionOutcome.COMPLETE
+        return await self._send(payload, PhaseKind.AWAITING)
 
     # with device_sum2 enabled, models above this size use the JAX device
     # kernels for mask derivation + aggregation (the Sum2 participant hot
@@ -228,9 +231,7 @@ class StateMachine:
         mask_obj = self._aggregate_masks(mask_seeds, length, config)
 
         payload = Sum2(sum_signature=self.sum_signature, model_mask=mask_obj)
-        await self._send(payload)
-        self.phase = PhaseKind.AWAITING
-        return TransitionOutcome.COMPLETE
+        return await self._send(payload, PhaseKind.AWAITING)
 
     def _aggregate_masks(self, mask_seeds, length: int, config) -> MaskObject:
         if self.device_sum2 and length >= self.DEVICE_SUM2_THRESHOLD:
@@ -264,9 +265,15 @@ class StateMachine:
 
     # --- sending ----------------------------------------------------------
 
-    async def _send(self, payload) -> None:
+    async def _send(self, payload, next_phase: PhaseKind) -> TransitionOutcome:
         """Sign, chunk if oversized, sealed-box encrypt, POST
-        (sending.rs:23-121)."""
+        (sending.rs:23-121).
+
+        Parts that fail to send stay queued and are retried on later ticks
+        (chunk-level retry, reference sending.rs:96-113) — already-delivered
+        chunks are never re-sent; the phase only advances once every part is
+        through.
+        """
         assert self.round_params is not None
         message = Message(
             participant_pk=self.keys.public,
@@ -274,9 +281,30 @@ class StateMachine:
             payload=payload,
         )
         coordinator_pk = PublicEncryptKey(self.round_params.pk)
-        for part in MessageEncoder(message, self.keys.secret, self.max_message_size):
-            encrypted = coordinator_pk.encrypt(part)
-            await self.client.send_message(encrypted)
+        self._pending_sends = [
+            coordinator_pk.encrypt(part)
+            for part in MessageEncoder(message, self.keys.secret, self.max_message_size)
+        ]
+        self._after_send_phase = next_phase
+        return await self._drain_sends()
+
+    async def _drain_sends(self) -> TransitionOutcome:
+        while self._pending_sends:
+            try:
+                await self.client.send_message(self._pending_sends[0])
+            except Exception as e:
+                logger.info(
+                    "chunk send failed (%d parts outstanding); retrying on a "
+                    "later tick: %s",
+                    len(self._pending_sends),
+                    e,
+                )
+                return TransitionOutcome.PENDING
+            self._pending_sends.pop(0)
+        if self._after_send_phase is not None:
+            self.phase = self._after_send_phase
+            self._after_send_phase = None
+        return TransitionOutcome.COMPLETE
 
     # --- persistence ------------------------------------------------------
 
@@ -293,6 +321,10 @@ class StateMachine:
             "update_signature": self.update_signature.hex() if self.update_signature else None,
             "ephm_secret": self.ephm_keys.secret.as_bytes().hex() if self.ephm_keys else None,
             "round_params": self.round_params.to_dict() if self.round_params else None,
+            # in-flight multipart send state (chunk-level retry resumes
+            # exactly where it stopped, reference sending.rs sending state)
+            "pending_sends": [base64.b64encode(p).decode() for p in self._pending_sends],
+            "after_send_phase": self._after_send_phase.value if self._after_send_phase else None,
         }
         return json.dumps(d).encode()
 
@@ -322,4 +354,7 @@ class StateMachine:
             machine.ephm_keys = EncryptKeyPair.derive_from_seed(bytes.fromhex(d["ephm_secret"]))
         if d["round_params"]:
             machine.round_params = RoundParameters.from_dict(d["round_params"])
+        machine._pending_sends = [base64.b64decode(p) for p in d.get("pending_sends", [])]
+        if d.get("after_send_phase"):
+            machine._after_send_phase = PhaseKind(d["after_send_phase"])
         return machine

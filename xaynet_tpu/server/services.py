@@ -124,11 +124,13 @@ class PetMessageHandler:
         if not builder.add(chunk):
             return None
         del self._multipart[key]
-        payload_bytes = builder.payload_bytes()
-        from ..core.message.payloads import parse_payload
+        # streaming parse: chunk buffers are consumed as the parser reads,
+        # never concatenated (reference: multipart/service.rs streaming
+        # FromBytes re-parse; chunkable_iterator.rs:17-60)
+        from ..core.message.payloads import parse_payload_stream
 
         try:
-            payload = parse_payload(message.tag, False, payload_bytes)
+            payload = parse_payload_stream(message.tag, builder.take_reader())
         except DecodeError as e:
             raise ServiceError("multipart", str(e)) from e
         return Message(

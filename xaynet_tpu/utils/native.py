@@ -18,6 +18,8 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
+_ABI_VERSION = 2
+
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
@@ -42,13 +44,22 @@ def load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("XAYNET_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
-        _build()
+    # rebuild BEFORE the first dlopen: once a (stale) library is loaded,
+    # re-dlopening the same path returns the already-loaded image, so the
+    # staleness check must be mtime-based, not load-and-inspect
+    if os.path.isdir(_NATIVE_DIR):
+        src = os.path.join(_NATIVE_DIR, "xaynet_native.cpp")
+        stale = os.path.exists(src) and (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        )
+        if stale:
+            _build()
     if not os.path.exists(_LIB_PATH):
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-        if lib.xn_abi_version() != 1:
+        if lib.xn_abi_version() != _ABI_VERSION:
             logger.warning("native library ABI mismatch; using python fallback")
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -79,6 +90,18 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),
         ]
         lib.xn_decode_f64.restype = ctypes.c_int
+        lib.xn_decode_exact.argtypes = [
+            u32p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            u32p,
+            ctypes.c_uint32,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.xn_decode_exact.restype = ctypes.c_int
         lib.xn_mask_f32.argtypes = [
             u8p,
             ctypes.c_uint64,
