@@ -1,0 +1,75 @@
+"""Worker process for the 2-process multi-host aggregation test.
+
+Each worker is one "host": it joins the JAX distributed runtime, owns 4
+virtual CPU devices of the 8-device global mesh, parses/stages ONLY its
+slice of the model axis, and verifies its slice of the unmasked result
+against the host oracle. Run by tests/test_multihost.py, never directly
+by pytest.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xaynet_tpu.core.mask.config import (  # noqa: E402
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    ModelType,
+)
+from xaynet_tpu.ops import limbs as host_limbs  # noqa: E402
+from xaynet_tpu.parallel.multihost import MultiHostAggregator, initialize  # noqa: E402
+
+
+def main() -> None:
+    port, process_id = sys.argv[1], int(sys.argv[2])
+    initialize(f"127.0.0.1:{port}", num_processes=2, process_id=process_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    order = config.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    ol = host_limbs.order_limbs_for(order)
+    model_len, k = 1000, 6  # deliberately NOT divisible by 8 (exercises padding)
+
+    # identical deterministic data on both workers; each stages its slice
+    rng = np.random.default_rng(123)
+    # valid group elements: bound the top limb so value < order
+    top = int(order >> 32)
+    wire = rng.integers(0, 1 << 32, size=(k, model_len, n_limb), dtype=np.uint32)
+    wire[:, :, n_limb - 1] = rng.integers(0, top, size=(k, model_len), dtype=np.uint32)
+    mask = rng.integers(0, 1 << 32, size=(model_len, n_limb), dtype=np.uint32)
+    mask[:, n_limb - 1] = rng.integers(0, top, size=model_len, dtype=np.uint32)
+
+    agg = MultiHostAggregator(config, model_len)
+    lo, hi = agg.local_slice
+    assert hi > lo, (lo, hi)
+    agg.add_local_batch(wire[:, lo:hi, :])
+    assert agg.nb_models == k
+
+    out_local = agg.unmask_local(mask[lo:hi])
+
+    # host oracle over the full model; compare this worker's slice
+    expected = host_limbs.batch_mod_sum(wire, ol)
+    expected = host_limbs.mod_sub(expected, mask, ol)
+    assert np.array_equal(out_local, expected[lo:hi]), "unmasked slice mismatch"
+
+    print(f"WORKER {process_id} OK slice=[{lo},{hi})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,263 @@
+"""Byzantine-participant and griefing defenses.
+
+Mirrors the reference's defensive surface (reference:
+rust/xaynet-server/src/services/messages/task_validator.rs:40-88,
+multipart/service.rs:26-117, state_machine/phases/unmask.rs:96-115):
+structurally-valid-but-hostile inputs must be rejected into the right
+counter, never crash a phase, and never grow coordinator memory without
+bound.
+"""
+
+import asyncio
+
+import pytest
+
+from xaynet_tpu.core.crypto.encrypt import PublicEncryptKey
+from xaynet_tpu.core.crypto.prng import uniform_ints
+from xaynet_tpu.core.mask import (
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    MaskObject,
+    ModelType,
+)
+from xaynet_tpu.core.message import Message, Sum, Tag, Update
+from xaynet_tpu.core.message.payloads import Chunk
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.server.requests import RequestError
+from xaynet_tpu.server.services import PetMessageHandler, ServiceError
+from xaynet_tpu.server.settings import CountSettings, Settings
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
+
+
+class _CountingMetrics:
+    """Recorder stub: counts (measurement, phase) pairs."""
+
+    def __init__(self):
+        self.counts: dict[tuple[str, str], int] = {}
+
+    def _bump(self, name, phase):
+        self.counts[(name, phase)] = self.counts.get((name, phase), 0) + 1
+
+    def message_accepted(self, round_id, phase):
+        self._bump("accepted", phase)
+
+    def message_rejected(self, round_id, phase):
+        self._bump("rejected", phase)
+
+    def message_discarded(self, round_id, phase):
+        self._bump("discarded", phase)
+
+    def __getattr__(self, name):  # every other measurement is a no-op
+        return lambda *a, **k: None
+
+
+def _settings(tmax=5.0):
+    s = Settings.default()
+    s.mask.group_type = CFG.group_type
+    s.mask.data_type = CFG.data_type
+    s.mask.bound_type = CFG.bound_type
+    s.mask.model_type = CFG.model_type
+    s.model.length = 6
+    for phase in (s.pet.sum, s.pet.update, s.pet.sum2):
+        phase.time.min = 0.0
+        phase.time.max = tmax
+    return s
+
+
+def _store():
+    return Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+
+
+async def _until_phase(events, name):
+    while events.phase.get_latest().event.value != name:
+        await asyncio.sleep(0.01)
+
+
+def _encrypt_for(params, payload, keys, tag=None, is_multipart=False):
+    msg = Message(
+        participant_pk=keys.public,
+        coordinator_pk=params.pk,
+        payload=payload,
+        tag=tag,
+        is_multipart=is_multipart,
+    )
+    return PublicEncryptKey(params.pk).encrypt(msg.to_bytes(keys.secret))
+
+
+def _masked_model(seed: int, n: int = 6) -> MaskObject:
+    ints = uniform_ints(bytes([seed]) * 32, n + 1, CFG.order)
+    return MaskObject.new(CFG.pair(), ints[1:], ints[0])
+
+
+async def _drive_to_update(settings, store, metrics, n_summers=2):
+    """Start a coordinator, fill the sum phase, land in update phase."""
+    machine, tx, events = await StateMachineInitializer(settings, store, metrics).init()
+    handler = PetMessageHandler(events, tx)
+    machine_task = asyncio.create_task(machine.run())
+    await _until_phase(events, "sum")
+    params = events.params.get_latest().event
+    seed = params.seed.as_bytes()
+    summers = []
+    start = 0
+    while len(summers) < n_summers:
+        k = keys_for_task(seed, params.sum, params.update, "sum", start=start)
+        start += 100000
+        if all(k.public != s.public for s in summers):
+            summers.append(k)
+    for i, k in enumerate(summers):
+        payload = Sum(
+            sum_signature=k.sign(seed + b"sum").as_bytes(), ephm_pk=bytes([i + 1]) * 32
+        )
+        await handler.handle_message(_encrypt_for(params, payload, k))
+    await _until_phase(events, "update")
+    return machine, machine_task, handler, events, params, summers
+
+
+async def _stop(machine_task):
+    machine_task.cancel()
+    try:
+        await machine_task
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+def _updater(params, start=0):
+    seed = params.seed.as_bytes()
+    return keys_for_task(seed, params.sum, params.update, "update", start=start)
+
+
+def _update_payload(params, keys, seed_dict):
+    seed = params.seed.as_bytes()
+    return Update(
+        sum_signature=keys.sign(seed + b"sum").as_bytes(),
+        update_signature=keys.sign(seed + b"update").as_bytes(),
+        masked_model=_masked_model(3),
+        local_seed_dict=seed_dict,
+    )
+
+
+def test_seed_dict_targeting_subset_rejected():
+    """A seed dict covering only SOME sum participants (an attempt to bias
+    which summers can reconstruct the mask) is atomically rejected with
+    LENGTH_MISMATCH and lands in the rejected counter."""
+
+    async def run():
+        settings = _settings()
+        settings.pet.sum.count = CountSettings(2, 2)
+        settings.pet.update.count = CountSettings(3, 3)  # protocol floor is 3
+        metrics = _CountingMetrics()
+        store = _store()
+        machine, machine_task, handler, events, params, summers = await _drive_to_update(
+            settings, store, metrics
+        )
+        try:
+            updater = _updater(params)
+            # subset: only the FIRST summer gets a seed
+            subset = {summers[0].public: b"\x07" * 80}
+            with pytest.raises(RequestError) as e:
+                await handler.handle_message(
+                    _encrypt_for(params, _update_payload(params, updater, subset), updater)
+                )
+            assert e.value.kind is RequestError.Kind.MESSAGE_REJECTED
+            assert metrics.counts.get(("rejected", "update")) == 1
+            # seed dict of the right SIZE but with an unknown sum pk
+            unknown = {summers[0].public: b"\x07" * 80, b"\xee" * 32: b"\x07" * 80}
+            with pytest.raises(RequestError) as e:
+                await handler.handle_message(
+                    _encrypt_for(params, _update_payload(params, updater, unknown), updater)
+                )
+            assert e.value.kind is RequestError.Kind.MESSAGE_REJECTED
+            assert metrics.counts.get(("rejected", "update")) == 2
+            # an honest update with the full seed dict is still accepted
+            full = {s.public: b"\x07" * 80 for s in summers}
+            await handler.handle_message(
+                _encrypt_for(params, _update_payload(params, updater, full), updater)
+            )
+            assert metrics.counts.get(("accepted", "update")) == 1
+        finally:
+            await _stop(machine_task)
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_multipart_buffer_exhaustion_evicts_oldest():
+    """A flood of never-completing multipart messages cannot grow coordinator
+    memory: the buffer table is bounded and evicts oldest-first
+    (reference: multipart buffering, bounded here by max_multipart_buffers)."""
+
+    async def run():
+        settings = _settings()
+        settings.pet.sum.count = CountSettings(64, 64)  # keep sum phase open
+        store = _store()
+        machine, tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, tx)
+        handler.max_multipart_buffers = 8
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            await _until_phase(events, "sum")
+            params = events.params.get_latest().event
+            seed = params.seed.as_bytes()
+            attacker = keys_for_task(seed, params.sum, params.update, "sum")
+            for message_id in range(50):
+                chunk = Chunk(id=1, message_id=message_id, last=False, data=b"\xab" * 64)
+                enc = _encrypt_for(params, chunk, attacker, tag=Tag.SUM, is_multipart=True)
+                await handler.handle_message(enc)  # incomplete: returns, no error
+            assert len(handler._multipart) <= 8
+        finally:
+            await _stop(machine_task)
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_duplicate_chunk_flood_is_bounded_and_idempotent():
+    """Re-sending the same chunk ad infinitum neither grows the buffer nor
+    completes the message twice."""
+
+    async def run():
+        settings = _settings()
+        settings.pet.sum.count = CountSettings(64, 64)
+        store = _store()
+        machine, tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, tx)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            await _until_phase(events, "sum")
+            params = events.params.get_latest().event
+            seed = params.seed.as_bytes()
+            attacker = keys_for_task(seed, params.sum, params.update, "sum")
+            chunk = Chunk(id=1, message_id=9, last=False, data=b"\xcd" * 32)
+            enc = _encrypt_for(params, chunk, attacker, tag=Tag.SUM, is_multipart=True)
+            for _ in range(100):
+                await handler.handle_message(enc)
+            assert len(handler._multipart) == 1
+            (builder,) = handler._multipart.values()
+            assert len(builder._chunks) == 1  # duplicates overwrite, not append
+        finally:
+            await _stop(machine_task)
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_mask_election_majority_wins_and_tie_fails():
+    """The unmask election requires a unique maximum: a Byzantine minority
+    mask loses; an exact tie aborts the round instead of guessing
+    (reference: unmask.rs:96-115)."""
+    from xaynet_tpu.server.phases.base import PhaseError
+    from xaynet_tpu.server.phases.unmask import Unmask
+
+    honest, byzantine = _masked_model(1), _masked_model(2)
+    # majority: honest mask has 2 votes, byzantine 1
+    assert Unmask._freeze_mask_dict([(honest, 2), (byzantine, 1)]) == honest
+    # tie: must abort, not pick arbitrarily
+    with pytest.raises(PhaseError):
+        Unmask._freeze_mask_dict([(honest, 1), (byzantine, 1)])

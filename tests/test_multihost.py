@@ -1,0 +1,88 @@
+"""Multi-host sharded ingest: real 2-process jax.distributed CPU test.
+
+Two worker processes each own 4 virtual CPU devices of one 8-device global
+mesh, stage only their slice of every update batch, run the SPMD fold, and
+verify their slice of the unmasked aggregate against the host oracle —
+the sharded-ingest design of docs/DESIGN.md §3 executed for real (VERDICT
+round-1 item 9).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sharded_ingest():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multi-host workers timed out:\n" + "\n---\n".join(outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out[-3000:]}"
+        assert f"WORKER {i} OK" in out, out[-3000:]
+
+
+def test_single_process_multihost_aggregator_matches_oracle():
+    """The same MultiHostAggregator API on a single process (full slice)."""
+    from xaynet_tpu.core.mask.config import (
+        BoundType,
+        DataType,
+        GroupType,
+        MaskConfig,
+        ModelType,
+    )
+    from xaynet_tpu.ops import limbs as host_limbs
+    from xaynet_tpu.parallel.multihost import MultiHostAggregator
+
+    config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    n_limb = host_limbs.n_limbs_for_order(config.order)
+    ol = host_limbs.order_limbs_for(config.order)
+    rng = np.random.default_rng(9)
+    model_len, k = 333, 4
+    top = int(config.order >> 32)
+    wire = rng.integers(0, 1 << 32, size=(k, model_len, n_limb), dtype=np.uint32)
+    wire[:, :, n_limb - 1] = rng.integers(0, top, size=(k, model_len), dtype=np.uint32)
+    mask = rng.integers(0, 1 << 32, size=(model_len, n_limb), dtype=np.uint32)
+    mask[:, n_limb - 1] = rng.integers(0, top, size=model_len, dtype=np.uint32)
+
+    agg = MultiHostAggregator(config, model_len)
+    lo, hi = agg.local_slice
+    assert (lo, hi) == (0, model_len)
+    agg.add_local_batch(wire)
+    out = agg.unmask_local(mask)
+
+    expected = host_limbs.mod_sub(host_limbs.batch_mod_sum(wire, ol), mask, ol)
+    assert np.array_equal(out, expected)
+    assert np.array_equal(agg.snapshot_local(), host_limbs.batch_mod_sum(wire, ol))

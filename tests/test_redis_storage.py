@@ -32,16 +32,27 @@ class FakeRedis:
         self.sets: dict[bytes, set] = {}
         self.zsets: dict[bytes, dict[bytes, float]] = {}
         self._server = None
+        self._writers: set = set()
 
-    async def start(self):
-        self._server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+    async def start(self, port: int = 0):
+        """Binds (``port=0`` = ephemeral); data survives stop/start cycles,
+        like a Redis that was restarted with persistence."""
+        self._server = await asyncio.start_server(self._conn, "127.0.0.1", port)
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self):
+        """Stops listening AND severs live connections (a real crash)."""
         self._server.close()
+        for w in list(self._writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._writers.clear()
         await self._server.wait_closed()
 
     async def _conn(self, reader, writer):
+        self._writers.add(writer)
         try:
             while True:
                 line = await reader.readline()
@@ -61,6 +72,7 @@ class FakeRedis:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._writers.discard(writer)
             writer.close()
 
     # --- encoding helpers -------------------------------------------------
@@ -173,6 +185,107 @@ class FakeRedis:
 def _mask(seed=1, n=4) -> MaskObject:
     ints = uniform_ints(bytes([seed]) * 32, n + 1, CFG.order)
     return MaskObject.new(CFG.pair(), ints[1:], ints[0])
+
+
+def test_redis_reconnect_after_server_restart():
+    """A dropped connection transparently reconnects (ConnectionManager
+    analogue, reference redis/mod.rs:95-103): the server dies after a
+    successful session, comes back on the same port, and the next command
+    succeeds without the caller doing anything."""
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        store = RedisCoordinatorStorage(port=port)
+        try:
+            await store.set_coordinator_state(b"before-crash")
+            # kill the server: the client's socket goes dead
+            await fake.stop()
+            # restart on the same port (state survives, as with AOF persistence)
+            await fake.start(port)
+            # next command must reconnect-and-succeed, not raise
+            assert await store.coordinator_state() == b"before-crash"
+            await store.set_coordinator_state(b"after-restart")
+            assert await store.coordinator_state() == b"after-restart"
+        finally:
+            await store.client.close()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_redis_backoff_retries_while_server_briefly_down():
+    """Commands retry with backoff while the server is away and succeed the
+    moment it returns within the retry budget."""
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        store = RedisCoordinatorStorage(port=port)
+        store.client.RETRY_BASE_DELAY = 0.05
+        try:
+            await store.set_coordinator_state(b"x")
+            await fake.stop()
+
+            async def resurrect():
+                await asyncio.sleep(0.12)  # within the backoff budget
+                await fake.start(port)
+
+            task = asyncio.create_task(resurrect())
+            assert await store.coordinator_state() == b"x"  # survives the outage
+            await task
+        finally:
+            await store.client.close()
+            await fake.stop()
+
+    asyncio.run(run())
+
+
+def test_redis_unreachable_raises_storage_error():
+    from xaynet_tpu.storage.traits import StorageError
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        await fake.stop()  # nothing listening on that port now
+        store = RedisCoordinatorStorage(port=port)
+        store.client.RETRY_BASE_DELAY = 0.01
+        with pytest.raises(StorageError, match="unreachable"):
+            await store.is_ready()
+
+    asyncio.run(run())
+
+
+def test_redis_best_masks_ordering_and_ties():
+    """best_masks returns the top-2 by score in descending order
+    (reference integration matrix: redis/mod.rs best-masks ordering)."""
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        store = RedisCoordinatorStorage(port=port)
+        try:
+            for i in range(1, 6):
+                assert await store.add_sum_participant(bytes([i]) * 32, b"e" * 32) is None
+            m1, m2, m3 = _mask(1), _mask(2), _mask(3)
+            # m1: 3 votes, m2: 1 vote, m3: 1 vote
+            assert await store.incr_mask_score(bytes([1]) * 32, m1) is None
+            assert await store.incr_mask_score(bytes([2]) * 32, m1) is None
+            assert await store.incr_mask_score(bytes([3]) * 32, m1) is None
+            assert await store.incr_mask_score(bytes([4]) * 32, m2) is None
+            assert await store.incr_mask_score(bytes([5]) * 32, m3) is None
+            assert await store.number_of_unique_masks() == 3
+
+            best = await store.best_masks()
+            assert len(best) == 2
+            assert best[0] == (m1, 3)
+            assert best[1][1] == 1  # runner-up has the tied lower score
+            assert best[1][0] in (m2, m3)
+        finally:
+            await store.client.close()
+            await fake.stop()
+
+    asyncio.run(run())
 
 
 def test_redis_backend_full_cycle():

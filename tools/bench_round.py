@@ -1,0 +1,194 @@
+"""End-to-end update-phase benchmark: wire bytes -> global model.
+
+Measures the full coordinator-side PET round hot path as one script, with a
+per-leg wall-clock breakdown (VERDICT round-1 item 3):
+
+  1. wire parse         — serialized masked-model bytes -> limb tensors
+                          (thread-pool, like the REST ingest path)
+  2. validate           — config/length/element-validity per update
+                          (reference ordering: validate -> seed dict ->
+                          aggregate, update.rs:119-152)
+  3. seed-dict insert   — atomic conditional insert per update
+  4. stage + fold       — wire->planar, device_put, lazy-carry fold into the
+                          sharded HBM accumulator (device work overlaps the
+                          next batch's host-side parse via async dispatch)
+  5. sum2 (participant) — ONE sum participant deriving + summing k2 masks
+                          on device (the client-side hot loop)
+  6. unmask + decode    — modular subtract + fixed-point decode -> float32
+
+Usage:
+  python tools/bench_round.py                    # scaled CPU smoke
+  python tools/bench_round.py --updates 10000 --model-len 25000000  # TPU
+Prints a human breakdown table, plus one JSON line (machine-readable tail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates", type=int, default=None, help="total updates (default: scaled to platform)")
+    ap.add_argument("--model-len", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=16, help="updates per staged batch")
+    ap.add_argument("--sum2-seeds", type=int, default=None, help="seeds for the sum2 participant leg")
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
+    from xaynet_tpu.core.mask.encode import decode_vect_fast
+    from xaynet_tpu.core.mask.object import MaskVect
+    from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
+    from xaynet_tpu.ops import chacha_jax, limbs as host_limbs, limbs_jax
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+    from xaynet_tpu.storage.memory import InMemoryCoordinatorStorage
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    model_len = args.model_len or (25_000_000 if on_tpu else 1_000_000)
+    n_updates = args.updates or (10_000 if on_tpu else 96)
+    k_batch = args.batch
+    k_sum2 = args.sum2_seeds or (1_000 if on_tpu else 8)
+
+    config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    order = config.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    ol = host_limbs.order_limbs_for(order)
+
+    # --- synthesize one batch of wire messages (reused; generation excluded
+    # from timings) -------------------------------------------------------
+    rng = np.random.default_rng(0)
+    top = int(order >> (32 * (n_limb - 1)))
+    batch_limbs = rng.integers(0, 1 << 32, size=(k_batch, model_len, n_limb), dtype=np.uint32)
+    batch_limbs[:, :, n_limb - 1] = rng.integers(
+        0, top, size=(k_batch, model_len), dtype=np.uint32
+    )
+    wire_msgs = [
+        serialize_mask_vect(MaskVect(config, batch_limbs[i])) for i in range(k_batch)
+    ]
+    del batch_limbs
+
+    agg = ShardedAggregator(config, model_len)
+    store = InMemoryCoordinatorStorage()
+    sum_pks = [bytes([i + 1]) * 32 for i in range(3)]
+
+    async def _seed_store():
+        for i, pk in enumerate(sum_pks):
+            await store.add_sum_participant(pk, bytes([i + 9]) * 32)
+
+    import asyncio
+
+    asyncio.run(_seed_store())
+
+    t_parse = t_validate = t_seed = t_stage = 0.0
+    pool = ThreadPoolExecutor(max_workers=max(2, (os.cpu_count() or 2)))
+    t_total0 = time.perf_counter()
+
+    n_batches = n_updates // k_batch
+    seed_entry = {pk: b"\x07" * 80 for pk in sum_pks}
+    for b in range(n_batches):
+        # 1. wire parse on the thread pool
+        t0 = time.perf_counter()
+        parsed = list(pool.map(lambda w: parse_mask_vect(w)[0], wire_msgs))
+        t_parse += time.perf_counter() - t0
+
+        # 2. validate (is_valid is part of parse; re-assert config + length,
+        # the validate_aggregation ordering of update.rs:119-152)
+        t0 = time.perf_counter()
+        for v in parsed:
+            assert v.config == config and len(v) == model_len
+        t_validate += time.perf_counter() - t0
+
+        # 3. seed-dict conditional insert per update
+        t0 = time.perf_counter()
+
+        async def _inserts(base):
+            for i in range(k_batch):
+                pk = (b"%16d" % (base + i)).ljust(32, b"u")
+                err = await store.add_local_seed_dict(pk, dict(seed_entry))
+                assert err is None, err
+
+        asyncio.run(_inserts(b * k_batch))
+        t_seed += time.perf_counter() - t0
+
+        # 4. stage + fold (device dispatch is async: the fold of batch b
+        # overlaps the parse of batch b+1)
+        t0 = time.perf_counter()
+        stack = np.stack([v.data for v in parsed])
+        agg.add_batch(stack)
+        t_stage += time.perf_counter() - t0
+
+    jax.block_until_ready(agg.acc)
+    t_update_phase = time.perf_counter() - t_total0
+
+    # 5. sum2 participant leg: derive + sum k_sum2 masks on device
+    t0 = time.perf_counter()
+    mask_acc = None
+    for i in range(k_sum2):
+        seed = bytes([i & 0xFF, i >> 8]) + b"\x33" * 30
+        vect = chacha_jax.derive_uniform_limbs(seed, model_len, order)
+        mask_acc = vect if mask_acc is None else limbs_jax.mod_add(mask_acc, vect, ol)
+    jax.block_until_ready(mask_acc)
+    t_sum2 = time.perf_counter() - t0
+
+    # 6. unmask + fixed-point decode to float
+    t0 = time.perf_counter()
+    unmasked_wire = agg.unmask_limbs(np.asarray(mask_acc))
+    from fractions import Fraction
+
+    out = decode_vect_fast(unmasked_wire, config, agg.nb_models, Fraction(agg.nb_models))
+    t_unmask = time.perf_counter() - t0
+    assert out.shape == (model_len,)
+
+    total = t_update_phase + t_sum2 + t_unmask
+    ups = (n_batches * k_batch) / t_update_phase
+
+    rows = [
+        ("wire parse (thread pool)", t_parse),
+        ("validate", t_validate),
+        ("seed-dict inserts", t_seed),
+        ("stage + fold (device)", t_stage),
+        ("update phase wall", t_update_phase),
+        (f"sum2 mask derive+sum ({k_sum2} seeds)", t_sum2),
+        ("unmask + decode", t_unmask),
+        ("TOTAL", total),
+    ]
+    print(f"# E2E round bench: platform={platform} model_len={model_len} "
+          f"updates={n_batches * k_batch} batch={k_batch}", file=sys.stderr)
+    for name, t in rows:
+        print(f"  {name:<38} {t:8.2f}s", file=sys.stderr)
+    print(f"  update-phase throughput: {ups:.1f} updates/s", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "e2e update-phase throughput",
+                "value": round(ups, 2),
+                "unit": "updates/s",
+                "platform": platform,
+                "model_len": model_len,
+                "updates": n_batches * k_batch,
+                "breakdown_s": {name: round(t, 3) for name, t in rows},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,7 @@ zero-collective elementwise kernels and multi-host extensions.
 """
 
 from .aggregator import ShardedAggregator
-from .mesh import MODEL_AXIS, make_mesh, model_sharding
+from .mesh import MODEL_AXIS, make_mesh
+from .multihost import MultiHostAggregator
 
-__all__ = ["ShardedAggregator", "MODEL_AXIS", "make_mesh", "model_sharding"]
+__all__ = ["ShardedAggregator", "MODEL_AXIS", "make_mesh", "MultiHostAggregator"]

@@ -26,16 +26,6 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (MODEL_AXIS,))
 
 
-def model_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for ``[model_len, L]`` limb buffers: split the length axis."""
-    return NamedSharding(mesh, P(MODEL_AXIS, None))
-
-
-def batch_model_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for ``[K, model_len, L]`` staging batches: split the length axis."""
-    return NamedSharding(mesh, P(None, MODEL_AXIS, None))
-
-
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -52,6 +52,16 @@ def init_store(settings: Settings) -> Store:
         coordinator = InMemoryCoordinatorStorage()
     if settings.storage.backend == "filesystem":
         models = FilesystemModelStorage(settings.storage.model_dir)
+    elif settings.storage.backend == "s3":
+        from ..storage.s3 import S3ModelStorage
+
+        models = S3ModelStorage(
+            endpoint=settings.storage.s3_endpoint,
+            bucket=settings.storage.s3_bucket,
+            access_key=settings.storage.s3_access_key,
+            secret_key=settings.storage.s3_secret_key,
+            region=settings.storage.s3_region,
+        )
     else:
         models = InMemoryModelStorage()
     return Store(coordinator, models, NoOpTrustAnchor())
@@ -73,6 +83,12 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     store = store if store is not None else init_store(settings)
+    if settings.storage.backend == "s3":
+        # reference creates the bucket at startup (main.rs init_store path)
+        from ..storage.s3 import S3ModelStorage
+
+        if isinstance(store.models, S3ModelStorage):
+            await store.models.create_bucket()
     metrics = init_metrics(settings)
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()

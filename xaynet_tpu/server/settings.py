@@ -105,13 +105,19 @@ class ApiSettings:
 
 @dataclass
 class StorageSettings:
-    backend: str = "memory"  # memory | filesystem (models) ...
+    backend: str = "memory"  # memory | filesystem | s3 (models)
     model_dir: str = "./global_models"
     # coordinator dictionary backend: memory | file | redis
     coordinator: str = "memory"
     redis_host: str = "127.0.0.1"
     redis_port: int = 6379
     redis_db: int = 0
+    # s3 backend (Minio/GCS-interop/AWS; reference settings/s3.rs)
+    s3_endpoint: str = "http://127.0.0.1:9000"
+    s3_bucket: str = "global-models"
+    s3_access_key: str = ""
+    s3_secret_key: str = ""
+    s3_region: str = "us-east-1"
 
 
 @dataclass
@@ -256,6 +262,11 @@ class Settings:
                 redis_host=str(storage_raw.get("redis_host", base.storage.redis_host)),
                 redis_port=int(storage_raw.get("redis_port", base.storage.redis_port)),
                 redis_db=int(storage_raw.get("redis_db", base.storage.redis_db)),
+                s3_endpoint=str(storage_raw.get("s3_endpoint", base.storage.s3_endpoint)),
+                s3_bucket=str(storage_raw.get("s3_bucket", base.storage.s3_bucket)),
+                s3_access_key=str(storage_raw.get("s3_access_key", base.storage.s3_access_key)),
+                s3_secret_key=str(storage_raw.get("s3_secret_key", base.storage.s3_secret_key)),
+                s3_region=str(storage_raw.get("s3_region", base.storage.s3_region)),
             ),
             restore=RestoreSettings(enable=bool(restore_raw.get("enable", False))),
             metrics=MetricsSettings(

@@ -34,7 +34,18 @@ from .traits import (
 
 
 class RespClient:
-    """Minimal Redis protocol client (RESP2) over asyncio streams."""
+    """Minimal Redis protocol client (RESP2) over asyncio streams.
+
+    Connection management mirrors the reference's ``ConnectionManager``
+    (reference: redis/mod.rs:95-103): commands transparently reconnect with
+    exponential backoff when the connection drops or the server is briefly
+    away. Retrying gives at-least-once delivery — safe here because every
+    mutating operation is either idempotent (SET) or a conditional insert
+    whose replay surfaces as a dedup error code.
+    """
+
+    RETRY_ATTEMPTS = 4
+    RETRY_BASE_DELAY = 0.05  # seconds; doubles per attempt
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0):
         self.host, self.port, self.db = host, port, db
@@ -57,15 +68,30 @@ class RespClient:
         self._reader = self._writer = None
 
     async def command(self, *parts: bytes):
-        """Sends one command and decodes one reply (auto-reconnect once)."""
+        """Sends one command and decodes one reply (auto-reconnect + backoff)."""
         async with self._lock:
-            if self._writer is None:
-                await self._connect_locked()
+            last: Exception | None = None
+            for attempt in range(self.RETRY_ATTEMPTS):
+                try:
+                    if self._writer is None:
+                        await self._connect_locked()
+                    return await self._roundtrip(parts)
+                except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                    last = e
+                    self._drop_connection()
+                    if attempt + 1 < self.RETRY_ATTEMPTS:
+                        await asyncio.sleep(self.RETRY_BASE_DELAY * (2**attempt))
+            raise StorageError(
+                f"redis unreachable after {self.RETRY_ATTEMPTS} attempts: {last}"
+            )
+
+    def _drop_connection(self) -> None:
+        if self._writer is not None:
             try:
-                return await self._roundtrip(parts)
-            except (ConnectionError, asyncio.IncompleteReadError):
-                await self._connect_locked()
-                return await self._roundtrip(parts)
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
 
     async def _connect_locked(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
