@@ -1,0 +1,1030 @@
+// Interpreter-free embeddable PET participant (C ABI, no Python).
+//
+// Native analogue of the reference's xaynet-mobile crate
+// (reference: rust/xaynet-mobile/src/participant.rs:129-353 tick-driven
+// Participant, src/ffi/ C API): a caller-driven state machine owning the
+// full client protocol — task signatures + exact eligibility, ephemeral
+// keys, fused masking, seed-dict sealing, sum2 mask derivation/aggregation,
+// multipart chunking with chunk-level send retry, save/restore — linked
+// against libsodium for Ed25519/X25519/ChaCha20-Poly1305 (the reference
+// links the same library through sodiumoxide).
+//
+// Transport is caller-provided (one callback receiving "GET /params",
+// "POST /message", ... and returning the response bytes), which keeps the
+// library free of any network stack — the right shape for constrained
+// edge targets; the embedding app brings its own HTTP/TLS.
+//
+// Wire format parity: 136-byte signed header, Sum/Update/Sum2/Chunk
+// payload layouts, 4-byte mask configs, LV seed dicts — all matching
+// xaynet_tpu/core/message/* byte for byte (tested cross-language).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xaynet_orders.h"
+
+#define XN_EXPORT extern "C" __attribute__((visibility("default")))
+
+// --------------------------------------------------------------------------
+// libsodium prototypes (stable C ABI; linked against libsodium.so)
+// --------------------------------------------------------------------------
+
+extern "C" {
+int sodium_init(void);
+void randombytes_buf(void* buf, size_t size);
+int crypto_sign_seed_keypair(unsigned char* pk, unsigned char* sk, const unsigned char* seed);
+int crypto_sign_detached(unsigned char* sig, unsigned long long* siglen,
+                         const unsigned char* m, unsigned long long mlen,
+                         const unsigned char* sk);
+int crypto_scalarmult_base(unsigned char* q, const unsigned char* n);
+int crypto_scalarmult(unsigned char* q, const unsigned char* n, const unsigned char* p);
+int crypto_hash_sha256(unsigned char* out, const unsigned char* in, unsigned long long inlen);
+typedef struct {
+  unsigned char opaque[208];
+} xn_hmacsha256_state;
+int crypto_auth_hmacsha256_init(xn_hmacsha256_state* state, const unsigned char* key,
+                                size_t keylen);
+int crypto_auth_hmacsha256_update(xn_hmacsha256_state* state, const unsigned char* in,
+                                  unsigned long long inlen);
+int crypto_auth_hmacsha256_final(xn_hmacsha256_state* state, unsigned char* out);
+int crypto_aead_chacha20poly1305_ietf_encrypt(unsigned char* c, unsigned long long* clen,
+                                              const unsigned char* m, unsigned long long mlen,
+                                              const unsigned char* ad, unsigned long long adlen,
+                                              const unsigned char* nsec, const unsigned char* npub,
+                                              const unsigned char* k);
+int crypto_aead_chacha20poly1305_ietf_decrypt(unsigned char* m, unsigned long long* mlen,
+                                              unsigned char* nsec, const unsigned char* c,
+                                              unsigned long long clen, const unsigned char* ad,
+                                              unsigned long long adlen, const unsigned char* npub,
+                                              const unsigned char* k);
+// from xaynet_native.cpp (same shared library)
+uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_offset, uint64_t count,
+                           const uint8_t* order_le, uint32_t order_nbytes, uint8_t* out);
+uint64_t xn_mask_f32(const uint8_t key_bytes[32], uint64_t byte_offset, const float* weights,
+                     uint64_t n, const uint8_t* order_le, uint32_t draw_nbytes,
+                     uint32_t elem_nbytes, double a, double e, double s_hi, double s_lo,
+                     uint8_t* out);
+}
+
+namespace {
+
+using bytes = std::vector<uint8_t>;
+
+// --------------------------------------------------------------------------
+// sealed box (format parity with xaynet_tpu/core/crypto/encrypt.py:
+// eph_pk(32) || ChaCha20Poly1305(msg), key = HKDF-SHA256(X25519 shared,
+// info = "xaynet-tpu-sealedbox" || eph_pk || recipient_pk), zero nonce)
+// --------------------------------------------------------------------------
+
+const char kSealInfo[] = "xaynet-tpu-sealedbox";
+const unsigned char kZeroNonce[12] = {0};
+
+void hkdf_sha256(const uint8_t* ikm, size_t ikm_len, const uint8_t* info, size_t info_len,
+                 uint8_t out[32]) {
+  // extract with a zero salt of hash length, then one expand block
+  uint8_t zero_salt[32] = {0};
+  xn_hmacsha256_state st;
+  uint8_t prk[32];
+  crypto_auth_hmacsha256_init(&st, zero_salt, 32);
+  crypto_auth_hmacsha256_update(&st, ikm, ikm_len);
+  crypto_auth_hmacsha256_final(&st, prk);
+  uint8_t one = 1;
+  crypto_auth_hmacsha256_init(&st, prk, 32);
+  crypto_auth_hmacsha256_update(&st, info, info_len);
+  crypto_auth_hmacsha256_update(&st, &one, 1);
+  crypto_auth_hmacsha256_final(&st, out);
+}
+
+void seal_key(const uint8_t shared[32], const uint8_t eph_pk[32], const uint8_t recipient_pk[32],
+              uint8_t key[32]) {
+  bytes info(sizeof(kSealInfo) - 1 + 64);
+  std::memcpy(info.data(), kSealInfo, sizeof(kSealInfo) - 1);
+  std::memcpy(info.data() + sizeof(kSealInfo) - 1, eph_pk, 32);
+  std::memcpy(info.data() + sizeof(kSealInfo) - 1 + 32, recipient_pk, 32);
+  hkdf_sha256(shared, 32, info.data(), info.size(), key);
+}
+
+bool seal(const uint8_t* msg, size_t len, const uint8_t recipient_pk[32], bytes& out) {
+  uint8_t eph_sk[32], eph_pk[32], shared[32], key[32];
+  randombytes_buf(eph_sk, 32);
+  crypto_scalarmult_base(eph_pk, eph_sk);
+  if (crypto_scalarmult(shared, eph_sk, recipient_pk) != 0) return false;
+  seal_key(shared, eph_pk, recipient_pk, key);
+  out.resize(32 + len + 16);
+  std::memcpy(out.data(), eph_pk, 32);
+  unsigned long long clen = 0;
+  crypto_aead_chacha20poly1305_ietf_encrypt(out.data() + 32, &clen, msg, len, nullptr, 0, nullptr,
+                                            kZeroNonce, key);
+  out.resize(32 + clen);
+  return true;
+}
+
+bool seal_open(const uint8_t* sealed, size_t len, const uint8_t my_sk[32],
+               const uint8_t my_pk[32], bytes& out) {
+  if (len < 48) return false;
+  uint8_t shared[32], key[32];
+  if (crypto_scalarmult(shared, my_sk, sealed) != 0) return false;
+  seal_key(shared, sealed, my_pk, key);
+  out.resize(len - 48);
+  unsigned long long mlen = 0;
+  if (crypto_aead_chacha20poly1305_ietf_decrypt(out.data(), &mlen, nullptr, sealed + 32, len - 32,
+                                                nullptr, 0, kZeroNonce, key) != 0)
+    return false;
+  out.resize(mlen);
+  return true;
+}
+
+// --------------------------------------------------------------------------
+// exact eligibility: int_le(sha256(sig)) / (2^256 - 1) <= threshold
+// (reference semantics: sign.rs:186-202; exact rational comparison)
+// --------------------------------------------------------------------------
+
+// compare n * 2^(53-e) <= m53 * (2^256 - 1) over little-endian u32 bignums
+bool is_eligible(const uint8_t sig[64], double threshold) {
+  if (threshold <= 0.0) {
+    if (threshold < 0.0) return false;
+    // threshold == 0: only the all-zero hash qualifies
+  }
+  if (threshold >= 1.0) return true;
+  uint8_t h[32];
+  crypto_hash_sha256(h, sig, 64);
+
+  int e;
+  double m = std::frexp(threshold, &e);  // threshold = m * 2^e, m in [0.5, 1)
+  uint64_t m53 = (uint64_t)std::ldexp(m, 53);  // exact 53-bit integer
+
+  // lhs = h (256 bits) shifted left by (53 - e) bits
+  int shift = 53 - e;  // e <= 0 for threshold < 1, so shift >= 53
+  std::vector<uint64_t> lhs(4 + shift / 64 + 2, 0);
+  for (int i = 0; i < 4; i++) {
+    uint64_t w;
+    std::memcpy(&w, h + i * 8, 8);  // little-endian words
+    int word = shift / 64, bit = shift % 64;
+    lhs[i + word] |= w << bit;
+    if (bit) lhs[i + word + 1] |= w >> (64 - bit);
+  }
+  // rhs = m53 * (2^256 - 1) = (m53 << 256) - m53
+  std::vector<uint64_t> rhs(lhs.size(), 0);
+  if (rhs.size() < 6) rhs.resize(6, 0);
+  rhs[4] = m53;  // m53 << 256
+  // subtract m53 with borrow
+  uint64_t borrow = m53;
+  for (size_t i = 0; i < rhs.size() && borrow; i++) {
+    uint64_t before = rhs[i];
+    rhs[i] = before - borrow;
+    borrow = before < borrow ? 1 : 0;
+  }
+  if (lhs.size() < rhs.size()) lhs.resize(rhs.size(), 0);
+  if (rhs.size() < lhs.size()) rhs.resize(lhs.size(), 0);
+  for (int i = (int)lhs.size() - 1; i >= 0; i--) {
+    if (lhs[i] < rhs[i]) return true;
+    if (lhs[i] > rhs[i]) return false;
+  }
+  return true;  // equal
+}
+
+// --------------------------------------------------------------------------
+// mask config catalogue lookup
+// --------------------------------------------------------------------------
+
+struct MaskCfg {
+  uint8_t raw[4];  // group, data, bound, model (wire bytes)
+  const uint8_t* order_le = nullptr;
+  uint32_t order_nbytes = 0;   // byte length of the order itself
+  uint32_t elem_nbytes = 0;    // bytes_per_number = byte length of order-1
+  double add_shift = 0.0;      // valid for the f32 bounded fast path
+  double exp_shift = 0.0;
+  bool fast_f32 = false;       // f32 data, bounded, order <= 16 bytes
+};
+
+bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
+  for (int i = 0; i < XN_N_ORDERS; i++) {
+    const XnOrderEntry& e = XN_ORDERS[i];
+    if (e.group == raw[0] && e.data == raw[1] && e.bound == raw[2] && e.model == raw[3]) {
+      std::memcpy(cfg.raw, raw, 4);
+      cfg.order_le = e.bytes;
+      cfg.order_nbytes = e.nbytes;
+      // bytes_per_number = byte length of (order - 1); differs from the
+      // order's own length only when the order is 2^(8k)
+      uint32_t n = e.nbytes;
+      bool pow2_at_boundary = e.bytes[n - 1] == 1;
+      for (uint32_t j = 0; j + 1 < n && pow2_at_boundary; j++)
+        if (e.bytes[j] != 0) pow2_at_boundary = false;
+      cfg.elem_nbytes = pow2_at_boundary ? n - 1 : n;
+      // data=F32(0), bound != Bmax(4)
+      cfg.fast_f32 = raw[1] == 0 && raw[2] != 4 && e.nbytes <= 16;
+      if (cfg.fast_f32) {
+        static const double kAdd[4] = {1.0, 100.0, 10000.0, 1000000.0};
+        cfg.add_shift = kAdd[raw[2]];
+        cfg.exp_shift = 1e10;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+// (a + b) mod order over fixed-width little-endian byte strings
+void add_mod_le(uint8_t* a, const uint8_t* b, const uint8_t* order_le, uint32_t order_nbytes,
+                uint32_t width) {
+  unsigned carry = 0;
+  for (uint32_t i = 0; i < width; i++) {
+    unsigned s = a[i] + b[i] + carry;
+    a[i] = (uint8_t)s;
+    carry = s >> 8;
+  }
+  // compare against the order (order may be wider than width by 1 for
+  // powers of two at a byte boundary — then the sum < order always)
+  bool ge = carry != 0;
+  if (!ge && order_nbytes <= width) {
+    ge = true;
+    for (int i = (int)width - 1; i >= 0; i--) {
+      uint8_t o = i < (int)order_nbytes ? order_le[i] : 0;
+      if (a[i] != o) {
+        ge = a[i] > o;
+        break;
+      }
+    }
+  }
+  if (ge && order_nbytes <= width) {
+    unsigned borrow = 0;
+    for (uint32_t i = 0; i < width; i++) {
+      uint8_t o = i < order_nbytes ? order_le[i] : 0;
+      int d = (int)a[i] - (int)o - (int)borrow;
+      borrow = d < 0;
+      a[i] = (uint8_t)(d & 0xff);
+    }
+  }
+}
+
+// --------------------------------------------------------------------------
+// minimal JSON field extraction (our own coordinator's fixed schemas)
+// --------------------------------------------------------------------------
+
+bool json_find(const std::string& body, const char* key, size_t& val_start) {
+  std::string needle = std::string("\"") + key + "\"";
+  size_t p = body.find(needle);
+  if (p == std::string::npos) return false;
+  p = body.find(':', p + needle.size());
+  if (p == std::string::npos) return false;
+  p++;
+  while (p < body.size() && (body[p] == ' ' || body[p] == '\t')) p++;
+  val_start = p;
+  return true;
+}
+
+bool json_string(const std::string& body, const char* key, std::string& out) {
+  size_t p;
+  if (!json_find(body, key, p) || body[p] != '"') return false;
+  size_t end = body.find('"', p + 1);
+  if (end == std::string::npos) return false;
+  out = body.substr(p + 1, end - p - 1);
+  return true;
+}
+
+bool json_number(const std::string& body, const char* key, double& out) {
+  size_t p;
+  if (!json_find(body, key, p)) return false;
+  out = std::strtod(body.c_str() + p, nullptr);
+  return true;
+}
+
+// "key": [1, 2, 3, 4] -> 4 bytes
+bool json_byte4(const std::string& body, const char* key, uint8_t out[4]) {
+  size_t p;
+  if (!json_find(body, key, p) || body[p] != '[') return false;
+  const char* s = body.c_str() + p + 1;
+  for (int i = 0; i < 4; i++) {
+    char* end;
+    long v = std::strtol(s, &end, 10);
+    if (end == s || v < 0 || v > 255) return false;
+    out[i] = (uint8_t)v;
+    s = end;
+    while (*s == ',' || *s == ' ') s++;
+  }
+  return true;
+}
+
+int hex_nibble(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+bool hex_decode(const std::string& hex, bytes& out) {
+  if (hex.size() % 2) return false;
+  out.resize(hex.size() / 2);
+  for (size_t i = 0; i < out.size(); i++) {
+    int hi = hex_nibble(hex[2 * i]), lo = hex_nibble(hex[2 * i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out[i] = (uint8_t)((hi << 4) | lo);
+  }
+  return true;
+}
+
+std::string hex_encode(const uint8_t* data, size_t len) {
+  static const char* d = "0123456789abcdef";
+  std::string out(len * 2, '0');
+  for (size_t i = 0; i < len; i++) {
+    out[2 * i] = d[data[i] >> 4];
+    out[2 * i + 1] = d[data[i] & 0xf];
+  }
+  return out;
+}
+
+// iterate a flat {"hex": "hex", ...} object
+bool json_hex_map(const std::string& body, std::vector<std::pair<bytes, bytes>>& out) {
+  size_t p = body.find('{');
+  if (p == std::string::npos) return false;
+  p++;
+  while (true) {
+    size_t k0 = body.find('"', p);
+    if (k0 == std::string::npos) return true;
+    size_t k1 = body.find('"', k0 + 1);
+    size_t c = body.find(':', k1);
+    size_t v0 = body.find('"', c);
+    size_t v1 = body.find('"', v0 + 1);
+    if (k1 == std::string::npos || c == std::string::npos || v0 == std::string::npos ||
+        v1 == std::string::npos)
+      return false;
+    bytes k, v;
+    if (!hex_decode(body.substr(k0 + 1, k1 - k0 - 1), k)) return false;
+    if (!hex_decode(body.substr(v0 + 1, v1 - v0 - 1), v)) return false;
+    out.emplace_back(std::move(k), std::move(v));
+    p = v1 + 1;
+  }
+}
+
+// --------------------------------------------------------------------------
+// wire building (parity: xaynet_tpu/core/message/{message,payloads}.py)
+// --------------------------------------------------------------------------
+
+constexpr size_t kHeader = 136;
+constexpr uint8_t kTagSum = 1, kTagUpdate = 2, kTagSum2 = 3;
+constexpr uint8_t kFlagMultipart = 1;
+
+void put_u32be(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = v >> 16;
+  p[2] = v >> 8;
+  p[3] = v;
+}
+
+void put_u16be(uint8_t* p, uint16_t v) {
+  p[0] = v >> 8;
+  p[1] = v;
+}
+
+bytes build_message(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t coord_pk[32],
+                    uint8_t tag, bool multipart, const bytes& payload) {
+  bytes out(kHeader + payload.size());
+  std::memcpy(out.data() + 64, pk, 32);
+  std::memcpy(out.data() + 96, coord_pk, 32);
+  put_u32be(out.data() + 128, (uint32_t)out.size());
+  out[132] = tag;
+  out[133] = multipart ? kFlagMultipart : 0;
+  std::memcpy(out.data() + kHeader, payload.data(), payload.size());
+  crypto_sign_detached(out.data(), nullptr, out.data() + 64, out.size() - 64, sk64);
+  return out;
+}
+
+// split a payload into signed chunk messages when oversized; every part is
+// sealed for the coordinator (the send queue holds ready-to-POST bodies)
+void encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t coord_pk[32],
+                     uint8_t tag, const bytes& payload, uint32_t max_message_size,
+                     std::vector<bytes>& queue) {
+  if (max_message_size == 0 || kHeader + payload.size() <= max_message_size) {
+    bytes msg = build_message(sk64, pk, coord_pk, tag, false, payload);
+    bytes sealed;
+    seal(msg.data(), msg.size(), coord_pk, sealed);
+    queue.push_back(std::move(sealed));
+    return;
+  }
+  size_t budget = max_message_size > kHeader + 8 + 1 ? max_message_size - kHeader - 8 : 1;
+  uint16_t message_id;
+  randombytes_buf(&message_id, 2);
+  size_t n_chunks = (payload.size() + budget - 1) / budget;
+  for (size_t i = 0; i < n_chunks; i++) {
+    size_t lo = i * budget;
+    size_t hi = lo + budget < payload.size() ? lo + budget : payload.size();
+    bytes chunk(8 + (hi - lo));
+    put_u16be(chunk.data(), (uint16_t)(i + 1));
+    put_u16be(chunk.data() + 2, message_id);
+    chunk[4] = i + 1 == n_chunks ? 1 : 0;  // LAST_CHUNK
+    std::memcpy(chunk.data() + 8, payload.data() + lo, hi - lo);
+    bytes msg = build_message(sk64, pk, coord_pk, tag, true, chunk);
+    bytes sealed;
+    seal(msg.data(), msg.size(), coord_pk, sealed);
+    queue.push_back(std::move(sealed));
+  }
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// C API surface
+// --------------------------------------------------------------------------
+
+// transport callback: method+path in `request` ("GET /params",
+// "POST /message", "GET /seeds?pk=<hex>", "GET /model"), body for POSTs.
+// Returns 0 on 200 (fill *out with malloc'd bytes, the library frees),
+// 1 on 204/empty, negative on failure.
+typedef struct {
+  uint8_t* data;
+  uint64_t len;
+} XnBuffer;
+typedef int (*xn_transport_fn)(void* user, const char* request, const uint8_t* body,
+                               uint64_t body_len, XnBuffer* out);
+
+enum XnTask { XN_TASK_NONE = 0, XN_TASK_SUM = 1, XN_TASK_UPDATE = 2 };
+enum {
+  XN_OK = 0,
+  XN_ERR_NULL = -1,
+  XN_ERR_TRANSPORT = -2,
+  XN_ERR_PARSE = -3,
+  XN_ERR_CRYPTO = -4,
+  XN_ERR_STATE = -5,
+  XN_ERR_CONFIG = -6,
+  XN_ERR_MODEL = -7,
+  XN_ERR_RESTORE = -8,
+};
+
+namespace {
+
+struct RoundParams {
+  bytes coord_pk;  // 32
+  double sum_prob = 0.0, update_prob = 0.0;
+  bytes seed;  // 32
+  uint8_t cfg_vect[4] = {0}, cfg_unit[4] = {0};
+  uint64_t model_length = 0;
+  std::string raw;  // raw body for freshness comparison + save/restore
+};
+
+enum class Phase { Awaiting, NewRound, Sum, Update, Sum2 };
+
+struct Participant {
+  // identity & settings
+  uint8_t sign_seed[32];
+  uint8_t sign_pk[32];
+  uint8_t sign_sk64[64];
+  int64_t scalar_num = 1;
+  int64_t scalar_den = 1;
+  uint32_t max_message_size = 4096;
+  xn_transport_fn transport = nullptr;
+  void* transport_user = nullptr;
+
+  // round state
+  Phase phase = Phase::Awaiting;
+  RoundParams params;
+  bool have_params = false;
+  uint8_t sum_sig[64] = {0};
+  uint8_t update_sig[64] = {0};
+  bool have_ephm = false;
+  uint8_t ephm_sk[32] = {0};
+  uint8_t ephm_pk[32] = {0};
+  std::vector<bytes> pending;  // sealed parts not yet delivered
+  Phase after_send = Phase::Awaiting;
+
+  // embedder interaction
+  std::vector<float> model;
+  bool model_set = false;
+  bool wants_model = false;
+  bool made_progress = false;
+  bool new_round_flag = false;
+  std::vector<double> global_model;
+
+  int fetch(const char* request, const uint8_t* body, uint64_t body_len, bytes& out) const {
+    if (!transport) return XN_ERR_TRANSPORT;
+    XnBuffer buf{nullptr, 0};
+    int rc = transport(transport_user, request, body, body_len, &buf);
+    if (rc < 0) return XN_ERR_TRANSPORT;
+    if (rc == 0 && buf.data) {
+      out.assign(buf.data, buf.data + buf.len);
+      std::free(buf.data);
+    } else {
+      out.clear();
+    }
+    return rc;
+  }
+};
+
+bool parse_params(const std::string& body, RoundParams& p) {
+  std::string pk_hex, seed_hex;
+  if (!json_string(body, "pk", pk_hex) || !json_string(body, "seed", seed_hex)) return false;
+  if (!hex_decode(pk_hex, p.coord_pk) || p.coord_pk.size() != 32) return false;
+  if (!hex_decode(seed_hex, p.seed) || p.seed.size() != 32) return false;
+  if (!json_number(body, "sum", p.sum_prob) || !json_number(body, "update", p.update_prob))
+    return false;
+  if (!json_byte4(body, "vect", p.cfg_vect) || !json_byte4(body, "unit", p.cfg_unit)) return false;
+  double ml;
+  if (!json_number(body, "model_length", ml)) return false;
+  p.model_length = (uint64_t)ml;
+  p.raw = body;
+  return true;
+}
+
+void reset_round(Participant& p) {
+  p.phase = Phase::NewRound;
+  p.have_ephm = false;
+  p.pending.clear();
+  p.new_round_flag = true;
+  p.wants_model = false;
+}
+
+// returns XN_OK when everything queued was delivered
+int drain(Participant& p) {
+  while (!p.pending.empty()) {
+    bytes resp;
+    int rc = p.fetch("POST /message", p.pending.front().data(), p.pending.front().size(), resp);
+    if (rc < 0) return XN_ERR_TRANSPORT;  // retry THIS part on a later tick
+    p.pending.erase(p.pending.begin());
+  }
+  p.phase = p.after_send;
+  return XN_OK;
+}
+
+int step_new_round(Participant& p) {
+  bytes to_sign(p.params.seed);
+  to_sign.insert(to_sign.end(), {'s', 'u', 'm'});
+  crypto_sign_detached(p.sum_sig, nullptr, to_sign.data(), to_sign.size(), p.sign_sk64);
+  bytes to_sign2(p.params.seed);
+  const char* upd = "update";
+  to_sign2.insert(to_sign2.end(), upd, upd + 6);
+  crypto_sign_detached(p.update_sig, nullptr, to_sign2.data(), to_sign2.size(), p.sign_sk64);
+
+  if (is_eligible(p.sum_sig, p.params.sum_prob)) {
+    p.phase = Phase::Sum;
+  } else if (is_eligible(p.update_sig, p.params.update_prob)) {
+    p.phase = Phase::Update;
+  } else {
+    p.phase = Phase::Awaiting;
+  }
+  p.made_progress = true;
+  return XN_OK;
+}
+
+int step_sum(Participant& p) {
+  if (!p.have_ephm) {
+    randombytes_buf(p.ephm_sk, 32);
+    crypto_scalarmult_base(p.ephm_pk, p.ephm_sk);
+    p.have_ephm = true;
+  }
+  bytes payload(64 + 32);
+  std::memcpy(payload.data(), p.sum_sig, 64);
+  std::memcpy(payload.data() + 64, p.ephm_pk, 32);
+  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum, payload,
+                  p.max_message_size, p.pending);
+  p.after_send = Phase::Sum2;
+  return drain(p);
+}
+
+int step_update(Participant& p) {
+  bytes sums_body;
+  int rc = p.fetch("GET /sums", nullptr, 0, sums_body);
+  if (rc < 0) return XN_ERR_TRANSPORT;
+  if (rc != 0 || sums_body.empty()) return XN_OK;  // not available yet
+  std::vector<std::pair<bytes, bytes>> sum_dict;
+  if (!json_hex_map(std::string(sums_body.begin(), sums_body.end()), sum_dict))
+    return XN_ERR_PARSE;
+  if (sum_dict.empty()) return XN_OK;
+
+  if (!p.model_set || p.model.size() != p.params.model_length) {
+    p.wants_model = true;
+    return XN_OK;
+  }
+
+  MaskCfg cfg_n, cfg_1;
+  if (!lookup_cfg(p.params.cfg_vect, cfg_n) || !lookup_cfg(p.params.cfg_unit, cfg_1))
+    return XN_ERR_CONFIG;
+  if (!cfg_n.fast_f32 || !cfg_1.fast_f32) return XN_ERR_CONFIG;  // native FSM: f32 bounded
+
+  // fresh mask seed; unit draw first, then the vector draws continue on the
+  // same keystream (parity: MaskSeed.derive_mask / Masker.mask)
+  uint8_t mask_seed[32];
+  randombytes_buf(mask_seed, 32);
+  bytes rand1(cfg_1.order_nbytes);
+  uint64_t offset =
+      xn_sample_uniform(mask_seed, 0, 1, cfg_1.order_le, cfg_1.order_nbytes, rand1.data());
+
+  // clamped scalar s = min(num/den, A1); dd split for the fused kernel
+  double a1 = cfg_1.add_shift;
+  double s_hi = (double)p.scalar_num / (double)p.scalar_den;
+  double s_lo = 0.0;  // scalars are small rationals; refine via fma residue
+  s_lo = std::fma(-s_hi, (double)p.scalar_den, (double)p.scalar_num) / (double)p.scalar_den;
+  if (s_hi > a1 || (s_hi == a1 && s_lo > 0)) {
+    s_hi = a1;
+    s_lo = 0.0;
+  }
+
+  // masked vector in wire element bytes (fused native kernel)
+  bytes vect(p.params.model_length * cfg_n.elem_nbytes);
+  uint64_t end_off = xn_mask_f32(mask_seed, offset, p.model.data(), p.params.model_length,
+                                 cfg_n.order_le, cfg_n.order_nbytes, cfg_n.elem_nbytes,
+                                 cfg_n.add_shift, cfg_n.exp_shift, s_hi, s_lo, vect.data());
+  if (end_off == 0) return XN_ERR_CONFIG;
+
+  // masked unit: floor((s + A1) * E1) + rand1 mod unit order.
+  // s = num/den clamped; exact in __int128 for the bounded-f32 family
+  __int128 num = p.scalar_num, den = p.scalar_den;
+  __int128 a1i = (__int128)a1, e1i = (__int128)cfg_1.exp_shift;
+  if (num > a1i * den) num = a1i * den;
+  __int128 shifted1 = ((num + a1i * den) * e1i) / den;
+  bytes unit_elem(cfg_1.elem_nbytes, 0);
+  for (uint32_t i = 0; i < cfg_1.elem_nbytes && shifted1 > 0; i++) {
+    unit_elem[i] = (uint8_t)(shifted1 & 0xff);
+    shifted1 >>= 8;
+  }
+  bytes rand1_w(rand1.begin(), rand1.begin() + cfg_1.elem_nbytes);
+  add_mod_le(unit_elem.data(), rand1_w.data(), cfg_1.order_le, cfg_1.order_nbytes,
+             cfg_1.elem_nbytes);
+
+  // payload: sum_sig(64) || update_sig(64) || MaskObject || LV seed dict
+  bytes payload;
+  payload.insert(payload.end(), p.sum_sig, p.sum_sig + 64);
+  payload.insert(payload.end(), p.update_sig, p.update_sig + 64);
+  payload.insert(payload.end(), cfg_n.raw, cfg_n.raw + 4);
+  uint8_t cnt[4];
+  put_u32be(cnt, (uint32_t)p.params.model_length);
+  payload.insert(payload.end(), cnt, cnt + 4);
+  payload.insert(payload.end(), vect.begin(), vect.end());
+  payload.insert(payload.end(), cfg_1.raw, cfg_1.raw + 4);
+  payload.insert(payload.end(), unit_elem.begin(), unit_elem.end());
+  // LV seed dict: length includes the 4-byte length field
+  uint8_t lv[4];
+  put_u32be(lv, (uint32_t)(4 + sum_dict.size() * 112));
+  payload.insert(payload.end(), lv, lv + 4);
+  for (auto& kv : sum_dict) {
+    if (kv.first.size() != 32 || kv.second.size() != 32) return XN_ERR_PARSE;
+    bytes sealed;
+    if (!seal(mask_seed, 32, kv.second.data(), sealed) || sealed.size() != 80)
+      return XN_ERR_CRYPTO;
+    payload.insert(payload.end(), kv.first.begin(), kv.first.end());
+    payload.insert(payload.end(), sealed.begin(), sealed.end());
+  }
+
+  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagUpdate, payload,
+                  p.max_message_size, p.pending);
+  p.after_send = Phase::Awaiting;
+  p.made_progress = true;
+  return drain(p);
+}
+
+int step_sum2(Participant& p) {
+  std::string req = "GET /seeds?pk=" + hex_encode(p.sign_pk, 32);
+  bytes body;
+  int rc = p.fetch(req.c_str(), nullptr, 0, body);
+  if (rc < 0) return XN_ERR_TRANSPORT;
+  if (rc != 0 || body.empty()) return XN_OK;  // seeds not available yet
+  std::vector<std::pair<bytes, bytes>> seeds;
+  if (!json_hex_map(std::string(body.begin(), body.end()), seeds)) return XN_ERR_PARSE;
+  if (seeds.empty()) return XN_OK;
+
+  MaskCfg cfg_n, cfg_1;
+  if (!lookup_cfg(p.params.cfg_vect, cfg_n) || !lookup_cfg(p.params.cfg_unit, cfg_1))
+    return XN_ERR_CONFIG;
+
+  // derive + modular-sum every mask (reference: sum2.rs:170-193)
+  uint64_t n = p.params.model_length;
+  bytes vect_acc(n * cfg_n.elem_nbytes, 0);
+  bytes unit_acc(cfg_1.elem_nbytes, 0);
+  bytes vect_one(n * cfg_n.elem_nbytes);
+  bytes draw_buf(cfg_n.order_nbytes);
+  for (auto& kv : seeds) {
+    bytes seed;
+    if (!seal_open(kv.second.data(), kv.second.size(), p.ephm_sk, p.ephm_pk, seed) ||
+        seed.size() != 32)
+      return XN_ERR_CRYPTO;
+    bytes rand1(cfg_1.order_nbytes);
+    uint64_t off =
+        xn_sample_uniform(seed.data(), 0, 1, cfg_1.order_le, cfg_1.order_nbytes, rand1.data());
+    add_mod_le(unit_acc.data(), rand1.data(), cfg_1.order_le, cfg_1.order_nbytes,
+               cfg_1.elem_nbytes);
+    if (cfg_n.order_nbytes == cfg_n.elem_nbytes) {
+      xn_sample_uniform(seed.data(), off, n, cfg_n.order_le, cfg_n.order_nbytes, vect_one.data());
+      for (uint64_t i = 0; i < n; i++)
+        add_mod_le(vect_acc.data() + i * cfg_n.elem_nbytes,
+                   vect_one.data() + i * cfg_n.elem_nbytes, cfg_n.order_le, cfg_n.order_nbytes,
+                   cfg_n.elem_nbytes);
+    } else {
+      // draws are order-width; accepted values fit the element width
+      uint64_t o = off;
+      for (uint64_t i = 0; i < n; i++) {
+        o = xn_sample_uniform(seed.data(), o, 1, cfg_n.order_le, cfg_n.order_nbytes,
+                              draw_buf.data());
+        add_mod_le(vect_acc.data() + i * cfg_n.elem_nbytes, draw_buf.data(), cfg_n.order_le,
+                   cfg_n.order_nbytes, cfg_n.elem_nbytes);
+      }
+    }
+  }
+
+  // payload: sum_sig(64) || MaskObject(vect config+count+elems, unit)
+  bytes payload;
+  payload.insert(payload.end(), p.sum_sig, p.sum_sig + 64);
+  payload.insert(payload.end(), cfg_n.raw, cfg_n.raw + 4);
+  uint8_t cnt[4];
+  put_u32be(cnt, (uint32_t)n);
+  payload.insert(payload.end(), cnt, cnt + 4);
+  payload.insert(payload.end(), vect_acc.begin(), vect_acc.end());
+  payload.insert(payload.end(), cfg_1.raw, cfg_1.raw + 4);
+  payload.insert(payload.end(), unit_acc.begin(), unit_acc.end());
+
+  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum2, payload,
+                  p.max_message_size, p.pending);
+  p.after_send = Phase::Awaiting;
+  p.made_progress = true;
+  return drain(p);
+}
+
+// save format: "XNP1" || seed(32) || scalar num/den (i64 LE each) ||
+// mms(u32) || phase(u8) || after_send(u8) || flags(u8: have_params,
+// have_ephm, model_set<<2) || ephm_sk(32) || sum_sig(64) || update_sig(64)
+// || params_raw(LV u32) || pending(count u32, each LV u32) || model(LV u32,
+// f32 LE)
+void put_lv(bytes& out, const uint8_t* data, size_t len) {
+  uint8_t l[4];
+  put_u32be(l, (uint32_t)len);
+  out.insert(out.end(), l, l + 4);
+  out.insert(out.end(), data, data + len);
+}
+
+}  // namespace
+
+XN_EXPORT uint32_t xaynet_ffi_abi_version(void) { return 2; }
+
+XN_EXPORT int xaynet_ffi_crypto_init(void) { return sodium_init() >= 0 ? XN_OK : XN_ERR_CRYPTO; }
+
+XN_EXPORT void* xaynet_ffi_participant_new(const uint8_t signing_seed[32], int64_t scalar_num,
+                                           int64_t scalar_den, uint32_t max_message_size,
+                                           xn_transport_fn transport, void* user) {
+  if (!signing_seed || !transport || scalar_den <= 0 || scalar_num < 0) return nullptr;
+  if (sodium_init() < 0) return nullptr;
+  auto* p = new Participant();
+  std::memcpy(p->sign_seed, signing_seed, 32);
+  crypto_sign_seed_keypair(p->sign_pk, p->sign_sk64, signing_seed);
+  p->scalar_num = scalar_num;
+  p->scalar_den = scalar_den;
+  p->max_message_size = max_message_size;
+  p->transport = transport;
+  p->transport_user = user;
+  return p;
+}
+
+XN_EXPORT void xaynet_ffi_participant_destroy(void* handle) {
+  delete static_cast<Participant*>(handle);
+}
+
+XN_EXPORT int xaynet_ffi_participant_tick(void* handle) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p) return XN_ERR_NULL;
+  p->made_progress = false;
+
+  // round freshness first (parity: sdk phase.rs:160-200)
+  bytes body;
+  int rc = p->fetch("GET /params", nullptr, 0, body);
+  if (rc != 0) return XN_ERR_TRANSPORT;
+  std::string raw(body.begin(), body.end());
+  if (!p->have_params || raw != p->params.raw) {
+    RoundParams fresh;
+    if (!parse_params(raw, fresh)) return XN_ERR_PARSE;
+    p->params = std::move(fresh);
+    p->have_params = true;
+    reset_round(*p);
+  }
+
+  if (!p->pending.empty()) {
+    int drc = drain(*p);
+    if (drc == XN_OK) p->made_progress = true;
+    return drc == XN_OK ? XN_OK : drc;
+  }
+
+  switch (p->phase) {
+    case Phase::Awaiting:
+      return XN_OK;
+    case Phase::NewRound:
+      return step_new_round(*p);
+    case Phase::Sum:
+      return step_sum(*p);
+    case Phase::Update:
+      return step_update(*p);
+    case Phase::Sum2:
+      return step_sum2(*p);
+  }
+  return XN_ERR_STATE;
+}
+
+XN_EXPORT int xaynet_ffi_participant_task(void* handle) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p) return XN_ERR_NULL;
+  switch (p->phase) {
+    case Phase::Sum:
+    case Phase::Sum2:
+      return XN_TASK_SUM;
+    case Phase::Update:
+      return XN_TASK_UPDATE;
+    default:
+      return XN_TASK_NONE;
+  }
+}
+
+XN_EXPORT int xaynet_ffi_participant_made_progress(void* handle) {
+  auto* p = static_cast<Participant*>(handle);
+  return p && p->made_progress ? 1 : 0;
+}
+
+XN_EXPORT int xaynet_ffi_participant_should_set_model(void* handle) {
+  auto* p = static_cast<Participant*>(handle);
+  return p && p->wants_model ? 1 : 0;
+}
+
+XN_EXPORT int xaynet_ffi_participant_new_round(void* handle) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p) return 0;
+  int f = p->new_round_flag ? 1 : 0;
+  p->new_round_flag = false;
+  return f;
+}
+
+XN_EXPORT int xaynet_ffi_participant_set_model(void* handle, const float* data, uint64_t len) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p || !data) return XN_ERR_NULL;
+  p->model.assign(data, data + len);
+  p->model_set = true;
+  p->wants_model = false;
+  return XN_OK;
+}
+
+// fetch the latest global model (f64 little-endian over the transport);
+// returns element count (>=0) or an error code; *out borrowed until the
+// next call/destroy
+XN_EXPORT int64_t xaynet_ffi_participant_global_model(void* handle, const double** out) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p || !out) return XN_ERR_NULL;
+  bytes body;
+  int rc = p->fetch("GET /model", nullptr, 0, body);
+  if (rc < 0) return XN_ERR_TRANSPORT;
+  if (rc != 0 || body.empty()) {
+    *out = nullptr;
+    return 0;
+  }
+  p->global_model.resize(body.size() / 8);
+  std::memcpy(p->global_model.data(), body.data(), p->global_model.size() * 8);
+  *out = p->global_model.data();
+  return (int64_t)p->global_model.size();
+}
+
+XN_EXPORT int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t* out_len) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p || !out || !out_len) return XN_ERR_NULL;
+  bytes buf;
+  const char magic[4] = {'X', 'N', 'P', '1'};
+  buf.insert(buf.end(), magic, magic + 4);
+  buf.insert(buf.end(), p->sign_seed, p->sign_seed + 32);
+  for (int64_t v : {p->scalar_num, p->scalar_den})
+    for (int i = 0; i < 8; i++) buf.push_back((uint8_t)(((uint64_t)v) >> (8 * i)));
+  uint8_t mms[4];
+  put_u32be(mms, p->max_message_size);
+  buf.insert(buf.end(), mms, mms + 4);
+  buf.push_back((uint8_t)p->phase);
+  buf.push_back((uint8_t)p->after_send);
+  buf.push_back((uint8_t)((p->have_params ? 1 : 0) | (p->have_ephm ? 2 : 0) |
+                          (p->model_set ? 4 : 0)));
+  buf.insert(buf.end(), p->ephm_sk, p->ephm_sk + 32);
+  buf.insert(buf.end(), p->sum_sig, p->sum_sig + 64);
+  buf.insert(buf.end(), p->update_sig, p->update_sig + 64);
+  put_lv(buf, (const uint8_t*)p->params.raw.data(), p->params.raw.size());
+  uint8_t cnt[4];
+  put_u32be(cnt, (uint32_t)p->pending.size());
+  buf.insert(buf.end(), cnt, cnt + 4);
+  for (auto& part : p->pending) put_lv(buf, part.data(), part.size());
+  put_lv(buf, (const uint8_t*)p->model.data(), p->model.size() * 4);
+
+  *out = (uint8_t*)std::malloc(buf.size());
+  if (!*out) return XN_ERR_NULL;
+  std::memcpy(*out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return XN_OK;
+}
+
+XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len,
+                                               xn_transport_fn transport, void* user) {
+  if (!data || len < 4 + 32 + 16 + 4 + 3 + 32 + 128 + 4 || std::memcmp(data, "XNP1", 4) != 0)
+    return nullptr;
+  if (sodium_init() < 0) return nullptr;
+  auto* p = new Participant();
+  size_t o = 4;
+  auto take = [&](void* dst, size_t n) {
+    std::memcpy(dst, data + o, n);
+    o += n;
+  };
+  take(p->sign_seed, 32);
+  crypto_sign_seed_keypair(p->sign_pk, p->sign_sk64, p->sign_seed);
+  uint64_t num = 0, den = 0;
+  take(&num, 8);
+  take(&den, 8);
+  p->scalar_num = (int64_t)num;
+  p->scalar_den = (int64_t)den;
+  uint8_t mms[4];
+  take(mms, 4);
+  p->max_message_size = ((uint32_t)mms[0] << 24) | (mms[1] << 16) | (mms[2] << 8) | mms[3];
+  uint8_t ph, as, fl;
+  take(&ph, 1);
+  take(&as, 1);
+  take(&fl, 1);
+  p->phase = (Phase)ph;
+  p->after_send = (Phase)as;
+  p->have_params = fl & 1;
+  p->have_ephm = fl & 2;
+  p->model_set = fl & 4;
+  take(p->ephm_sk, 32);
+  if (p->have_ephm) crypto_scalarmult_base(p->ephm_pk, p->ephm_sk);
+  take(p->sum_sig, 64);
+  take(p->update_sig, 64);
+  auto take_lv = [&](bytes& outb) -> bool {
+    if (o + 4 > len) return false;
+    uint32_t n = ((uint32_t)data[o] << 24) | (data[o + 1] << 16) | (data[o + 2] << 8) |
+                 data[o + 3];
+    o += 4;
+    if (o + n > len) return false;
+    outb.assign(data + o, data + o + n);
+    o += n;
+    return true;
+  };
+  bytes raw;
+  if (!take_lv(raw)) {
+    delete p;
+    return nullptr;
+  }
+  if (p->have_params) {
+    if (!parse_params(std::string(raw.begin(), raw.end()), p->params)) {
+      delete p;
+      return nullptr;
+    }
+  }
+  if (o + 4 > len) {
+    delete p;
+    return nullptr;
+  }
+  uint32_t n_pending = ((uint32_t)data[o] << 24) | (data[o + 1] << 16) | (data[o + 2] << 8) |
+                       data[o + 3];
+  o += 4;
+  for (uint32_t i = 0; i < n_pending; i++) {
+    bytes part;
+    if (!take_lv(part)) {
+      delete p;
+      return nullptr;
+    }
+    p->pending.push_back(std::move(part));
+  }
+  bytes model_raw;
+  if (!take_lv(model_raw) || model_raw.size() % 4 != 0) {  // reject, don't overflow
+    delete p;
+    return nullptr;
+  }
+  p->model.resize(model_raw.size() / 4);
+  std::memcpy(p->model.data(), model_raw.data(), model_raw.size());
+  p->transport = transport;
+  p->transport_user = user;
+  return p;
+}
+
+// --- standalone crypto helpers (cross-language interop tests) -------------
+
+XN_EXPORT int xaynet_ffi_seal(const uint8_t* msg, uint64_t len, const uint8_t pk[32],
+                              uint8_t* out, uint64_t* out_len) {
+  bytes sealed;
+  if (!seal(msg, len, pk, sealed)) return XN_ERR_CRYPTO;
+  std::memcpy(out, sealed.data(), sealed.size());
+  *out_len = sealed.size();
+  return XN_OK;
+}
+
+XN_EXPORT int xaynet_ffi_seal_open(const uint8_t* sealed, uint64_t len, const uint8_t sk[32],
+                                   uint8_t* out, uint64_t* out_len) {
+  uint8_t pk[32];
+  crypto_scalarmult_base(pk, sk);
+  bytes plain;
+  if (!seal_open(sealed, len, sk, pk, plain)) return XN_ERR_CRYPTO;
+  std::memcpy(out, plain.data(), plain.size());
+  *out_len = plain.size();
+  return XN_OK;
+}
+
+XN_EXPORT int xaynet_ffi_sign(const uint8_t seed[32], const uint8_t* msg, uint64_t len,
+                              uint8_t sig[64]) {
+  uint8_t pk[32], sk64[64];
+  crypto_sign_seed_keypair(pk, sk64, seed);
+  crypto_sign_detached(sig, nullptr, msg, len, sk64);
+  return XN_OK;
+}
+
+XN_EXPORT int xaynet_ffi_is_eligible(const uint8_t sig[64], double threshold) {
+  return is_eligible(sig, threshold) ? 1 : 0;
+}
+
+XN_EXPORT void xaynet_ffi_free(void* ptr) { std::free(ptr); }

@@ -33,6 +33,9 @@ class FakeRedis:
         self.zsets: dict[bytes, dict[bytes, float]] = {}
         self._server = None
         self._writers: set = set()
+        # fault injection: execute the next EVAL but sever the connection
+        # before the reply goes out (the replay-hazard window)
+        self.kill_next_eval_reply = False
 
     async def start(self, port: int = 0):
         """Binds (``port=0`` = ephemeral); data survives stop/start cycles,
@@ -67,7 +70,11 @@ class FakeRedis:
                     size = int(ln[1:-2])
                     data = await reader.readexactly(size + 2)
                     parts.append(data[:-2])
-                writer.write(self._dispatch(parts))
+                reply = self._dispatch(parts)
+                if parts[0].upper() == b"EVAL" and self.kill_next_eval_reply:
+                    self.kill_next_eval_reply = False
+                    break  # executed, but the reply is lost
+                writer.write(reply)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -252,6 +259,35 @@ def test_redis_unreachable_raises_storage_error():
         store.client.RETRY_BASE_DELAY = 0.01
         with pytest.raises(StorageError, match="unreachable"):
             await store.is_ready()
+
+    asyncio.run(run())
+
+
+def test_redis_conditional_insert_not_replayed_on_lost_reply():
+    """An EVAL that executed but lost its reply must surface a StorageError
+    (-> Failure phase), NOT be silently replayed — a replay would return a
+    dedup error for a write that landed, desynchronizing the seed dict from
+    the model aggregate."""
+    from xaynet_tpu.storage.traits import StorageError
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        store = RedisCoordinatorStorage(port=port)
+        store.client.RETRY_BASE_DELAY = 0.01
+        try:
+            # prime the connection with a replay-safe command
+            await store.set_coordinator_state(b"x")
+            fake.kill_next_eval_reply = True
+            with pytest.raises(StorageError, match="not replayed"):
+                await store.add_sum_participant(b"s1" * 16, b"e1" * 16)
+            # the write DID land server-side (that's the hazard)
+            assert (b"s1" * 16) in fake.hashes.get(b"sum_dict", {})
+            # the client recovers for subsequent commands
+            assert await store.coordinator_state() == b"x"
+        finally:
+            await store.client.close()
+            await fake.stop()
 
     asyncio.run(run())
 

@@ -100,7 +100,15 @@ def main() -> None:
     pool = ThreadPoolExecutor(max_workers=max(2, (os.cpu_count() or 2)))
     t_total0 = time.perf_counter()
 
-    n_batches = n_updates // k_batch
+    if n_updates < k_batch:
+        ap.error(f"--updates ({n_updates}) must be >= --batch ({k_batch})")
+    n_batches = round(n_updates / k_batch)  # nearest whole batch, >= 1
+    if n_batches * k_batch != n_updates:
+        print(
+            f"note: rounding {n_updates} updates to {n_batches * k_batch} "
+            f"(whole {k_batch}-update batches)",
+            file=sys.stderr,
+        )
     seed_entry = {pk: b"\x07" * 80 for pk in sum_pks}
     for b in range(n_batches):
         # 1. wire parse on the thread pool

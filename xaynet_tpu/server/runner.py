@@ -24,7 +24,7 @@ from ..storage.memory import (
     NoOpTrustAnchor,
 )
 from ..storage.traits import Store
-from .metrics import InfluxLineMetrics, JsonlMetrics, LogMetrics
+from .metrics import InfluxHttpMetrics, InfluxLineMetrics, JsonlMetrics, LogMetrics
 from .rest import RestServer
 from .services import Fetcher, PetMessageHandler
 from .settings import Settings
@@ -74,6 +74,8 @@ def init_metrics(settings: Settings):
         return JsonlMetrics(settings.metrics.path)
     if settings.metrics.sink == "influx":
         return InfluxLineMetrics(settings.metrics.path)
+    if settings.metrics.sink == "influx-http":
+        return InfluxHttpMetrics(settings.metrics.url, settings.metrics.database)
     return LogMetrics()
 
 

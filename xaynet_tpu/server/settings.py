@@ -128,8 +128,10 @@ class RestoreSettings:
 @dataclass
 class MetricsSettings:
     enable: bool = False
-    sink: str = "log"  # log | jsonl
+    sink: str = "log"  # log | jsonl | influx (file) | influx-http (network)
     path: str = "./metrics.jsonl"
+    url: str = "http://127.0.0.1:8086"  # influx-http write endpoint
+    database: str = "metrics"
 
 
 @dataclass
@@ -273,6 +275,8 @@ class Settings:
                 enable=bool(metrics_raw.get("enable", False)),
                 sink=str(metrics_raw.get("sink", base.metrics.sink)),
                 path=str(metrics_raw.get("path", base.metrics.path)),
+                url=str(metrics_raw.get("url", base.metrics.url)),
+                database=str(metrics_raw.get("database", base.metrics.database)),
             ),
             log=LoggingSettings(filter=str(log_raw.get("filter", base.log.filter))),
             aggregation=AggregationSettings(

@@ -39,9 +39,15 @@ class RespClient:
     Connection management mirrors the reference's ``ConnectionManager``
     (reference: redis/mod.rs:95-103): commands transparently reconnect with
     exponential backoff when the connection drops or the server is briefly
-    away. Retrying gives at-least-once delivery — safe here because every
-    mutating operation is either idempotent (SET) or a conditional insert
-    whose replay surfaces as a dedup error code.
+    away. Replay discipline: a command is only re-sent when either (a) the
+    failure happened before any bytes went out (connect failure), or (b)
+    the caller marked it ``replay_safe`` (reads and idempotent SETs). The
+    conditional-insert Lua scripts are NOT replay safe — replaying one that
+    executed but lost its reply would surface a dedup error for a write
+    that actually landed, desynchronizing the seed dict from the model
+    aggregate — so those surface a ``StorageError`` instead, which routes
+    the round to the Failure phase exactly like the reference's failed
+    in-flight commands.
     """
 
     RETRY_ATTEMPTS = 4
@@ -67,18 +73,35 @@ class RespClient:
                 pass
         self._reader = self._writer = None
 
-    async def command(self, *parts: bytes):
-        """Sends one command and decodes one reply (auto-reconnect + backoff)."""
+    async def command(self, *parts: bytes, replay_safe: bool = True):
+        """Sends one command and decodes one reply (auto-reconnect + backoff).
+
+        ``replay_safe=False``: once the request bytes may have reached the
+        server, a connection failure raises instead of re-sending.
+        """
         async with self._lock:
             last: Exception | None = None
             for attempt in range(self.RETRY_ATTEMPTS):
+                sent = False
                 try:
+                    if not replay_safe and self._writer is not None:
+                        # validate a possibly-stale idle connection first, so
+                        # only genuine mid-command drops become hard failures
+                        try:
+                            await self._roundtrip((b"PING",))
+                        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                            self._drop_connection()
                     if self._writer is None:
                         await self._connect_locked()
+                    sent = True  # _roundtrip writes before reading
                     return await self._roundtrip(parts)
                 except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
                     last = e
                     self._drop_connection()
+                    if sent and not replay_safe:
+                        raise StorageError(
+                            f"redis connection lost mid-command (not replayed): {e}"
+                        ) from e
                     if attempt + 1 < self.RETRY_ATTEMPTS:
                         await asyncio.sleep(self.RETRY_BASE_DELAY * (2**attempt))
             raise StorageError(
@@ -206,7 +229,8 @@ class RedisCoordinatorStorage(CoordinatorStorage):
 
     async def add_sum_participant(self, pk: bytes, ephm_pk: bytes) -> Optional[SumPartAddError]:
         ok = await self.client.command(
-            b"EVAL", ADD_SUM_PARTICIPANT, b"1", _K_SUM_DICT, pk, ephm_pk
+            b"EVAL", ADD_SUM_PARTICIPANT, b"1", _K_SUM_DICT, pk, ephm_pk,
+            replay_safe=False,
         )
         return None if ok == 1 else SumPartAddError.ALREADY_EXISTS
 
@@ -224,7 +248,8 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             seed_bytes = seed.as_bytes() if isinstance(seed, EncryptedMaskSeed) else bytes(seed)
             argv += [sum_pk, seed_bytes]
         code = await self.client.command(
-            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", _K_SUM_DICT, _K_UPDATE_SET, *argv
+            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", _K_SUM_DICT, _K_UPDATE_SET, *argv,
+            replay_safe=False,
         )
         return {
             0: None,
@@ -256,6 +281,7 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             _K_MASK_DICT,
             pk,
             serialize_mask_object(mask),
+            replay_safe=False,
         )
         return {
             0: None,

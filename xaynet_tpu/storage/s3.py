@@ -131,6 +131,18 @@ async def _http_request(
                 # HEAD carries Content-Length of the WOULD-BE body but no
                 # body bytes; reading would hit EOF
                 data = b""
+            elif "chunked" in resp_headers.get("transfer-encoding", "").lower():
+                # de-chunk (reverse proxies in front of Minio answer this way)
+                parts = []
+                while True:
+                    size_line = await reader.readline()
+                    size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                    if size == 0:
+                        await reader.readline()  # trailing CRLF
+                        break
+                    parts.append(await reader.readexactly(size))
+                    await reader.readexactly(2)  # chunk CRLF
+                data = b"".join(parts)
             else:
                 length = resp_headers.get("content-length")
                 if length is not None:
