@@ -116,14 +116,19 @@ def main() -> None:
     stack = jax.device_put(host_stack)
     del host_stack
 
-    # candidate kernels: XLA fold and (on real accelerators) the Pallas fold;
-    # calibrate quickly and measure with the faster one
+    # candidate kernels: XLA fold and (on real accelerators) the Pallas fold
+    # at several tile sizes; calibrate quickly and measure with the fastest
     candidates = {"xla": lambda a, s: fold_planar_batch(a, s, order)}
     if on_tpu:
         try:
             from xaynet_tpu.ops.fold_pallas import fold_planar_batch_pallas
 
-            candidates["pallas"] = lambda a, s: fold_planar_batch_pallas(a, s, order)
+            for tile in (1024, 2048, 4096, 8192):
+
+                def _pallas(a, s, _t=tile):
+                    return fold_planar_batch_pallas(a, s, order, tile_size=_t)
+
+                candidates[f"pallas-t{tile}"] = _pallas
         except Exception:
             pass
 

@@ -23,6 +23,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <vector>
 
@@ -398,7 +399,7 @@ bytes build_message(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t 
 // sealed for the coordinator (the send queue holds ready-to-POST bodies)
 void encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t coord_pk[32],
                      uint8_t tag, const bytes& payload, uint32_t max_message_size,
-                     std::vector<bytes>& queue) {
+                     std::deque<bytes>& queue) {
   if (max_message_size == 0 || kHeader + payload.size() <= max_message_size) {
     bytes msg = build_message(sk64, pk, coord_pk, tag, false, payload);
     bytes sealed;
@@ -488,7 +489,7 @@ struct Participant {
   bool have_ephm = false;
   uint8_t ephm_sk[32] = {0};
   uint8_t ephm_pk[32] = {0};
-  std::vector<bytes> pending;  // sealed parts not yet delivered
+  std::deque<bytes> pending;  // sealed parts not yet delivered (O(1) pops)
   Phase after_send = Phase::Awaiting;
 
   // embedder interaction
@@ -543,7 +544,7 @@ int drain(Participant& p) {
     bytes resp;
     int rc = p.fetch("POST /message", p.pending.front().data(), p.pending.front().size(), resp);
     if (rc < 0) return XN_ERR_TRANSPORT;  // retry THIS part on a later tick
-    p.pending.erase(p.pending.begin());
+    p.pending.pop_front();
   }
   p.phase = p.after_send;
   return XN_OK;
@@ -929,6 +930,10 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   take(&den, 8);
   p->scalar_num = (int64_t)num;
   p->scalar_den = (int64_t)den;
+  if (p->scalar_den <= 0 || p->scalar_num < 0) {  // same contract as _new
+    delete p;
+    return nullptr;
+  }
   uint8_t mms[4];
   take(mms, 4);
   p->max_message_size = ((uint32_t)mms[0] << 24) | (mms[1] << 16) | (mms[2] << 8) | mms[3];

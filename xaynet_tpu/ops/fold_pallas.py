@@ -106,17 +106,21 @@ def _fold_kernel(acc_ref, stack_ref, out_ref, *, k: int, n_limb: int, order: int
     out_ref[:] = jnp.stack(reduced)
 
 
-@partial(jax.jit, static_argnames=("order", "interpret"), donate_argnums=(0,))
-def fold_planar_batch_pallas(acc, stack_planar, order: int, interpret: bool = False):
+@partial(jax.jit, static_argnames=("order", "interpret", "tile_size"), donate_argnums=(0,))
+def fold_planar_batch_pallas(
+    acc, stack_planar, order: int, interpret: bool = False, tile_size: int | None = None
+):
     """Pallas version of ``fold_jax.fold_planar_batch`` (same contract).
 
     Model lengths that don't divide the tile are zero-padded internally
     (zeros are valid group elements) and sliced back afterwards.
+    ``tile_size`` overrides the default tile (bench.py sweeps it on real
+    hardware to pick the fastest VMEM blocking for the chip).
     """
     k, n_limb, n = stack_planar.shape
     if k > MAX_LAZY_BATCH:
         raise ValueError(f"batch of {k} exceeds lazy-carry headroom {MAX_LAZY_BATCH}")
-    tile = min(TILE, n)
+    tile = min(tile_size if tile_size else TILE, n)
     padded_n = -(-n // tile) * tile
     if padded_n != n:
         pad = padded_n - n

@@ -48,6 +48,9 @@ class Metrics:
     def event(self, round_id: int, kind: str, detail: str = "") -> None:
         self._emit("event_" + kind, detail, round_id)
 
+    def close(self) -> None:
+        """Flush/stop the sink; no-op for synchronous sinks."""
+
 
 class LogMetrics(Metrics):
     def _emit(self, measurement: str, value, round_id: int, phase: str = "") -> None:
@@ -85,7 +88,11 @@ def _influx_line(measurement: str, value, round_id: int, phase: str = "") -> str
     if isinstance(value, (int, float)):
         field = f"value={value}"
     else:
-        escaped = str(value).replace('"', '\\"')
+        # line protocol: backslash BEFORE quote, and no raw newlines (a bad
+        # value must never invalidate the rest of a batch)
+        escaped = (
+            str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+        )
         field = f'value="{escaped}"'
     return f"xaynet_{measurement}{tags} {field} {int(time.time() * 1e9)}"
 

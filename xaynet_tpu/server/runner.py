@@ -127,6 +127,8 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
     finally:
         machine_task.cancel()
         await rest.stop()
+        if metrics is not None:
+            metrics.close()  # drain the async sink's queued tail
         logger.info("coordinator stopped")
 
 

@@ -52,12 +52,14 @@ class RespClient:
 
     RETRY_ATTEMPTS = 4
     RETRY_BASE_DELAY = 0.05  # seconds; doubles per attempt
+    IDLE_PROBE_AFTER = 1.0  # validate connections idle longer than this
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0):
         self.host, self.port, self.db = host, port, db
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._lock = asyncio.Lock()
+        self._last_use = 0.0
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
@@ -84,9 +86,15 @@ class RespClient:
             for attempt in range(self.RETRY_ATTEMPTS):
                 sent = False
                 try:
-                    if not replay_safe and self._writer is not None:
-                        # validate a possibly-stale idle connection first, so
+                    if (
+                        not replay_safe
+                        and self._writer is not None
+                        and asyncio.get_running_loop().time() - self._last_use
+                        > self.IDLE_PROBE_AFTER
+                    ):
+                        # validate a stale-looking idle connection first, so
                         # only genuine mid-command drops become hard failures
+                        # (hot-path commands skip the probe entirely)
                         try:
                             await self._roundtrip((b"PING",))
                         except (ConnectionError, OSError, asyncio.IncompleteReadError):
@@ -94,7 +102,9 @@ class RespClient:
                     if self._writer is None:
                         await self._connect_locked()
                     sent = True  # _roundtrip writes before reading
-                    return await self._roundtrip(parts)
+                    result = await self._roundtrip(parts)
+                    self._last_use = asyncio.get_running_loop().time()
+                    return result
                 except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
                     last = e
                     self._drop_connection()
