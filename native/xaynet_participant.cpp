@@ -201,6 +201,11 @@ struct MaskCfg {
   double add_shift = 0.0;      // valid for the f32 bounded fast path
   double exp_shift = 0.0;
   bool fast_f32 = false;       // f32 data, bounded, order <= 16 bytes
+  // exact __int128 shifts — valid for f32-bounded and i32/i64 (any bound):
+  // every such config has E = 10^10 and A <= 2^63
+  bool exact_ae = false;
+  unsigned __int128 a_int = 0;
+  unsigned __int128 e_int = 0;
 };
 
 bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
@@ -217,11 +222,28 @@ bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
       for (uint32_t j = 0; j + 1 < n && pow2_at_boundary; j++)
         if (e.bytes[j] != 0) pow2_at_boundary = false;
       cfg.elem_nbytes = pow2_at_boundary ? n - 1 : n;
-      // data=F32(0), bound != Bmax(4)
-      cfg.fast_f32 = raw[1] == 0 && raw[2] != 4 && e.nbytes <= 16;
+      // bound wire values: B0=0, B2=2, B4=4, B6=6, BMAX=255
+      const bool bmax = raw[2] == 255;
+      cfg.fast_f32 = raw[1] == 0 && !bmax && e.nbytes <= 16;
+      if (!bmax) {  // bounded: A = 10^bound
+        unsigned long long a = 1;
+        for (uint8_t d = 0; d < raw[2]; d++) a *= 10;
+        cfg.a_int = a;
+        cfg.exact_ae = true;
+      } else if (raw[1] == 2) {  // i32 Bmax: A = 2^31
+        cfg.a_int = 1ull << 31;
+        cfg.exact_ae = true;
+      } else if (raw[1] == 3) {  // i64 Bmax: A = 2^63
+        cfg.a_int = (unsigned __int128)1 << 63;
+        cfg.exact_ae = true;
+      }
+      // E = 10^10 for f32-bounded and all integer data types; f64 uses
+      // 10^20 which exceeds the exact budget here (interpreter FFI covers it)
+      if (raw[1] == 1) cfg.exact_ae = false;  // f64: not natively masked
+      if (raw[1] == 0 && bmax) cfg.exact_ae = false;  // f32 Bmax
+      cfg.e_int = 10000000000ull;
       if (cfg.fast_f32) {
-        static const double kAdd[4] = {1.0, 100.0, 10000.0, 1000000.0};
-        cfg.add_shift = kAdd[raw[2]];
+        cfg.add_shift = (double)(unsigned long long)cfg.a_int;
         cfg.exp_shift = 1e10;
       }
       return true;
@@ -494,7 +516,9 @@ struct Participant {
 
   // embedder interaction
   std::vector<float> model;
+  std::vector<int64_t> model_i;  // integer data types (i32/i64 configs)
   bool model_set = false;
+  bool model_i_set = false;
   bool wants_model = false;
   bool made_progress = false;
   bool new_round_flag = false;
@@ -595,15 +619,25 @@ int step_update(Participant& p) {
     return XN_ERR_PARSE;
   if (sum_dict.empty()) return XN_OK;
 
-  if (!p.model_set || p.model.size() != p.params.model_length) {
-    p.wants_model = true;
-    return XN_OK;
-  }
-
   MaskCfg cfg_n, cfg_1;
   if (!lookup_cfg(p.params.cfg_vect, cfg_n) || !lookup_cfg(p.params.cfg_unit, cfg_1))
     return XN_ERR_CONFIG;
-  if (!cfg_n.fast_f32 || !cfg_1.fast_f32) return XN_ERR_CONFIG;  // native FSM: f32 bounded
+  // native FSM coverage: f32 bounded (fused dd kernel) and i32/i64 any
+  // bound (exact __int128 encode); f64 and f32/Bmax use the interpreter FFI
+  const bool is_int = cfg_n.raw[1] == 2 || cfg_n.raw[1] == 3;
+  if (is_int) {
+    if (!cfg_n.exact_ae || !cfg_1.exact_ae) return XN_ERR_CONFIG;
+    if (!p.model_i_set || p.model_i.size() != p.params.model_length) {
+      p.wants_model = true;
+      return XN_OK;
+    }
+  } else {
+    if (!cfg_n.fast_f32 || !cfg_1.fast_f32) return XN_ERR_CONFIG;
+    if (!p.model_set || p.model.size() != p.params.model_length) {
+      p.wants_model = true;
+      return XN_OK;
+    }
+  }
 
   // fresh mask seed; unit draw first, then the vector draws continue on the
   // same keystream (parity: MaskSeed.derive_mask / Masker.mask)
@@ -613,37 +647,67 @@ int step_update(Participant& p) {
   uint64_t offset =
       xn_sample_uniform(mask_seed, 0, 1, cfg_1.order_le, cfg_1.order_nbytes, rand1.data());
 
-  // clamped scalar s = min(num/den, A1); dd split for the fused kernel
-  double a1 = cfg_1.add_shift;
-  double s_hi = (double)p.scalar_num / (double)p.scalar_den;
-  double s_lo = 0.0;  // scalars are small rationals; refine via fma residue
-  s_lo = std::fma(-s_hi, (double)p.scalar_den, (double)p.scalar_num) / (double)p.scalar_den;
-  if (s_hi > a1 || (s_hi == a1 && s_lo > 0)) {
-    s_hi = a1;
-    s_lo = 0.0;
+  const uint64_t n = p.params.model_length;
+  bytes vect(n * cfg_n.elem_nbytes);
+  if (is_int) {
+    // exact integer masking: per element
+    //   shifted = floor((clamp(num/den * w, -A, A) + A) * E)
+    // num, den <= 2^31 (enforced at construction) keeps everything inside
+    // __int128 via a quotient/remainder split of the division by den.
+    bytes draws(n * cfg_n.order_nbytes);
+    xn_sample_uniform(mask_seed, offset, n, cfg_n.order_le, cfg_n.order_nbytes, draws.data());
+    const __int128 num = p.scalar_num, den = p.scalar_den;
+    const __int128 a_den = (__int128)cfg_n.a_int * den;
+    const __int128 e = (__int128)cfg_n.e_int;
+    std::memset(vect.data(), 0, vect.size());
+    for (uint64_t i = 0; i < n; i++) {
+      __int128 c = num * (__int128)p.model_i[i];
+      if (c > a_den) c = a_den;
+      if (c < -a_den) c = -a_den;
+      __int128 t = c + a_den;  // in [0, 2*A*den]
+      __int128 shifted = (t / den) * e + ((t % den) * e) / den;
+      uint8_t* dst = vect.data() + i * cfg_n.elem_nbytes;
+      for (uint32_t j = 0; j < cfg_n.elem_nbytes && shifted > 0; j++) {
+        dst[j] = (uint8_t)(shifted & 0xff);
+        shifted >>= 8;
+      }
+      // accepted draws fit the element width; add modulo the order
+      add_mod_le(dst, draws.data() + i * cfg_n.order_nbytes, cfg_n.order_le,
+                 cfg_n.order_nbytes, cfg_n.elem_nbytes);
+    }
+  } else {
+    // clamped scalar s = min(num/den, A1); dd split for the fused kernel
+    double a1 = cfg_1.add_shift;
+    double s_hi = (double)p.scalar_num / (double)p.scalar_den;
+    double s_lo =
+        std::fma(-s_hi, (double)p.scalar_den, (double)p.scalar_num) / (double)p.scalar_den;
+    if (s_hi > a1 || (s_hi == a1 && s_lo > 0)) {
+      s_hi = a1;
+      s_lo = 0.0;
+    }
+    uint64_t end_off = xn_mask_f32(mask_seed, offset, p.model.data(), n, cfg_n.order_le,
+                                   cfg_n.order_nbytes, cfg_n.elem_nbytes, cfg_n.add_shift,
+                                   cfg_n.exp_shift, s_hi, s_lo, vect.data());
+    if (end_off == 0) return XN_ERR_CONFIG;
   }
 
-  // masked vector in wire element bytes (fused native kernel)
-  bytes vect(p.params.model_length * cfg_n.elem_nbytes);
-  uint64_t end_off = xn_mask_f32(mask_seed, offset, p.model.data(), p.params.model_length,
-                                 cfg_n.order_le, cfg_n.order_nbytes, cfg_n.elem_nbytes,
-                                 cfg_n.add_shift, cfg_n.exp_shift, s_hi, s_lo, vect.data());
-  if (end_off == 0) return XN_ERR_CONFIG;
-
-  // masked unit: floor((s + A1) * E1) + rand1 mod unit order.
-  // s = num/den clamped; exact in __int128 for the bounded-f32 family
-  __int128 num = p.scalar_num, den = p.scalar_den;
-  __int128 a1i = (__int128)a1, e1i = (__int128)cfg_1.exp_shift;
-  if (num > a1i * den) num = a1i * den;
-  __int128 shifted1 = ((num + a1i * den) * e1i) / den;
+  // masked unit: floor((min(s, A1) + A1) * E1) + rand1 mod unit order —
+  // exact __int128 for every natively-supported config (E1 = 10^10)
   bytes unit_elem(cfg_1.elem_nbytes, 0);
-  for (uint32_t i = 0; i < cfg_1.elem_nbytes && shifted1 > 0; i++) {
-    unit_elem[i] = (uint8_t)(shifted1 & 0xff);
-    shifted1 >>= 8;
+  {
+    const __int128 num = p.scalar_num, den = p.scalar_den;
+    const __int128 a1_den = (__int128)cfg_1.a_int * den;
+    const __int128 e1 = (__int128)cfg_1.e_int;
+    __int128 s_num = num > a1_den ? a1_den : num;  // scalar clamped above by A1
+    __int128 t = s_num + a1_den;
+    __int128 shifted1 = (t / den) * e1 + ((t % den) * e1) / den;
+    for (uint32_t i = 0; i < cfg_1.elem_nbytes && shifted1 > 0; i++) {
+      unit_elem[i] = (uint8_t)(shifted1 & 0xff);
+      shifted1 >>= 8;
+    }
+    add_mod_le(unit_elem.data(), rand1.data(), cfg_1.order_le, cfg_1.order_nbytes,
+               cfg_1.elem_nbytes);
   }
-  bytes rand1_w(rand1.begin(), rand1.begin() + cfg_1.elem_nbytes);
-  add_mod_le(unit_elem.data(), rand1_w.data(), cfg_1.order_le, cfg_1.order_nbytes,
-             cfg_1.elem_nbytes);
 
   // payload: sum_sig(64) || update_sig(64) || MaskObject || LV seed dict
   bytes payload;
@@ -763,7 +827,10 @@ XN_EXPORT int xaynet_ffi_crypto_init(void) { return sodium_init() >= 0 ? XN_OK :
 XN_EXPORT void* xaynet_ffi_participant_new(const uint8_t signing_seed[32], int64_t scalar_num,
                                            int64_t scalar_den, uint32_t max_message_size,
                                            xn_transport_fn transport, void* user) {
-  if (!signing_seed || !transport || scalar_den <= 0 || scalar_num < 0) return nullptr;
+  // num/den bounded to 2^31-1 keeps every fixed-point encode inside __int128
+  if (!signing_seed || !transport || scalar_den <= 0 || scalar_num < 0 ||
+      scalar_den > 0x7FFFFFFF || scalar_num > 0x7FFFFFFF)
+    return nullptr;
   if (sodium_init() < 0) return nullptr;
   auto* p = new Participant();
   std::memcpy(p->sign_seed, signing_seed, 32);
@@ -860,6 +927,17 @@ XN_EXPORT int xaynet_ffi_participant_set_model(void* handle, const float* data, 
   return XN_OK;
 }
 
+// integer data types (i32/i64 mask configs) take their model as int64
+XN_EXPORT int xaynet_ffi_participant_set_model_i64(void* handle, const int64_t* data,
+                                                   uint64_t len) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p || !data) return XN_ERR_NULL;
+  p->model_i.assign(data, data + len);
+  p->model_i_set = true;
+  p->wants_model = false;
+  return XN_OK;
+}
+
 // fetch the latest global model (f64 little-endian over the transport);
 // returns element count (>=0) or an error code; *out borrowed until the
 // next call/destroy
@@ -894,7 +972,7 @@ XN_EXPORT int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t*
   buf.push_back((uint8_t)p->phase);
   buf.push_back((uint8_t)p->after_send);
   buf.push_back((uint8_t)((p->have_params ? 1 : 0) | (p->have_ephm ? 2 : 0) |
-                          (p->model_set ? 4 : 0)));
+                          (p->model_set ? 4 : 0) | (p->model_i_set ? 8 : 0)));
   buf.insert(buf.end(), p->ephm_sk, p->ephm_sk + 32);
   buf.insert(buf.end(), p->sum_sig, p->sum_sig + 64);
   buf.insert(buf.end(), p->update_sig, p->update_sig + 64);
@@ -904,6 +982,7 @@ XN_EXPORT int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t*
   buf.insert(buf.end(), cnt, cnt + 4);
   for (auto& part : p->pending) put_lv(buf, part.data(), part.size());
   put_lv(buf, (const uint8_t*)p->model.data(), p->model.size() * 4);
+  put_lv(buf, (const uint8_t*)p->model_i.data(), p->model_i.size() * 8);
 
   *out = (uint8_t*)std::malloc(buf.size());
   if (!*out) return XN_ERR_NULL;
@@ -930,7 +1009,8 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   take(&den, 8);
   p->scalar_num = (int64_t)num;
   p->scalar_den = (int64_t)den;
-  if (p->scalar_den <= 0 || p->scalar_num < 0) {  // same contract as _new
+  if (p->scalar_den <= 0 || p->scalar_num < 0 || p->scalar_den > 0x7FFFFFFF ||
+      p->scalar_num > 0x7FFFFFFF) {  // same contract as _new
     delete p;
     return nullptr;
   }
@@ -946,6 +1026,7 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   p->have_params = fl & 1;
   p->have_ephm = fl & 2;
   p->model_set = fl & 4;
+  p->model_i_set = fl & 8;
   take(p->ephm_sk, 32);
   if (p->have_ephm) crypto_scalarmult_base(p->ephm_pk, p->ephm_sk);
   take(p->sum_sig, 64);
@@ -993,6 +1074,19 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   }
   p->model.resize(model_raw.size() / 4);
   std::memcpy(p->model.data(), model_raw.data(), model_raw.size());
+  // trailing int-model LV: absent in blobs saved by older library versions
+  // (treated as empty — format is append-only for forward compatibility)
+  if (o < len) {
+    bytes model_i_raw;
+    if (!take_lv(model_i_raw) || model_i_raw.size() % 8 != 0) {
+      delete p;
+      return nullptr;
+    }
+    p->model_i.resize(model_i_raw.size() / 8);
+    std::memcpy(p->model_i.data(), model_i_raw.data(), model_i_raw.size());
+  } else {
+    p->model_i_set = false;
+  }
   p->transport = transport;
   p->transport_user = user;
   return p;

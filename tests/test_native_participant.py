@@ -230,11 +230,13 @@ class _Bridge:
             pass  # drops are logged server-side; clients watch round progress
 
 
-def test_native_participants_complete_full_round():
-    """1 native summer + 3 native updaters complete a PET round against the
-    Python coordinator; the global model equals the exact mean. The small
-    max_message_size forces the native multipart encoder + the server's
-    streaming reassembly."""
+def _run_native_round(lib, cfg: MaskConfig, model_len: int, set_models, expect,
+                      after_round=None, max_message_size=400):
+    """Drives 1 native summer + 3 native updaters through a full round
+    against the in-process Python coordinator; asserts the global model.
+    ``after_round(lib, handles, bridge)`` runs before handles are destroyed."""
+    import time
+
     from xaynet_tpu.server.services import Fetcher, PetMessageHandler
     from xaynet_tpu.server.settings import CountSettings, Settings
     from xaynet_tpu.server.state_machine import StateMachineInitializer
@@ -245,14 +247,12 @@ def test_native_participants_complete_full_round():
     )
     from xaynet_tpu.storage.traits import Store
 
-    lib = _load()
-    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3)
     settings = Settings.default()
     settings.mask.group_type = cfg.group_type
     settings.mask.data_type = cfg.data_type
     settings.mask.bound_type = cfg.bound_type
     settings.mask.model_type = cfg.model_type
-    settings.model.length = 24
+    settings.model.length = model_len
     settings.pet.sum.count = CountSettings(1, 1)
     settings.pet.update.count = CountSettings(3, 3)
     settings.pet.sum2.count = CountSettings(1, 1)
@@ -286,9 +286,8 @@ def test_native_participants_complete_full_round():
 
     thread = threading.Thread(target=run_coordinator, daemon=True)
     thread.start()
+    handles = []
     try:
-        import time
-
         for _ in range(300):
             if "fetcher" in state:
                 break
@@ -308,44 +307,118 @@ def test_native_participants_complete_full_round():
             if all(k.public != u.public for u in upd_keys):
                 upd_keys.append(k)
 
-        handles = []
         summer = lib.xaynet_ffi_participant_new(
-            _u8(sum_keys.secret), 1, 3, 400, bridge.cb, None
+            _u8(sum_keys.secret), 1, 3, max_message_size, bridge.cb, None
         )
         assert summer
         handles.append(summer)
-        vals = [0.25, -0.5, 0.75]
         for i, k in enumerate(upd_keys):
-            h = lib.xaynet_ffi_participant_new(_u8(k.secret), 1, 3, 400, bridge.cb, None)
-            assert h
-            model = np.full(24, vals[i], dtype=np.float32)
-            lib.xaynet_ffi_participant_set_model(
-                h, model.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 24
+            h = lib.xaynet_ffi_participant_new(
+                _u8(k.secret), 1, 3, max_message_size, bridge.cb, None
             )
+            assert h
+            set_models(lib, h, i)
             handles.append(h)
 
         out_ptr = ctypes.POINTER(ctypes.c_double)()
         n = 0
-        for sweep in range(400):
+        for _ in range(400):
             for h in handles:
                 lib.xaynet_ffi_participant_tick(h)
             n = lib.xaynet_ffi_participant_global_model(handles[0], ctypes.byref(out_ptr))
             if n > 0:
                 break
             time.sleep(0.01)
-        assert n == 24, f"round did not complete (n={n})"
-        got = np.ctypeslib.as_array(out_ptr, shape=(24,)).copy()
+        assert n == model_len, f"round did not complete (n={n})"
+        got = np.ctypeslib.as_array(out_ptr, shape=(model_len,)).copy()
+        expect(got)
+        if after_round is not None:
+            after_round(lib, handles, bridge)
+    finally:
+        for h in handles:
+            lib.xaynet_ffi_participant_destroy(h)
+        stop_evt.set()
+        thread.join(timeout=10)
+
+
+def test_native_round_i64_config():
+    """Full round on an INTEGER data type (i64/B2): exercises the exact
+    __int128 masking path instead of the fused f32 kernel."""
+    lib = _load()
+    cfg = MaskConfig(GroupType.INTEGER, DataType.I64, BoundType.B2, ModelType.M3)
+    vals = [[-3, 7, 0, 25], [5, -1, 2, -25], [1, 0, 4, 9]]
+
+    def set_models(lib, h, i):
+        arr = np.asarray(vals[i] * 4, dtype=np.int64)  # model_len 16
+        rc = lib.xaynet_ffi_participant_set_model_i64(
+            h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 16
+        )
+        assert rc == 0
+
+    def expect(got):
+        want = np.mean(np.asarray([v * 4 for v in vals], dtype=np.float64), axis=0)
+        assert np.allclose(got, want, atol=1e-9), (got[:4], want[:4])
+
+    lib.xaynet_ffi_participant_set_model_i64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_uint64,
+    ]
+    _run_native_round(lib, cfg, 16, set_models, expect)
+
+
+def test_native_round_f32_b2_config():
+    """Full round on f32/B2 — pins the bound->add_shift mapping for the
+    non-B0 wire values (B2=2, B4=4, B6=6, not consecutive indices)."""
+    lib = _load()
+    cfg = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B2, ModelType.M3)
+    vals = [12.5, -40.25, 3.75]
+
+    def set_models(lib, h, i):
+        arr = np.full(8, vals[i], dtype=np.float32)
+        assert lib.xaynet_ffi_participant_set_model(
+            h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 8
+        ) == 0
+
+    def expect(got):
+        assert np.allclose(got, np.mean(vals), atol=1e-7), got[:3]
+
+    _run_native_round(lib, cfg, 8, set_models, expect)
+
+
+def test_native_participants_complete_full_round():
+    """1 native summer + 3 native updaters complete a PET round against the
+    Python coordinator; the global model equals the exact mean. The small
+    max_message_size forces the native multipart encoder + the server's
+    streaming reassembly; afterwards save/restore round-trips (including
+    tolerance for blobs without the trailing int-model field)."""
+    lib = _load()
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3)
+    vals = [0.25, -0.5, 0.75]
+
+    def set_models(lib, h, i):
+        model = np.full(24, vals[i], dtype=np.float32)
+        assert lib.xaynet_ffi_participant_set_model(
+            h, model.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 24
+        ) == 0
+
+    def expect(got):
         assert np.allclose(got, np.mean(vals), atol=1e-7), got[:4]
 
-        # save/restore round-trips
+    def after_round(lib, handles, bridge):
         buf = ctypes.POINTER(ctypes.c_uint8)()
         blen = ctypes.c_uint64()
-        assert lib.xaynet_ffi_participant_save(handles[0], ctypes.byref(buf), ctypes.byref(blen)) == 0
+        assert lib.xaynet_ffi_participant_save(
+            handles[0], ctypes.byref(buf), ctypes.byref(blen)
+        ) == 0
+        blob = bytes(ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8 * blen.value)).contents)
         restored = lib.xaynet_ffi_participant_restore(buf, blen.value, bridge.cb, None)
         assert restored
         lib.xaynet_ffi_participant_destroy(restored)
-        for h in handles:
-            lib.xaynet_ffi_participant_destroy(h)
-    finally:
-        stop_evt.set()
-        thread.join(timeout=10)
+        # old-format blob (no trailing int-model LV) still restores
+        trimmed = blob[: len(blob) - 4]  # drop the empty trailing LV
+        restored2 = lib.xaynet_ffi_participant_restore(_u8(trimmed), len(trimmed), bridge.cb, None)
+        assert restored2
+        lib.xaynet_ffi_participant_destroy(restored2)
+
+    _run_native_round(lib, cfg, 24, set_models, expect, after_round=after_round)

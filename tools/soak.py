@@ -1,0 +1,171 @@
+"""Long-haul soak: a real coordinator process under participant churn.
+
+Runs the coordinator as a subprocess (the production entry point), then
+cycles fresh participants through rounds over the REST socket — every round
+gets NEW keypairs (churn), so dictionaries, multipart buffers and the model
+archive are exercised continuously. Tracks the coordinator's RSS across
+rounds; steady-state growth beyond the expected per-round model archive
+indicates a leak.
+
+Usage:
+  python tools/soak.py --rounds 200 [--model-len 2000]
+Prints one JSON line: rounds completed, wall, rounds/s, RSS start/end/slope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+CONFIG = """
+[api]
+bind_address = "127.0.0.1:{port}"
+
+[pet.sum]
+prob = 0.5
+[pet.sum.count]
+min = 1
+max = 1
+[pet.sum.time]
+min = 0.0
+max = 20.0
+
+[pet.update]
+prob = 0.9
+[pet.update.count]
+min = 3
+max = 3
+[pet.update.time]
+min = 0.0
+max = 20.0
+
+[pet.sum2.count]
+min = 1
+max = 1
+[pet.sum2.time]
+min = 0.0
+max = 20.0
+
+[model]
+length = {model_len}
+
+[storage]
+backend = "filesystem"
+model_dir = "{model_dir}"
+
+[log]
+filter = "warning"
+"""
+
+
+def run_soak_sync(port: int, rounds: int, model_len: int) -> dict:
+    # synchronous driver: Participant.tick() owns its own event loop, so
+    # the soak loop must NOT run inside asyncio itself
+    from fractions import Fraction
+
+    import numpy as np
+
+    from xaynet_tpu.sdk.client import HttpClient
+    from xaynet_tpu.sdk.participant import Participant
+    from xaynet_tpu.sdk.simulation import keys_for_task
+
+    url = f"http://127.0.0.1:{port}"
+
+    def fetch_params():
+        return asyncio.run(HttpClient(url).get_round_params())
+
+    completed = 0
+    last_seed = None
+    t0 = time.perf_counter()
+    while completed < rounds:
+        params = fetch_params()
+        if params.seed.as_bytes() == last_seed:
+            time.sleep(0.01)
+            continue
+        last_seed = params.seed.as_bytes()
+        seed = last_seed
+        # churn: brand-new participants every round
+        summer = keys_for_task(seed, params.sum, params.update, "sum")
+        upd, start = [], 0
+        while len(upd) < 3:
+            k = keys_for_task(seed, params.sum, params.update, "update", start=start)
+            start += 100000
+            if all(k.public != u.public for u in upd) and k.public != summer.public:
+                upd.append(k)
+
+        parts = [Participant(url, keys=summer, scalar=Fraction(1, 3))]
+        for i, k in enumerate(upd):
+            p = Participant(url, keys=k, scalar=Fraction(1, 3))
+            p.set_model(np.full(model_len, 0.25 * (i + 1), dtype=np.float32))
+            parts.append(p)
+        for _ in range(400):
+            for p in parts:
+                p.tick()
+            if fetch_params().seed.as_bytes() != seed:
+                break  # round completed, coordinator moved on
+        else:
+            raise RuntimeError(f"round {completed + 1} did not complete")
+        completed += 1
+    return {"rounds": completed, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=200)
+    ap.add_argument("--model-len", type=int, default=2000)
+    ap.add_argument("--port", type=int, default=18439)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_path = os.path.join(tmp, "config.toml")
+        with open(cfg_path, "w") as f:
+            f.write(
+                CONFIG.format(
+                    port=args.port, model_len=args.model_len, model_dir=os.path.join(tmp, "models")
+                )
+            )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", cfg_path],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            time.sleep(4)
+            rss_start = _rss_kb(proc.pid)
+            result = run_soak_sync(args.port, args.rounds, args.model_len)
+            rss_end = _rss_kb(proc.pid)
+            result.update(
+                {
+                    "rounds_per_s": round(result["rounds"] / result["wall_s"], 2),
+                    "rss_start_kb": rss_start,
+                    "rss_end_kb": rss_end,
+                    "rss_kb_per_round": round((rss_end - rss_start) / max(result["rounds"], 1), 1),
+                }
+            )
+            print(json.dumps(result))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    main()
