@@ -60,11 +60,13 @@ def _start(model_len):
     # participants from the previous round stealing slots (their roles
     # re-draw on the new seed); the phase stays open long enough for the
     # pinned participants to register even if a leftover got in first
+    # generous time.max: under full-suite load, participant jit/training can
+    # be slow; a phase timing out mid-test makes the round count flaky
     settings = Settings(
         pet=PetSettings(
-            sum=PhaseSettings(prob=0.3, count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 30)),
-            update=PhaseSettings(prob=0.6, count=CountSettings(N_UPDATE, N_UPDATE + 3), time=TimeSettings(1.0, 30)),
-            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 30)),
+            sum=PhaseSettings(prob=0.3, count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 90)),
+            update=PhaseSettings(prob=0.6, count=CountSettings(N_UPDATE, N_UPDATE + 3), time=TimeSettings(1.0, 90)),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM + 3), time=TimeSettings(1.0, 90)),
         )
     )
     settings.model.length = model_len
@@ -133,9 +135,9 @@ def test_federated_mlp_learns():
     xs, ys = make_data(999)
     losses = []
     last_model = None
-    deadline = time.time() + 150
     n_rounds = 3
     for round_no in range(n_rounds):
+        deadline = time.time() + 120  # per round, not shared across rounds
         threads, trainers = [], []
         for i in range(N_SUM):
             keys = keys_for_task(seed, 0.3, 0.6, "sum", start=i * 1000)
@@ -162,6 +164,8 @@ def test_federated_mlp_learns():
             time.sleep(0.1)
         for t in threads:
             t.stop()
+        for t in threads:  # fully stopped: no leftover ticking into the
+            t.join(timeout=5)  # next round's slots with a stale model
         # the next round's seed (Idle may not have republished params yet
         # at the moment the model broadcast is observed — wait for it)
         while True:
@@ -203,6 +207,58 @@ def test_local_federation_harness():
     assert len(results) == 2
     np.testing.assert_allclose(results[0].global_model, np.full(MLEN, 0.6), atol=1e-8)
     assert results[0].round_id == 1 and results[1].round_id == 2
+
+
+def test_local_federation_integer_models():
+    """Int64 models federate through an i64 mask config end-to-end (the
+    quantized-delta path of examples/lora_federated.py): the SDK must keep
+    the integer dtype through set_model and the exact encode must accept
+    numpy scalars."""
+    import numpy as np
+
+    from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType
+    from xaynet_tpu.sdk.api import ParticipantABC
+    from xaynet_tpu.sdk.federation import LocalFederation
+    from xaynet_tpu.server.settings import (
+        CountSettings,
+        PetSettings,
+        PhaseSettings,
+        Settings,
+        Sum2Settings,
+        TimeSettings,
+    )
+
+    MLEN = 7
+
+    class ConstInt(ParticipantABC):
+        def __init__(self, v):
+            self.v = v
+
+        def train_round(self, training_input):
+            return np.full(MLEN, self.v, dtype=np.int64)
+
+        def serialize_training_result(self, result):
+            return np.asarray(result, dtype=np.int64)
+
+    settings = Settings(
+        pet=PetSettings(
+            sum=PhaseSettings(prob=0.3, count=CountSettings(1, 1), time=TimeSettings(0, 60)),
+            update=PhaseSettings(prob=0.6, count=CountSettings(3, 3), time=TimeSettings(0, 60)),
+            sum2=Sum2Settings(count=CountSettings(1, 1), time=TimeSettings(0, 60)),
+        )
+    )
+    settings.mask.group_type = GroupType.INTEGER
+    settings.mask.data_type = DataType.I64
+    settings.mask.bound_type = BoundType.B6
+    fed = LocalFederation(model_length=MLEN, n_sum=1, n_update=3, settings=settings)
+    trainers = [ConstInt(0), ConstInt(-90_000), ConstInt(30_000), ConstInt(120_000)]
+    try:
+        results = list(fed.rounds(trainers, n_rounds=1, round_timeout=60))
+    finally:
+        fed.stop()
+    np.testing.assert_allclose(
+        results[0].global_model, np.full(MLEN, 20_000.0), atol=1e-5
+    )
 
 
 def test_ten_round_soak():

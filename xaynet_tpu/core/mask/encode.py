@@ -57,7 +57,8 @@ def encode_vect_exact(weights, scalar_clamped: Fraction, config: MaskConfig) -> 
     e = config.exp_shift
     out = []
     for w in weights:
-        scaled = scalar_clamped * Fraction(w)
+        # numpy scalars (e.g. float32) are not Rational; unwrap to python
+        scaled = scalar_clamped * Fraction(w.item() if hasattr(w, "item") else w)
         c = -a if scaled < -a else (a if scaled > a else scaled)
         t = c + a
         out.append((t.numerator * e) // t.denominator)

@@ -165,7 +165,9 @@ class AsyncParticipant(threading.Thread):
                 time.sleep(self._tick_interval)
 
     def set_model(self, model) -> None:
-        self._model_queue.put(np.asarray(model, dtype=np.float32))
+        from .participant import coerce_model_array
+
+        self._model_queue.put(coerce_model_array(model))
 
     def get_global_model(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
         self._new_global.wait(timeout)

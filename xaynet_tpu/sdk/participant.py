@@ -44,6 +44,18 @@ class _Events(Notify):
         self.new_global = True
 
 
+def coerce_model_array(model) -> np.ndarray:
+    """Staging dtype for a local model: floats go to f32; integer arrays
+    keep their dtype (coercing quantized ints to f32 would corrupt values
+    beyond 2^24). The float-vs-int decision against the round's mask config
+    happens at mask time (`StateMachine._step_update`), where the config is
+    actually known."""
+    arr = np.asarray(model)
+    if not np.issubdtype(arr.dtype, np.integer):
+        arr = np.asarray(arr, dtype=np.float32)
+    return arr
+
+
 class _SettableModelStore(ModelStore):
     def __init__(self):
         self.model: Optional[np.ndarray] = None
@@ -115,7 +127,7 @@ class Participant:
     # --- model exchange ---------------------------------------------------
 
     def set_model(self, model) -> None:
-        self._store.model = np.asarray(model, dtype=np.float32)
+        self._store.model = coerce_model_array(model)
 
     def clear_model(self) -> None:
         """Forget the staged local model (typically at round start)."""

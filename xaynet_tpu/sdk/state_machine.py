@@ -195,6 +195,14 @@ class StateMachine:
                 f"local model length {len(model)} != round model length "
                 f"{self.round_params.model_length}"
             )
+        # dtype vs the ROUND's mask config: integer weights on a float
+        # config ride the fused f32 fast path (values <= 2^24 are exact in
+        # f32; larger ones belong on an integer config anyway)
+        if isinstance(model, np.ndarray) and np.issubdtype(model.dtype, np.integer):
+            from ..core.mask.config import DataType
+
+            if self.round_params.mask_config.vect.data_type in (DataType.F32, DataType.F64):
+                model = model.astype(np.float32)
 
         masker = Masker(self.round_params.mask_config)
         seed, masked_model = masker.mask(Scalar.from_fraction(self.scalar), model)
