@@ -5,11 +5,18 @@ update-message serde at sizes up to ~10MB with 10k-entry seed dicts
 (reference: rust/benches/). This prints the same matrix for this
 implementation so regressions in the host paths are visible over commits.
 
-Run:  python tools/microbench.py
+Run:  python tools/microbench.py [--json]
+
+``--json`` appends one JSON record (git rev + every timing) to
+BENCH_HISTORY.jsonl at the repo root, the criterion-style
+tracked-over-commits record.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import subprocess
 import sys
 import time
 
@@ -39,8 +46,12 @@ from xaynet_tpu.core.message import Message, Update
 CFG = MaskConfig(GroupType.PRIME, DataType.F32, BoundType.B0, ModelType.M3)
 
 
+RESULTS: dict[str, float] = {}
+
+
 def timeit(label: str, fn, repeat: int = 3) -> None:
     best = min(_once(fn) for _ in range(repeat))
+    RESULTS[label] = round(best * 1e3, 3)
     print(f"{label:<56} {best * 1e3:10.2f} ms")
 
 
@@ -90,6 +101,17 @@ def main() -> None:
     wire = msg.to_bytes(keys.secret)
     timeit(f"update message serialize+sign ({len(wire)} B, 10k seeds)", lambda: msg.to_bytes(keys.secret))
     timeit("update message parse+verify", lambda: Message.from_bytes(wire))
+
+    if "--json" in sys.argv:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True, text=True
+        ).stdout.strip()
+        record = {"ts": time.time(), "rev": rev or "unknown", "timings_ms": RESULTS}
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                            "BENCH_HISTORY.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        print(f"appended {len(RESULTS)} timings for {rev} to BENCH_HISTORY.jsonl")
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ from typing import Optional
 
 import numpy as np
 
-from .participant import Participant
+from .participant import Participant, coerce_model_array
 
 logger = logging.getLogger("xaynet.sdk")
 
@@ -165,8 +165,6 @@ class AsyncParticipant(threading.Thread):
                 time.sleep(self._tick_interval)
 
     def set_model(self, model) -> None:
-        from .participant import coerce_model_array
-
         self._model_queue.put(coerce_model_array(model))
 
     def get_global_model(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:

@@ -196,13 +196,16 @@ class StateMachine:
                 f"{self.round_params.model_length}"
             )
         # dtype vs the ROUND's mask config: integer weights on a float
-        # config ride the fused f32 fast path (values <= 2^24 are exact in
-        # f32; larger ones belong on an integer config anyway)
+        # config become the config's float width (f32 fast path when exact
+        # to 2^24; f64 keeps integer exactness to 2^53)
         if isinstance(model, np.ndarray) and np.issubdtype(model.dtype, np.integer):
             from ..core.mask.config import DataType
 
-            if self.round_params.mask_config.vect.data_type in (DataType.F32, DataType.F64):
+            dt = self.round_params.mask_config.vect.data_type
+            if dt is DataType.F32:
                 model = model.astype(np.float32)
+            elif dt is DataType.F64:
+                model = model.astype(np.float64)
 
         masker = Masker(self.round_params.mask_config)
         seed, masked_model = masker.mask(Scalar.from_fraction(self.scalar), model)
