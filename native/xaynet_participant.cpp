@@ -201,8 +201,8 @@ struct MaskCfg {
   double add_shift = 0.0;      // valid for the f32 bounded fast path
   double exp_shift = 0.0;
   bool fast_f32 = false;       // f32 data, bounded, order <= 16 bytes
-  // exact __int128 shifts — valid for f32-bounded and i32/i64 (any bound):
-  // every such config has E = 10^10 and A <= 2^63
+  // exact shifts — valid for f32/f64 bounded and i32/i64 (any bound):
+  // E = 10^20 for f64, 10^10 otherwise; A <= 2^63
   bool exact_ae = false;
   unsigned __int128 a_int = 0;
   unsigned __int128 e_int = 0;
@@ -237,11 +237,13 @@ bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
         cfg.a_int = (unsigned __int128)1 << 63;
         cfg.exact_ae = true;
       }
-      // E = 10^10 for f32-bounded and all integer data types; f64 uses
-      // 10^20 which exceeds the exact budget here (interpreter FFI covers it)
-      if (raw[1] == 1) cfg.exact_ae = false;  // f64: not natively masked
+      // E = 10^20 for f64, 10^10 otherwise; Bmax float configs exceed the
+      // exact integer budget (interpreter FFI covers those)
+      if (raw[1] == 1 && bmax) cfg.exact_ae = false;  // f64 Bmax
       if (raw[1] == 0 && bmax) cfg.exact_ae = false;  // f32 Bmax
-      cfg.e_int = 10000000000ull;
+      cfg.e_int = raw[1] == 1
+                      ? (unsigned __int128)10000000000ull * 10000000000ull
+                      : (unsigned __int128)10000000000ull;
       if (cfg.fast_f32) {
         cfg.add_shift = (double)(unsigned long long)cfg.a_int;
         cfg.exp_shift = 1e10;
@@ -283,6 +285,91 @@ void add_mod_le(uint8_t* a, const uint8_t* b, const uint8_t* order_le, uint32_t 
       a[i] = (uint8_t)(d & 0xff);
     }
   }
+}
+
+// Exact f64 fixed-point encode for bounded configs:
+//   shifted = floor((clamp(num/den * w, -A, A) + A) * E)
+// computed without rounding: w = m * 2^e exactly (53-bit mantissa), the
+// numerator num*m*E spans up to ~2^185 and is handled as 3 base-2^64 limbs
+// with long division by den and an exact right-shift. Preconditions:
+// 0 <= num <= 2^31-1, 1 <= den <= 2^31-1, A <= 10^6, E <= 10^20, w finite.
+unsigned __int128 encode_f64_exact(double w, int64_t num, int64_t den,
+                                   unsigned long long A, unsigned __int128 E) {
+  const unsigned __int128 AE = (unsigned __int128)A * E;
+  if (!(w == w) || num == 0 || w == 0.0) return AE;  // NaN/zero scalar/zero
+  const bool negative = w < 0.0;
+  double aw = negative ? -w : w;
+  int e2;
+  double frac = std::frexp(aw, &e2);            // aw = frac * 2^e2, frac in [0.5, 1)
+  uint64_t m = (uint64_t)std::ldexp(frac, 53);  // exact 53-bit integer
+  int e = e2 - 53;                              // aw = m * 2^e
+  if (e >= 0) {
+    // m >= 2^52 while A*den < 2^52: |num*w| >= A, fully clamped
+    return negative ? 0 : 2 * AE;
+  }
+  const int k = -e;  // k >= 1
+
+  // early clamp test (also guards the shift math below from overflow):
+  // |c| >= A  <=>  num*m >= A*den*2^k  <=>  (num*m) >> k >= A*den
+  const unsigned __int128 nm = (unsigned __int128)m * (uint64_t)num;  // <= 2^84
+  const unsigned __int128 ad = (unsigned __int128)A * (uint64_t)den;  // <= 2^51
+  if ((k < 128 ? (nm >> k) : (unsigned __int128)0) >= ad) {
+    return negative ? 0 : 2 * AE;
+  }
+  // from here |c| < A, so the result c*E < A*E <= 2^87 fits comfortably
+
+  // X = num * (m * E) as limbs x2:x1:x0 (m*E <= 2^120 fits u128)
+  const unsigned __int128 mE = (unsigned __int128)m * E;
+  const unsigned __int128 p0 = (unsigned __int128)(uint64_t)mE * (uint64_t)num;
+  const unsigned __int128 p1 = (unsigned __int128)(uint64_t)(mE >> 64) * (uint64_t)num;
+  uint64_t x0 = (uint64_t)p0;
+  const unsigned __int128 mid = (p0 >> 64) + p1;
+  uint64_t x1 = (uint64_t)mid;
+  uint64_t x2 = (uint64_t)(mid >> 64);
+  if (negative) {
+    // ceil(X/D) = floor((X-1)/D) + 1 for X >= 1 (X >= m*E*num >= 1 here)
+    if (x0 == 0) {
+      x0 = ~0ull;
+      if (x1 == 0) {
+        x1 = ~0ull;
+        x2 -= 1;
+      } else {
+        x1 -= 1;
+      }
+    } else {
+      x0 -= 1;
+    }
+  }
+
+  // floor(X / den): 192/31-bit long division (each quotient digit < 2^64
+  // because the running remainder stays < den)
+  const uint64_t d = (uint64_t)den;
+  unsigned __int128 r = x2;
+  const uint64_t q2 = (uint64_t)(r / d);
+  r %= d;
+  r = (r << 64) | x1;
+  const uint64_t q1 = (uint64_t)(r / d);
+  r %= d;
+  r = (r << 64) | x0;
+  const uint64_t q0 = (uint64_t)(r / d);
+
+  // Q >> k (Q = q2:q1:q0); the result fits u128 by the clamp guard above
+  unsigned __int128 shifted;
+  if (k >= 192) {
+    shifted = 0;
+  } else if (k >= 128) {
+    shifted = (unsigned __int128)q2 >> (k - 128);
+  } else if (k >= 64) {
+    shifted = (((unsigned __int128)q2 << 64) | q1) >> (k - 64);
+  } else {
+    shifted = ((((unsigned __int128)q2 << 64) | q1) << (64 - k)) | (q0 >> k);
+  }
+
+  if (negative) {
+    const unsigned __int128 ceil_val = shifted + 1;  // ceil(|c|*E)
+    return ceil_val >= AE ? 0 : AE - ceil_val;
+  }
+  return shifted >= AE ? 2 * AE : AE + shifted;
 }
 
 // --------------------------------------------------------------------------
@@ -517,8 +604,10 @@ struct Participant {
   // embedder interaction
   std::vector<float> model;
   std::vector<int64_t> model_i;  // integer data types (i32/i64 configs)
+  std::vector<double> model_d;   // f64 configs (exact 192-bit encode)
   bool model_set = false;
   bool model_i_set = false;
+  bool model_d_set = false;
   bool wants_model = false;
   bool made_progress = false;
   bool new_round_flag = false;
@@ -622,12 +711,19 @@ int step_update(Participant& p) {
   MaskCfg cfg_n, cfg_1;
   if (!lookup_cfg(p.params.cfg_vect, cfg_n) || !lookup_cfg(p.params.cfg_unit, cfg_1))
     return XN_ERR_CONFIG;
-  // native FSM coverage: f32 bounded (fused dd kernel) and i32/i64 any
-  // bound (exact __int128 encode); f64 and f32/Bmax use the interpreter FFI
+  // native FSM coverage: f32 bounded (fused dd kernel), i32/i64 any bound,
+  // f64 bounded (exact 192-bit encode); float Bmax uses the interpreter FFI
   const bool is_int = cfg_n.raw[1] == 2 || cfg_n.raw[1] == 3;
+  const bool is_f64 = cfg_n.raw[1] == 1;
   if (is_int) {
     if (!cfg_n.exact_ae || !cfg_1.exact_ae) return XN_ERR_CONFIG;
     if (!p.model_i_set || p.model_i.size() != p.params.model_length) {
+      p.wants_model = true;
+      return XN_OK;
+    }
+  } else if (is_f64) {
+    if (!cfg_n.exact_ae || !cfg_1.exact_ae) return XN_ERR_CONFIG;
+    if (!p.model_d_set || p.model_d.size() != p.params.model_length) {
       p.wants_model = true;
       return XN_OK;
     }
@@ -649,7 +745,24 @@ int step_update(Participant& p) {
 
   const uint64_t n = p.params.model_length;
   bytes vect(n * cfg_n.elem_nbytes);
-  if (is_int) {
+  if (is_f64) {
+    // exact f64 masking: 192-bit fixed-point encode per element
+    bytes draws(n * cfg_n.order_nbytes);
+    xn_sample_uniform(mask_seed, offset, n, cfg_n.order_le, cfg_n.order_nbytes, draws.data());
+    std::memset(vect.data(), 0, vect.size());
+    const unsigned long long a = (unsigned long long)cfg_n.a_int;
+    for (uint64_t i = 0; i < n; i++) {
+      unsigned __int128 shifted =
+          encode_f64_exact(p.model_d[i], p.scalar_num, p.scalar_den, a, cfg_n.e_int);
+      uint8_t* dst = vect.data() + i * cfg_n.elem_nbytes;
+      for (uint32_t j = 0; j < cfg_n.elem_nbytes && shifted > 0; j++) {
+        dst[j] = (uint8_t)(shifted & 0xff);
+        shifted >>= 8;
+      }
+      add_mod_le(dst, draws.data() + i * cfg_n.order_nbytes, cfg_n.order_le,
+                 cfg_n.order_nbytes, cfg_n.elem_nbytes);
+    }
+  } else if (is_int) {
     // exact integer masking: per element
     //   shifted = floor((clamp(num/den * w, -A, A) + A) * E)
     // num, den <= 2^31 (enforced at construction) keeps everything inside
@@ -692,7 +805,8 @@ int step_update(Participant& p) {
   }
 
   // masked unit: floor((min(s, A1) + A1) * E1) + rand1 mod unit order —
-  // exact __int128 for every natively-supported config (E1 = 10^10)
+  // exact __int128 for every natively-supported config (E1 <= 10^20;
+  // max intermediate (t%den)*E1 <= 2^31 * 2^67 = 2^98)
   bytes unit_elem(cfg_1.elem_nbytes, 0);
   {
     const __int128 num = p.scalar_num, den = p.scalar_den;
@@ -938,6 +1052,33 @@ XN_EXPORT int xaynet_ffi_participant_set_model_i64(void* handle, const int64_t* 
   return XN_OK;
 }
 
+// f64 mask configs take their model as double (exact 192-bit encode)
+XN_EXPORT int xaynet_ffi_participant_set_model_f64(void* handle, const double* data,
+                                                   uint64_t len) {
+  auto* p = static_cast<Participant*>(handle);
+  if (!p || !data) return XN_ERR_NULL;
+  p->model_d.assign(data, data + len);
+  p->model_d_set = true;
+  p->wants_model = false;
+  return XN_OK;
+}
+
+// test shim: the exact f64 encode, result as 16 little-endian bytes
+XN_EXPORT int xaynet_ffi_encode_f64(double w, int64_t num, int64_t den, uint64_t a,
+                                    uint32_t e_pow10, uint8_t out[16]) {
+  if (den <= 0 || num < 0 || den > 0x7FFFFFFF || num > 0x7FFFFFFF || e_pow10 > 20 ||
+      a > 1000000ull)  // documented precondition A <= 10^6 (bounded configs)
+    return XN_ERR_CONFIG;
+  unsigned __int128 e = 1;
+  for (uint32_t i = 0; i < e_pow10; i++) e *= 10;
+  unsigned __int128 v = encode_f64_exact(w, num, den, a, e);
+  for (int i = 0; i < 16; i++) {
+    out[i] = (uint8_t)(v & 0xff);
+    v >>= 8;
+  }
+  return XN_OK;
+}
+
 // fetch the latest global model (f64 little-endian over the transport);
 // returns element count (>=0) or an error code; *out borrowed until the
 // next call/destroy
@@ -972,7 +1113,8 @@ XN_EXPORT int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t*
   buf.push_back((uint8_t)p->phase);
   buf.push_back((uint8_t)p->after_send);
   buf.push_back((uint8_t)((p->have_params ? 1 : 0) | (p->have_ephm ? 2 : 0) |
-                          (p->model_set ? 4 : 0) | (p->model_i_set ? 8 : 0)));
+                          (p->model_set ? 4 : 0) | (p->model_i_set ? 8 : 0) |
+                          (p->model_d_set ? 16 : 0)));
   buf.insert(buf.end(), p->ephm_sk, p->ephm_sk + 32);
   buf.insert(buf.end(), p->sum_sig, p->sum_sig + 64);
   buf.insert(buf.end(), p->update_sig, p->update_sig + 64);
@@ -983,6 +1125,7 @@ XN_EXPORT int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t*
   for (auto& part : p->pending) put_lv(buf, part.data(), part.size());
   put_lv(buf, (const uint8_t*)p->model.data(), p->model.size() * 4);
   put_lv(buf, (const uint8_t*)p->model_i.data(), p->model_i.size() * 8);
+  put_lv(buf, (const uint8_t*)p->model_d.data(), p->model_d.size() * 8);
 
   *out = (uint8_t*)std::malloc(buf.size());
   if (!*out) return XN_ERR_NULL;
@@ -1027,6 +1170,7 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   p->have_ephm = fl & 2;
   p->model_set = fl & 4;
   p->model_i_set = fl & 8;
+  p->model_d_set = fl & 16;
   take(p->ephm_sk, 32);
   if (p->have_ephm) crypto_scalarmult_base(p->ephm_pk, p->ephm_sk);
   take(p->sum_sig, 64);
@@ -1074,8 +1218,8 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
   }
   p->model.resize(model_raw.size() / 4);
   std::memcpy(p->model.data(), model_raw.data(), model_raw.size());
-  // trailing int-model LV: absent in blobs saved by older library versions
-  // (treated as empty — format is append-only for forward compatibility)
+  // trailing int/f64-model LVs: absent in blobs saved by older library
+  // versions (treated as empty — format is append-only for compatibility)
   if (o < len) {
     bytes model_i_raw;
     if (!take_lv(model_i_raw) || model_i_raw.size() % 8 != 0) {
@@ -1086,6 +1230,17 @@ XN_EXPORT void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len
     std::memcpy(p->model_i.data(), model_i_raw.data(), model_i_raw.size());
   } else {
     p->model_i_set = false;
+  }
+  if (o < len) {
+    bytes model_d_raw;
+    if (!take_lv(model_d_raw) || model_d_raw.size() % 8 != 0) {
+      delete p;
+      return nullptr;
+    }
+    p->model_d.resize(model_d_raw.size() / 8);
+    std::memcpy(p->model_d.data(), model_d_raw.data(), model_d_raw.size());
+  } else {
+    p->model_d_set = false;
   }
   p->transport = transport;
   p->transport_user = user;

@@ -386,6 +386,80 @@ def test_native_round_f32_b2_config():
     _run_native_round(lib, cfg, 8, set_models, expect)
 
 
+def test_native_f64_encode_matches_fraction_oracle():
+    """The 192-bit exact f64 fixed-point encode equals the reference
+    semantics (Fraction oracle) across random weights, subnormals, clamp
+    boundaries and every bounded A/E combination."""
+    import random
+
+    lib = _load()
+    lib.xaynet_ffi_encode_f64.argtypes = [
+        ctypes.c_double,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.xaynet_ffi_encode_f64.restype = ctypes.c_int
+
+    def native_encode(w, num, den, a, e_pow10):
+        out = (ctypes.c_uint8 * 16)()
+        assert lib.xaynet_ffi_encode_f64(w, num, den, a, e_pow10, out) == 0
+        return int.from_bytes(bytes(out), "little")
+
+    def oracle(w, num, den, a, e):
+        s = Fraction(num, den)
+        c = max(Fraction(-a), min(Fraction(a), s * Fraction(w)))
+        t = c + a
+        return (t.numerator * e) // t.denominator
+
+    rng = random.Random(17)
+    for _ in range(2000):
+        a = rng.choice([1, 100, 10**4, 10**6])
+        e_pow = rng.choice([10, 20])
+        num = rng.choice([0, 1, 3, 2**31 - 1, rng.randrange(1, 2**31)])
+        den = rng.choice([1, 3, 1000, 2**31 - 1, rng.randrange(1, 2**31)])
+        kind = rng.random()
+        if kind < 0.4:
+            w = rng.uniform(-2 * a, 2 * a)
+        elif kind < 0.6:
+            w = rng.uniform(-1e-10, 1e-10)
+        elif kind < 0.8:
+            w = float(np.ldexp(rng.uniform(0.5, 1), rng.randrange(-1074, 1020))) * rng.choice([-1, 1])
+        else:
+            w = rng.choice([0.0, -0.0, float(a), -float(a), 5e-324, -5e-324, 1e308])
+        if not np.isfinite(w):
+            continue
+        assert native_encode(w, num, den, a, e_pow) == oracle(w, num, den, a, 10**e_pow), (
+            w.hex(), num, den, a, e_pow,
+        )
+
+
+def test_native_round_f64_config():
+    """Full round on f64/B2: the exact 192-bit masking path end-to-end."""
+    lib = _load()
+    lib.xaynet_ffi_participant_set_model_f64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_uint64,
+    ]
+    cfg = MaskConfig(GroupType.PRIME, DataType.F64, BoundType.B2, ModelType.M3)
+    vals = [12.25e-5, -40.125, 3.0625]
+
+    def set_models(lib, h, i):
+        arr = np.full(8, vals[i], dtype=np.float64)
+        assert lib.xaynet_ffi_participant_set_model_f64(
+            h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 8
+        ) == 0
+
+    def expect(got):
+        # f64 config: 1/exp_shift = 1e-20 tolerance, i.e. exact to f64 eps
+        assert np.allclose(got, np.mean(vals), rtol=1e-12, atol=1e-15), got[:3]
+
+    _run_native_round(lib, cfg, 8, set_models, expect)
+
+
 def test_native_participants_complete_full_round():
     """1 native summer + 3 native updaters complete a PET round against the
     Python coordinator; the global model equals the exact mean. The small
