@@ -223,3 +223,67 @@ def test_1m_device_mesh_aggregation():
     acc = np.zeros((n, n_limb), dtype=np.uint32)
     want = limb_ops.batch_mod_sum(stack.copy(), limb_ops.order_limbs_for(order))
     assert np.array_equal(got, want)
+
+
+def test_256mb_multipart_streaming_reassembly_bounded_rss():
+    """A >=256MB multipart payload round-trips through chunked reassembly
+    with peak RSS bounded: the streaming parse must never hold a second
+    contiguous copy of the payload (VERDICT round-1 item 8 'done' bar)."""
+    import resource
+
+    import numpy as np
+
+    from xaynet_tpu.core.mask.config import (
+        BoundType,
+        DataType,
+        GroupType,
+        MaskConfig,
+        ModelType,
+    )
+    from xaynet_tpu.core.mask.object import MaskUnit, MaskVect
+    from xaynet_tpu.core.message import Sum2, Tag
+    from xaynet_tpu.core.message.encoder import MessageBuilder
+    from xaynet_tpu.core.message.payloads import Chunk, parse_payload_stream
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    n = 45_000_000  # x6 bytes/elem = 270 MB of wire payload
+    rng = np.random.default_rng(1)
+    top = int(cfg.order >> 32)
+    limbs = rng.integers(0, 1 << 32, size=(n, 2), dtype=np.uint32)
+    limbs[:, 1] = rng.integers(0, top, size=n, dtype=np.uint32)
+    unit = limbs[0].copy()
+    obj_vect = MaskVect(cfg, limbs)
+    payload = Sum2(
+        sum_signature=b"\x0d" * 64,
+        model_mask=__import__("xaynet_tpu.core.mask.object", fromlist=["MaskObject"]).MaskObject(
+            obj_vect, MaskUnit(cfg, unit)
+        ),
+    )
+    raw = payload.to_bytes()
+    wire_mb = len(raw) / 1e6
+    assert wire_mb >= 256, wire_mb
+
+    budget = 1 << 20  # 1MB chunks
+    builder = MessageBuilder()
+    n_chunks = -(-len(raw) // budget)
+    for i in range(n_chunks):
+        builder.add(
+            Chunk(
+                id=i + 1,
+                message_id=3,
+                last=(i == n_chunks - 1),
+                data=raw[i * budget : (i + 1) * budget],
+            )
+        )
+    del raw, limbs, obj_vect, payload
+
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KB
+    parsed = parse_payload_stream(Tag.SUM2, builder.take_reader())
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert len(parsed.model_mask.vect) == n
+    # peak growth during the parse must stay well under 2x the wire size:
+    # the output limb tensor is ~360MB (8 B/elem); a concat-then-parse
+    # would add the full 270MB joined copy + a full-size padded buffer on
+    # top. Allow output + bounded transients only.
+    growth_mb = (rss_after - rss_before) / 1024
+    assert growth_mb < 1.6 * wire_mb + 50, (growth_mb, wire_mb)
