@@ -18,6 +18,7 @@
 // payload layouts, 4-byte mask configs, LV seed dicts — all matching
 // xaynet_tpu/core/message/* byte for byte (tested cross-language).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -193,6 +194,190 @@ bool is_eligible(const uint8_t sig[64], double threshold) {
 // mask config catalogue lookup
 // --------------------------------------------------------------------------
 
+// --------------------------------------------------------------------------
+// minimal unsigned bignum (little-endian u64 limbs) — only what the Bmax
+// float encode needs: x*u64, +, -, <<, >>, compare, divmod by u64
+// --------------------------------------------------------------------------
+
+using BigU = std::vector<uint64_t>;
+
+void bu_trim(BigU& a) {
+  while (a.size() > 1 && a.back() == 0) a.pop_back();
+}
+
+BigU bu_from_u128(unsigned __int128 v) {
+  BigU out{(uint64_t)v, (uint64_t)(v >> 64)};
+  bu_trim(out);
+  return out;
+}
+
+BigU bu_mul_u64(const BigU& a, uint64_t f) {
+  BigU out(a.size() + 1, 0);
+  unsigned __int128 carry = 0;
+  for (size_t i = 0; i < a.size(); i++) {
+    unsigned __int128 p = (unsigned __int128)a[i] * f + carry;
+    out[i] = (uint64_t)p;
+    carry = p >> 64;
+  }
+  out[a.size()] = (uint64_t)carry;
+  bu_trim(out);
+  return out;
+}
+
+BigU bu_add(const BigU& a, const BigU& b) {
+  BigU out(std::max(a.size(), b.size()) + 1, 0);
+  unsigned __int128 carry = 0;
+  for (size_t i = 0; i < out.size(); i++) {
+    unsigned __int128 s = carry;
+    if (i < a.size()) s += a[i];
+    if (i < b.size()) s += b[i];
+    out[i] = (uint64_t)s;
+    carry = s >> 64;
+  }
+  bu_trim(out);
+  return out;
+}
+
+// a - b, requires a >= b
+BigU bu_sub(const BigU& a, const BigU& b) {
+  BigU out(a.size(), 0);
+  unsigned __int128 borrow = 0;
+  for (size_t i = 0; i < a.size(); i++) {
+    unsigned __int128 d = (unsigned __int128)a[i] - (i < b.size() ? b[i] : 0) - borrow;
+    out[i] = (uint64_t)d;
+    borrow = (d >> 127) & 1;
+  }
+  bu_trim(out);
+  return out;
+}
+
+int bu_cmp(const BigU& a, const BigU& b) {
+  size_t n = std::max(a.size(), b.size());
+  for (size_t i = n; i-- > 0;) {
+    uint64_t av = i < a.size() ? a[i] : 0;
+    uint64_t bv = i < b.size() ? b[i] : 0;
+    if (av != bv) return av < bv ? -1 : 1;
+  }
+  return 0;
+}
+
+bool bu_is_zero(const BigU& a) { return a.size() == 1 && a[0] == 0; }
+
+BigU bu_shl(const BigU& a, unsigned bits) {
+  unsigned limbs = bits / 64, rem = bits % 64;
+  BigU out(a.size() + limbs + 1, 0);
+  for (size_t i = 0; i < a.size(); i++) {
+    out[i + limbs] |= rem ? (a[i] << rem) : a[i];
+    if (rem) out[i + limbs + 1] |= a[i] >> (64 - rem);
+  }
+  bu_trim(out);
+  return out;
+}
+
+BigU bu_shr(const BigU& a, unsigned bits) {  // floor shift
+  unsigned limbs = bits / 64, rem = bits % 64;
+  if (limbs >= a.size()) return BigU{0};
+  BigU out(a.size() - limbs, 0);
+  for (size_t i = 0; i < out.size(); i++) {
+    out[i] = rem ? (a[i + limbs] >> rem) : a[i + limbs];
+    if (rem && i + limbs + 1 < a.size()) out[i] |= a[i + limbs + 1] << (64 - rem);
+  }
+  bu_trim(out);
+  return out;
+}
+
+// floor(a / d) for u64 d (d < 2^63 here)
+BigU bu_div_u64(const BigU& a, uint64_t d) {
+  BigU out(a.size(), 0);
+  unsigned __int128 r = 0;
+  for (size_t i = a.size(); i-- > 0;) {
+    r = (r << 64) | a[i];
+    out[i] = (uint64_t)(r / d);
+    r %= d;
+  }
+  bu_trim(out);
+  return out;
+}
+
+void bu_write_le(const BigU& a, uint8_t* out, uint32_t nbytes) {
+  std::memset(out, 0, nbytes);
+  for (uint32_t i = 0; i < nbytes; i++) {
+    size_t limb = i / 8;
+    if (limb >= a.size()) break;
+    out[i] = (uint8_t)(a[limb] >> (8 * (i % 8)));
+  }
+}
+
+BigU bu_pow10(unsigned k) {
+  BigU out{1};
+  for (unsigned i = 0; i < k; i++) out = bu_mul_u64(out, 10);
+  return out;
+}
+
+// A/E/A*E for the Bmax float families, computed once per process
+// (A = f32max = 2^104*(2^24-1) with E = 10^45, or A = f64max =
+// 2^971*(2^53-1) with E = 10^324)
+struct BmaxConsts {
+  BigU a, e, ae;
+};
+
+const BmaxConsts& bmax_consts(bool is_f64) {
+  static const BmaxConsts f32c{
+      bu_shl(bu_from_u128((1u << 24) - 1), 104),
+      bu_pow10(45),
+      bu_shl(bu_mul_u64(bu_pow10(45), (1u << 24) - 1), 104),
+  };
+  static const BmaxConsts f64c{
+      bu_shl(bu_from_u128((1ull << 53) - 1), 971),
+      bu_pow10(324),
+      bu_shl(bu_mul_u64(bu_pow10(324), (1ull << 53) - 1), 971),
+  };
+  return is_f64 ? f64c : f32c;
+}
+
+// Exact Bmax float encode: shifted = floor((clamp(num/den * w, -A, A) + A)*E)
+// over arbitrary-width A/E (f32max*10^45 or f64max*10^324). All arithmetic
+// exact. Non-finite weights clamp to the bound (the Python stack rejects
+// them before masking; an embedded device gets the defensive clamp).
+BigU encode_bmax_exact(double w, int64_t num, int64_t den, const BigU& A, const BigU& E,
+                       const BigU& AE) {
+  if (!(w == w) || num == 0 || w == 0.0) return AE;
+  const bool negative = w < 0.0;
+  if (std::isinf(w)) return negative ? BigU{0} : bu_add(AE, AE);
+  double aw = negative ? -w : w;
+  int e2;
+  double frac = std::frexp(aw, &e2);
+  uint64_t m = (uint64_t)std::ldexp(frac, 53);  // aw = m * 2^e, exact
+  int e = e2 - 53;
+
+  // clamp test: num*m*2^e >= A*den ?
+  const unsigned __int128 nm128 = (unsigned __int128)m * (uint64_t)num;
+  BigU lhs = bu_from_u128(nm128);
+  if (e > 0) lhs = bu_shl(lhs, (unsigned)e);
+  BigU rhs = bu_mul_u64(A, (uint64_t)den);
+  if (e < 0) rhs = bu_shl(rhs, (unsigned)-e);
+  if (bu_cmp(lhs, rhs) >= 0) {
+    return negative ? BigU{0} : bu_add(AE, AE);  // clamped at -A / +A
+  }
+
+  // X = E * (num*m) [* 2^e when e > 0]
+  BigU X = bu_mul_u64(E, (uint64_t)nm128);
+  uint64_t nm_hi = (uint64_t)(nm128 >> 64);
+  if (nm_hi) X = bu_add(X, bu_shl(bu_mul_u64(E, nm_hi), 64));
+  if (e > 0) X = bu_shl(X, (unsigned)e);
+  if (negative && !bu_is_zero(X)) X = bu_sub(X, BigU{1});  // ceil = floor(X-1)+1
+  BigU q = bu_div_u64(X, (uint64_t)den);
+  if (e < 0) q = bu_shr(q, (unsigned)-e);
+
+  if (negative) {
+    q = bu_add(q, BigU{1});               // ceil(|c|*E)
+    if (bu_cmp(q, AE) >= 0) return BigU{0};
+    return bu_sub(AE, q);
+  }
+  return bu_add(AE, q);
+}
+
+
 struct MaskCfg {
   uint8_t raw[4];  // group, data, bound, model (wire bytes)
   const uint8_t* order_le = nullptr;
@@ -206,6 +391,9 @@ struct MaskCfg {
   bool exact_ae = false;
   unsigned __int128 a_int = 0;
   unsigned __int128 e_int = 0;
+  // Bmax float configs: arbitrary-width A/E/A*E (f32max*10^45, f64max*10^324)
+  bool bmax_float = false;
+  BigU big_a, big_e, big_ae;
 };
 
 bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
@@ -237,10 +425,16 @@ bool lookup_cfg(const uint8_t raw[4], MaskCfg& cfg) {
         cfg.a_int = (unsigned __int128)1 << 63;
         cfg.exact_ae = true;
       }
-      // E = 10^20 for f64, 10^10 otherwise; Bmax float configs exceed the
-      // exact integer budget (interpreter FFI covers those)
-      if (raw[1] == 1 && bmax) cfg.exact_ae = false;  // f64 Bmax
-      if (raw[1] == 0 && bmax) cfg.exact_ae = false;  // f32 Bmax
+      // E = 10^20 for f64, 10^10 otherwise; Bmax FLOAT configs use the
+      // arbitrary-width bignum path (A = f32max/f64max, E = 10^45/10^324)
+      if (bmax && (raw[1] == 0 || raw[1] == 1)) {  // float Bmax families
+        cfg.bmax_float = true;
+        const BmaxConsts& c = bmax_consts(raw[1] == 1);
+        cfg.big_a = c.a;
+        cfg.big_e = c.e;
+        cfg.big_ae = c.ae;
+        cfg.exact_ae = false;
+      }
       cfg.e_int = raw[1] == 1
                       ? (unsigned __int128)10000000000ull * 10000000000ull
                       : (unsigned __int128)10000000000ull;
@@ -711,19 +905,25 @@ int step_update(Participant& p) {
   MaskCfg cfg_n, cfg_1;
   if (!lookup_cfg(p.params.cfg_vect, cfg_n) || !lookup_cfg(p.params.cfg_unit, cfg_1))
     return XN_ERR_CONFIG;
-  // native FSM coverage: f32 bounded (fused dd kernel), i32/i64 any bound,
-  // f64 bounded (exact 192-bit encode); float Bmax uses the interpreter FFI
+  // native FSM coverage is the full catalogue: f32 bounded (fused dd
+  // kernel), i32/i64 any bound (__int128), f64 bounded (192-bit), and
+  // float Bmax (arbitrary-width bignum)
   const bool is_int = cfg_n.raw[1] == 2 || cfg_n.raw[1] == 3;
-  const bool is_f64 = cfg_n.raw[1] == 1;
+  const bool is_f64 = cfg_n.raw[1] == 1 && !cfg_n.bmax_float;
+  const bool is_bmax_float = cfg_n.bmax_float;
   if (is_int) {
     if (!cfg_n.exact_ae || !cfg_1.exact_ae) return XN_ERR_CONFIG;
     if (!p.model_i_set || p.model_i.size() != p.params.model_length) {
       p.wants_model = true;
       return XN_OK;
     }
-  } else if (is_f64) {
-    if (!cfg_n.exact_ae || !cfg_1.exact_ae) return XN_ERR_CONFIG;
+  } else if (is_f64 || (is_bmax_float && cfg_n.raw[1] == 1)) {
     if (!p.model_d_set || p.model_d.size() != p.params.model_length) {
+      p.wants_model = true;
+      return XN_OK;
+    }
+  } else if (is_bmax_float) {  // f32 Bmax: model is float32
+    if (!p.model_set || p.model.size() != p.params.model_length) {
       p.wants_model = true;
       return XN_OK;
     }
@@ -745,7 +945,21 @@ int step_update(Participant& p) {
 
   const uint64_t n = p.params.model_length;
   bytes vect(n * cfg_n.elem_nbytes);
-  if (is_f64) {
+  if (is_bmax_float) {
+    // Bmax float masking: arbitrary-width exact encode per element
+    bytes draws(n * cfg_n.order_nbytes);
+    xn_sample_uniform(mask_seed, offset, n, cfg_n.order_le, cfg_n.order_nbytes, draws.data());
+    std::memset(vect.data(), 0, vect.size());
+    for (uint64_t i = 0; i < n; i++) {
+      double w = cfg_n.raw[1] == 1 ? p.model_d[i] : (double)p.model[i];
+      BigU shifted = encode_bmax_exact(w, p.scalar_num, p.scalar_den, cfg_n.big_a,
+                                       cfg_n.big_e, cfg_n.big_ae);
+      uint8_t* dst = vect.data() + i * cfg_n.elem_nbytes;
+      bu_write_le(shifted, dst, cfg_n.elem_nbytes);
+      add_mod_le(dst, draws.data() + i * cfg_n.order_nbytes, cfg_n.order_le,
+                 cfg_n.order_nbytes, cfg_n.elem_nbytes);
+    }
+  } else if (is_f64) {
     // exact f64 masking: 192-bit fixed-point encode per element
     bytes draws(n * cfg_n.order_nbytes);
     xn_sample_uniform(mask_seed, offset, n, cfg_n.order_le, cfg_n.order_nbytes, draws.data());
@@ -805,10 +1019,18 @@ int step_update(Participant& p) {
   }
 
   // masked unit: floor((min(s, A1) + A1) * E1) + rand1 mod unit order —
-  // exact __int128 for every natively-supported config (E1 <= 10^20;
-  // max intermediate (t%den)*E1 <= 2^31 * 2^67 = 2^98)
+  // exact __int128 for bounded configs (E1 <= 10^20; max intermediate
+  // (t%den)*E1 <= 2^31 * 2^67 = 2^98); bignum for Bmax float units, where
+  // A1 is astronomically larger than any scalar so min(s, A1) = s
   bytes unit_elem(cfg_1.elem_nbytes, 0);
-  {
+  if (cfg_1.bmax_float) {
+    BigU q = bu_div_u64(bu_mul_u64(cfg_1.big_e, (uint64_t)p.scalar_num),
+                        (uint64_t)p.scalar_den);
+    BigU s1 = bu_add(cfg_1.big_ae, q);
+    bu_write_le(s1, unit_elem.data(), cfg_1.elem_nbytes);
+    add_mod_le(unit_elem.data(), rand1.data(), cfg_1.order_le, cfg_1.order_nbytes,
+               cfg_1.elem_nbytes);
+  } else {
     const __int128 num = p.scalar_num, den = p.scalar_den;
     const __int128 a1_den = (__int128)cfg_1.a_int * den;
     const __int128 e1 = (__int128)cfg_1.e_int;
@@ -1061,6 +1283,21 @@ XN_EXPORT int xaynet_ffi_participant_set_model_f64(void* handle, const double* d
   p->model_d_set = true;
   p->wants_model = false;
   return XN_OK;
+}
+
+// test shim: the exact Bmax float encode using the SAME cached constants
+// as the production masking path; fills all out_cap bytes (zero-padded
+// little-endian) and returns out_cap, or <0 when the value doesn't fit
+XN_EXPORT int64_t xaynet_ffi_encode_bmax(double w, int64_t num, int64_t den, int is_f64,
+                                         uint8_t* out, uint64_t out_cap) {
+  if (den <= 0 || num < 0 || den > 0x7FFFFFFF || num > 0x7FFFFFFF) return XN_ERR_CONFIG;
+  const BmaxConsts& c = bmax_consts(is_f64 != 0);
+  BigU v = encode_bmax_exact(w, num, den, c.a, c.e, c.ae);
+  uint64_t need = v.size() * 8;  // trim leading zero bytes for the exact size
+  while (need > 0 && ((v[(need - 1) / 8] >> (8 * ((need - 1) % 8))) & 0xff) == 0) need--;
+  if (need > out_cap) return XN_ERR_CONFIG;
+  bu_write_le(v, out, (uint32_t)out_cap);
+  return (int64_t)out_cap;
 }
 
 // test shim: the exact f64 encode, result as 16 little-endian bytes

@@ -436,6 +436,100 @@ def test_native_f64_encode_matches_fraction_oracle():
         )
 
 
+def test_native_bmax_encode_matches_fraction_oracle():
+    """The arbitrary-width Bmax float encode (A = f32max/f64max,
+    E = 10^45/10^324) equals the Fraction oracle."""
+    import random
+
+    lib = _load()
+    lib.xaynet_ffi_encode_bmax.argtypes = [
+        ctypes.c_double,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_uint64,
+    ]
+    lib.xaynet_ffi_encode_bmax.restype = ctypes.c_int64
+
+    F32_MAX, F64_MAX = 2**128 - 2**104, 2**1024 - 2**971
+
+    def native_encode(w, num, den, is_f64):
+        cap = 600
+        out = (ctypes.c_uint8 * cap)()
+        assert lib.xaynet_ffi_encode_bmax(w, num, den, is_f64, out, cap) == cap
+        return int.from_bytes(bytes(out), "little")
+
+    def oracle(w, num, den, a, e):
+        s = Fraction(num, den)
+        c = max(Fraction(-a), min(Fraction(a), s * Fraction(w)))
+        t = c + a
+        return (t.numerator * e) // t.denominator
+
+    rng = random.Random(5)
+    for _ in range(600):
+        is_f64 = rng.random() < 0.5
+        a, e = (F64_MAX, 10**324) if is_f64 else (F32_MAX, 10**45)
+        num = rng.choice([0, 1, 3, 2**31 - 1, rng.randrange(1, 2**31)])
+        den = rng.choice([1, 3, 1000, rng.randrange(1, 2**31)])
+        kind = rng.random()
+        if kind < 0.5:
+            w = float(np.ldexp(rng.uniform(0.5, 1), rng.randrange(-1074, 1023))) * rng.choice([-1, 1])
+        elif kind < 0.75:
+            w = rng.uniform(-1e6, 1e6)
+        else:
+            w = rng.choice([0.0, 1e308, -1e308, 5e-324, -5e-324, 3.4028234e38])
+        if not np.isfinite(w):
+            continue
+        assert native_encode(w, num, den, is_f64) == oracle(w, num, den, a, e), (
+            w.hex(), num, den, is_f64,
+        )
+
+
+def test_native_round_f32_bmax_config():
+    """Full round on f32/Bmax: the bignum masking path end-to-end — with
+    this, the native FSM covers the whole catalogue."""
+    lib = _load()
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.BMAX, ModelType.M3)
+    vals = [1.5e10, -2.25e12, 7.75e8]
+
+    def set_models(lib, h, i):
+        arr = np.full(6, vals[i], dtype=np.float32)
+        assert lib.xaynet_ffi_participant_set_model(
+            h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6
+        ) == 0
+
+    def expect(got):
+        want = np.mean(np.asarray(vals, dtype=np.float32).astype(np.float64))
+        assert np.allclose(got, want, rtol=1e-10), (got[:3], want)
+
+    _run_native_round(lib, cfg, 6, set_models, expect)
+
+
+def test_native_round_f64_bmax_config():
+    """Full round on f64/Bmax: ~264-byte elements through chunked messaging
+    and the bignum unit path at full f64 widths."""
+    lib = _load()
+    lib.xaynet_ffi_participant_set_model_f64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_uint64,
+    ]
+    cfg = MaskConfig(GroupType.PRIME, DataType.F64, BoundType.BMAX, ModelType.M3)
+    vals = [3.5e200, -1.25e190, 6.0e150]
+
+    def set_models(lib, h, i):
+        arr = np.full(4, vals[i], dtype=np.float64)
+        assert lib.xaynet_ffi_participant_set_model_f64(
+            h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 4
+        ) == 0
+
+    def expect(got):
+        assert np.allclose(got, np.mean(vals), rtol=1e-12), got[:3]
+
+    _run_native_round(lib, cfg, 4, set_models, expect, max_message_size=4096)
+
+
 def test_native_round_f64_config():
     """Full round on f64/B2: the exact 192-bit masking path end-to-end."""
     lib = _load()
