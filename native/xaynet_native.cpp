@@ -373,12 +373,13 @@ inline void two_prod(double x, double y, double& p, double& err) {
 // including i64/f64/Bmax where C = nb_models * add_shift * exp_shift can be
 // hundreds of bits): out[i] = (value_i - C) * inv. The subtraction is exact
 // multi-limb integer arithmetic; the difference (which has no cancellation
-// left) is then rounded to ~96 bits and multiplied by the double-double
-// *normalized mantissa* (inv_hi, inv_lo) of the reciprocal of
-// exp_shift * scalar_sum, whose binary exponent `inv_exp` is applied by one
-// final ldexp — so reciprocals far outside float64 range (BMAX exp_shifts)
-// stay exact. Total relative error ~2^-95, far below the protocol tolerance
-// of 1/exp_shift (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
+// left) is then truncated to its top three 32-bit limbs and multiplied by
+// the double-double *normalized mantissa* (inv_hi, inv_lo) of the
+// reciprocal of exp_shift * scalar_sum, whose binary exponent `inv_exp` is
+// applied by one final ldexp — so reciprocals far outside float64 range
+// (BMAX exp_shifts) stay exact. Worst-case relative error ~2^-64 (small
+// leading limb), far below the 1/exp_shift protocol tolerance and the f64
+// output rounding (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
 // Returns nonzero on unsupported widths.
 XN_EXPORT int xn_decode_exact(const uint32_t* limbs, uint64_t n, uint32_t n_limbs,
                               const uint32_t* c_limbs, uint32_t c_nlimbs,

@@ -699,16 +699,19 @@ bytes build_message(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t 
 }
 
 // split a payload into signed chunk messages when oversized; every part is
-// sealed for the coordinator (the send queue holds ready-to-POST bodies)
-void encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t coord_pk[32],
+// sealed for the coordinator (the send queue holds ready-to-POST bodies).
+// Returns false (queueing nothing) if sealing fails — e.g. an invalid
+// coordinator public key — so callers surface an error instead of
+// advancing as if the message was delivered.
+bool encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t coord_pk[32],
                      uint8_t tag, const bytes& payload, uint32_t max_message_size,
                      std::deque<bytes>& queue) {
   if (max_message_size == 0 || kHeader + payload.size() <= max_message_size) {
     bytes msg = build_message(sk64, pk, coord_pk, tag, false, payload);
     bytes sealed;
-    seal(msg.data(), msg.size(), coord_pk, sealed);
+    if (!seal(msg.data(), msg.size(), coord_pk, sealed)) return false;
     queue.push_back(std::move(sealed));
-    return;
+    return true;
   }
   size_t budget = max_message_size > kHeader + 8 + 1 ? max_message_size - kHeader - 8 : 1;
   uint16_t message_id;
@@ -724,9 +727,13 @@ void encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t
     std::memcpy(chunk.data() + 8, payload.data() + lo, hi - lo);
     bytes msg = build_message(sk64, pk, coord_pk, tag, true, chunk);
     bytes sealed;
-    seal(msg.data(), msg.size(), coord_pk, sealed);
+    if (!seal(msg.data(), msg.size(), coord_pk, sealed)) {
+      queue.clear();  // all-or-nothing: no partial multipart queue
+      return false;
+    }
     queue.push_back(std::move(sealed));
   }
+  return true;
 }
 
 }  // namespace
@@ -886,8 +893,9 @@ int step_sum(Participant& p) {
   bytes payload(64 + 32);
   std::memcpy(payload.data(), p.sum_sig, 64);
   std::memcpy(payload.data() + 64, p.ephm_pk, 32);
-  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum, payload,
-                  p.max_message_size, p.pending);
+  if (!encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum, payload,
+                       p.max_message_size, p.pending))
+    return XN_ERR_CRYPTO;
   p.after_send = Phase::Sum2;
   return drain(p);
 }
@@ -1069,8 +1077,9 @@ int step_update(Participant& p) {
     payload.insert(payload.end(), sealed.begin(), sealed.end());
   }
 
-  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagUpdate, payload,
-                  p.max_message_size, p.pending);
+  if (!encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagUpdate, payload,
+                       p.max_message_size, p.pending))
+    return XN_ERR_CRYPTO;
   p.after_send = Phase::Awaiting;
   p.made_progress = true;
   return drain(p);
@@ -1135,8 +1144,9 @@ int step_sum2(Participant& p) {
   payload.insert(payload.end(), cfg_1.raw, cfg_1.raw + 4);
   payload.insert(payload.end(), unit_acc.begin(), unit_acc.end());
 
-  encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum2, payload,
-                  p.max_message_size, p.pending);
+  if (!encode_and_seal(p.sign_sk64, p.sign_pk, p.params.coord_pk.data(), kTagSum2, payload,
+                       p.max_message_size, p.pending))
+    return XN_ERR_CRYPTO;
   p.after_send = Phase::Awaiting;
   p.made_progress = true;
   return drain(p);

@@ -107,6 +107,9 @@ class FakeS3:
             if method == "GET":
                 return (200, objs[key]) if key in objs else (404, b"")
             if method == "PUT":
+                # honor the atomic conditional create (S3 If-None-Match: *)
+                if headers.get("if-none-match") == "*" and key in objs:
+                    return 412, b"<Error><Code>PreconditionFailed</Code></Error>"
                 objs[key] = body
                 return 200, b""
         return 400, b"bad request"
@@ -180,5 +183,30 @@ def test_s3_unreachable_raises_typed_error():
         store = _store(port)
         with pytest.raises(StorageError, match="unreachable"):
             await store.is_ready()
+
+    asyncio.run(run())
+
+
+def test_s3_conditional_put_closes_head_put_race():
+    """Even if the HEAD pre-check is bypassed (two concurrent writers), the
+    conditional PUT refuses the second write atomically."""
+
+    async def run():
+        fake = FakeS3()
+        port = await fake.start()
+        store = _store(port)
+        try:
+            await store.create_bucket()
+            seed = b"\x11" * 32
+            await store.set_global_model(3, seed, b"first")
+            # simulate the racing writer: skip HEAD, PUT directly
+            model_id = store.create_global_model_id(3, seed)
+            resp = await store._request(
+                "PUT", f"/{store.bucket}/{model_id}", b"second", {"if-none-match": "*"}
+            )
+            assert resp.status == 412
+            assert await store.global_model(model_id) == b"first"
+        finally:
+            await fake.stop()
 
     asyncio.run(run())

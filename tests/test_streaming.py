@@ -228,14 +228,14 @@ def test_chunk_level_send_retry():
         for _ in range(limit):
             out = await machine.transition()
             outcomes.append(out)
-            if machine.phase is PhaseKind.AWAITING and not machine._pending_sends:
+            if machine.phase is PhaseKind.AWAITING and machine._pending is None:
                 break
         return outcomes
 
     outcomes = asyncio.run(_drive_until_awaiting())
     assert TransitionOutcome.PENDING in outcomes  # the dropped part paused us
     assert machine.phase is PhaseKind.AWAITING
-    assert not machine._pending_sends
+    assert machine._pending is None
     # every part was delivered exactly once, in order: reassembling them
     # yields a complete message (delivered = sent list after the sum parts)
     delivered = client.sent[sum_parts:]
@@ -256,3 +256,72 @@ def _async(value):
         return {b"\x01" * 32: value} if value is not None else None
 
     return _inner()
+
+
+def test_pending_send_survives_save_restore():
+    """An in-flight multipart send serializes as ONE payload copy + cursor
+    and resumes from the exact part it stopped at."""
+    from xaynet_tpu.core.common import RoundParameters, RoundSeed
+    from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair
+    from xaynet_tpu.sdk.state_machine import PetSettings, PhaseKind, StateMachine
+    from xaynet_tpu.sdk.traits import ModelStore
+
+    class _NoModel(ModelStore):
+        async def load_model(self):
+            return None
+
+    class _FailingClient(_FlakyClient):
+        pass
+
+    coord = EncryptKeyPair.generate()
+    params = RoundParameters(
+        pk=coord.public.as_bytes(),
+        sum=1.0,
+        update=0.0,
+        seed=RoundSeed(b"\x06" * 32),
+        mask_config=CFG.pair(),
+        model_length=256,
+    )
+    keys = SigningKeyPair.generate()
+    machine = StateMachine(
+        PetSettings(keys=keys, max_message_size=400),
+        _FailingClient(params, fail_at=10**9),
+        _NoModel(),
+    )
+    client = machine.client
+
+    asyncio.run(_drive_n(machine, 2))  # -> SUM2
+    # produce a multipart sum2 message and fail on its third part
+    seed = MaskSeed(b"\x2b" * 32)
+    enc = seed.encrypt(machine.ephm_keys.public)
+    client.get_seeds = lambda pk: _async(enc)
+    client.fail_at = client.attempts + 3
+    asyncio.run(_drive_n(machine, 1))
+    assert machine._pending is not None
+    delivered_before = len(client.sent)
+    next_before = machine._pending.next_index
+    assert next_before == 2  # two parts through, third failed
+
+    state = machine.save()
+    assert len(state) < 64 * 1024  # cursor + one payload copy, not part list
+    restored = StateMachine.restore(state, client, _NoModel())
+    assert restored._pending is not None
+    assert restored._pending.next_index == next_before
+    client.fail_at = 10**9  # network healthy again
+    asyncio.run(_drive_n(restored, 2))
+    assert restored._pending is None
+    assert restored.phase is PhaseKind.AWAITING
+
+    # the full message reassembles from pre-save + post-restore parts
+    opened = [coord.secret.decrypt(p) for p in client.sent[1:]]  # skip the sum msg
+    msgs = [Message.from_bytes(r, verify=True) for r in opened]
+    builder = MessageBuilder()
+    complete = False
+    for m in msgs:
+        complete = builder.add(m.payload)
+    assert complete
+
+
+async def _drive_n(machine, n):
+    for _ in range(n):
+        await machine.transition()

@@ -149,7 +149,21 @@ def main() -> None:
             stderr=subprocess.DEVNULL,
         )
         try:
-            time.sleep(4)
+            # wait until the coordinator actually listens (loaded CI hosts
+            # can take longer than any fixed sleep)
+            import socket
+
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    with socket.create_connection(("127.0.0.1", args.port), timeout=1):
+                        break
+                except OSError:
+                    if proc.poll() is not None:
+                        raise RuntimeError("coordinator exited during startup")
+                    time.sleep(0.25)
+            else:
+                raise RuntimeError("coordinator did not start listening in 60s")
             rss_start = _rss_kb(proc.pid)
             result = run_soak_sync(args.port, args.rounds, args.model_len)
             rss_end = _rss_kb(proc.pid)

@@ -163,9 +163,11 @@ def decode_vect_any(
     Replaces the per-element ``Fraction`` loop for i32/i64/f64/Bmax configs:
     the cancellation-prone step ``v - nb_models * A * E`` is done in exact
     multi-limb integer arithmetic (native C++ when available, vectorized
-    numpy otherwise); the cancellation-free difference is then decoded in
-    double-double. Relative error ~2^-95 ≪ the 1/exp_shift protocol
-    tolerance (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
+    numpy otherwise); the cancellation-free difference is then decoded from
+    its top three 32-bit limbs in double-double. Worst-case relative error
+    ~2^-64 (when the leading limb is small), far below both the 1/exp_shift
+    protocol tolerance and the float64 output rounding that follows
+    (reference: rust/xaynet-core/src/mask/masking.rs:190-231).
     """
     n, n_limb = limbs.shape
     c_int = nb_models * int(config.add_shift) * config.exp_shift

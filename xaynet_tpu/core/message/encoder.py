@@ -35,47 +35,75 @@ def max_payload_size(max_message_size: int) -> int:
     return max_message_size - HEADER_LENGTH
 
 
+# the wire chunk id is u16 with id 0 reserved (reference chunk layout)
+MAX_CHUNKS = 0xFFFF
+
+
 class MessageEncoder:
-    """Encodes (and signs) a message, chunking it when oversized."""
+    """Encodes (and signs) a message, chunking it when oversized.
+
+    Parts are produced ON DEMAND (``part(i)``): a paused/retried multipart
+    send holds one payload copy plus the index, never the full list of
+    signed+sealed parts.
+    """
 
     def __init__(
         self,
         message: Message,
         secret_signing_key: bytes,
         max_message_size: int | None = DEFAULT_MAX_MESSAGE_SIZE,
+        message_id: int | None = None,  # pin when restoring an in-flight send
     ):
         self.message = message
         self.secret_signing_key = secret_signing_key
         self.max_message_size = max_message_size
+        self._payload_bytes = message.payload.to_bytes()
+        if (
+            max_message_size is None
+            or HEADER_LENGTH + len(self._payload_bytes) <= max_message_size
+        ):
+            self._budget = None
+            self.n_parts = 1
+        else:
+            self._budget = max(max_message_size - HEADER_LENGTH - CHUNK_HEADER_LENGTH, 1)
+            self.n_parts = -(-len(self._payload_bytes) // self._budget)
+            if self.n_parts > MAX_CHUNKS:
+                # the u16 chunk id cannot address more parts; wrapping would
+                # corrupt reassembly silently — refuse loudly instead
+                raise ValueError(
+                    f"payload needs {self.n_parts} chunks but the wire chunk id "
+                    f"is u16 (max {MAX_CHUNKS}); raise max_message_size "
+                    f"(>= {HEADER_LENGTH + CHUNK_HEADER_LENGTH + -(-len(self._payload_bytes) // MAX_CHUNKS)})"
+                )
+            self.message_id = (
+                message_id if message_id is not None else struct.unpack(">H", os.urandom(2))[0]
+            )
+
+    def part(self, i: int) -> bytes:
+        """The ``i``-th signed wire part (0-based)."""
+        if not 0 <= i < self.n_parts:
+            raise IndexError(i)
+        if self._budget is None:
+            return self.message.to_bytes(self.secret_signing_key)
+        chunk = Chunk(
+            id=i + 1,
+            message_id=self.message_id,
+            last=(i == self.n_parts - 1),
+            data=self._payload_bytes[i * self._budget : (i + 1) * self._budget],
+            tag=self.message.tag,
+        )
+        part = Message(
+            participant_pk=self.message.participant_pk,
+            coordinator_pk=self.message.coordinator_pk,
+            payload=chunk,
+            tag=self.message.tag,
+            is_multipart=True,
+        )
+        return part.to_bytes(self.secret_signing_key)
 
     def __iter__(self) -> Iterator[bytes]:
-        payload_bytes = self.message.payload.to_bytes()
-        if (
-            self.max_message_size is None
-            or HEADER_LENGTH + len(payload_bytes) <= self.max_message_size
-        ):
-            yield self.message.to_bytes(self.secret_signing_key)
-            return
-
-        budget = max(self.max_message_size - HEADER_LENGTH - CHUNK_HEADER_LENGTH, 1)
-        message_id = struct.unpack(">H", os.urandom(2))[0]
-        n_chunks = -(-len(payload_bytes) // budget)
-        for i in range(n_chunks):
-            chunk = Chunk(
-                id=i + 1,
-                message_id=message_id,
-                last=(i == n_chunks - 1),
-                data=payload_bytes[i * budget : (i + 1) * budget],
-                tag=self.message.tag,
-            )
-            part = Message(
-                participant_pk=self.message.participant_pk,
-                coordinator_pk=self.message.coordinator_pk,
-                payload=chunk,
-                tag=self.message.tag,
-                is_multipart=True,
-            )
-            yield part.to_bytes(self.secret_signing_key)
+        for i in range(self.n_parts):
+            yield self.part(i)
 
 
 class ChunkReader:

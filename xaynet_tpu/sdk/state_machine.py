@@ -76,6 +76,31 @@ class PetSettings:
             )
 
 
+@dataclass
+class _RawPayload:
+    """Pre-serialized payload bytes (restoring an in-flight send)."""
+
+    raw: bytes
+
+    def to_bytes(self) -> bytes:
+        return self.raw
+
+    def serialized_length(self) -> int:
+        return len(self.raw)
+
+
+class _PendingSend:
+    """An in-flight multipart send: encoder + next undelivered part."""
+
+    def __init__(self, encoder: MessageEncoder, coordinator_pk: bytes, next_index: int = 0):
+        self.encoder = encoder
+        self.coordinator_pk = PublicEncryptKey(coordinator_pk)
+        self.next_index = next_index
+
+    def sealed_part(self, i: int) -> bytes:
+        return self.coordinator_pk.encrypt(self.encoder.part(i))
+
+
 class StateMachine:
     """Poll-driven participant FSM."""
 
@@ -100,11 +125,12 @@ class StateMachine:
         self.sum_signature: Optional[bytes] = None
         self.update_signature: Optional[bytes] = None
         self.ephm_keys: Optional[EncryptKeyPair] = None
-        # chunk-level send retry (reference: sending.rs:96-113): encrypted
-        # parts not yet accepted by the coordinator; on a send failure only
-        # the failed part (and its successors) are retried on later ticks,
-        # never the parts that already went through
-        self._pending_sends: list[bytes] = []
+        # chunk-level send retry (reference: sending.rs:96-113): the
+        # in-flight multipart send is ONE payload copy plus a part index —
+        # each part is signed+sealed lazily when its turn comes, so a
+        # paused 270MB send doesn't hold a second materialized part list.
+        # Delivered parts are never re-sent.
+        self._pending: Optional[_PendingSend] = None
         self._after_send_phase: Optional[PhaseKind] = None
 
     # --- driving ----------------------------------------------------------
@@ -122,7 +148,7 @@ class StateMachine:
             self.phase = PhaseKind.NEW_ROUND
             self.notify.new_round()
 
-        if self._pending_sends:
+        if self._pending is not None:
             return await self._drain_sends()
 
         handler = {
@@ -139,7 +165,7 @@ class StateMachine:
         self.sum_signature = None
         self.update_signature = None
         self.ephm_keys = None
-        self._pending_sends = []
+        self._pending = None
         self._after_send_phase = None
 
     # --- phases -----------------------------------------------------------
@@ -280,10 +306,9 @@ class StateMachine:
         """Sign, chunk if oversized, sealed-box encrypt, POST
         (sending.rs:23-121).
 
-        Parts that fail to send stay queued and are retried on later ticks
-        (chunk-level retry, reference sending.rs:96-113) — already-delivered
-        chunks are never re-sent; the phase only advances once every part is
-        through.
+        A part that fails to send is retried on later ticks (chunk-level
+        retry, reference sending.rs:96-113) — already-delivered chunks are
+        never re-sent; the phase only advances once every part is through.
         """
         assert self.round_params is not None
         message = Message(
@@ -291,27 +316,28 @@ class StateMachine:
             coordinator_pk=self.round_params.pk,
             payload=payload,
         )
-        coordinator_pk = PublicEncryptKey(self.round_params.pk)
-        self._pending_sends = [
-            coordinator_pk.encrypt(part)
-            for part in MessageEncoder(message, self.keys.secret, self.max_message_size)
-        ]
+        encoder = MessageEncoder(message, self.keys.secret, self.max_message_size)
+        self._pending = _PendingSend(encoder, self.round_params.pk)
         self._after_send_phase = next_phase
         return await self._drain_sends()
 
     async def _drain_sends(self) -> TransitionOutcome:
-        while self._pending_sends:
+        assert self._pending is not None
+        pending = self._pending
+        while pending.next_index < pending.encoder.n_parts:
+            sealed = pending.sealed_part(pending.next_index)
             try:
-                await self.client.send_message(self._pending_sends[0])
+                await self.client.send_message(sealed)
             except Exception as e:
                 logger.info(
-                    "chunk send failed (%d parts outstanding); retrying on a "
-                    "later tick: %s",
-                    len(self._pending_sends),
+                    "chunk send failed (part %d/%d); retrying on a later tick: %s",
+                    pending.next_index + 1,
+                    pending.encoder.n_parts,
                     e,
                 )
                 return TransitionOutcome.PENDING
-            self._pending_sends.pop(0)
+            pending.next_index += 1
+        self._pending = None
         if self._after_send_phase is not None:
             self.phase = self._after_send_phase
             self._after_send_phase = None
@@ -332,9 +358,19 @@ class StateMachine:
             "update_signature": self.update_signature.hex() if self.update_signature else None,
             "ephm_secret": self.ephm_keys.secret.as_bytes().hex() if self.ephm_keys else None,
             "round_params": self.round_params.to_dict() if self.round_params else None,
-            # in-flight multipart send state (chunk-level retry resumes
-            # exactly where it stopped, reference sending.rs sending state)
-            "pending_sends": [base64.b64encode(p).decode() for p in self._pending_sends],
+            # in-flight multipart send (chunk-level retry resumes exactly
+            # where it stopped): ONE payload copy + cursor, not sealed parts
+            "pending_send": (
+                {
+                    "payload": base64.b64encode(self._pending.encoder._payload_bytes).decode(),
+                    "tag": int(self._pending.encoder.message.tag),
+                    "message_id": getattr(self._pending.encoder, "message_id", 0),
+                    "max_message_size": self._pending.encoder.max_message_size,
+                    "next_index": self._pending.next_index,
+                }
+                if self._pending is not None
+                else None
+            ),
             "after_send_phase": self._after_send_phase.value if self._after_send_phase else None,
         }
         return json.dumps(d).encode()
@@ -365,7 +401,25 @@ class StateMachine:
             machine.ephm_keys = EncryptKeyPair.derive_from_seed(bytes.fromhex(d["ephm_secret"]))
         if d["round_params"]:
             machine.round_params = RoundParameters.from_dict(d["round_params"])
-        machine._pending_sends = [base64.b64decode(p) for p in d.get("pending_sends", [])]
+        ps = d.get("pending_send")
+        if ps and machine.round_params is not None:
+            from ..core.message.message import Tag
+
+            message = Message(
+                participant_pk=machine.keys.public,
+                coordinator_pk=machine.round_params.pk,
+                payload=_RawPayload(base64.b64decode(ps["payload"])),
+                tag=Tag(ps["tag"]),
+            )
+            encoder = MessageEncoder(
+                message,
+                machine.keys.secret,
+                ps["max_message_size"],
+                message_id=ps["message_id"],
+            )
+            machine._pending = _PendingSend(
+                encoder, machine.round_params.pk, next_index=int(ps["next_index"])
+            )
         if d.get("after_send_phase"):
             machine._after_send_phase = PhaseKind(d["after_send_phase"])
         return machine

@@ -184,13 +184,23 @@ class InfluxHttpMetrics(Metrics):
     # --- recording (non-blocking) ----------------------------------------
 
     def _emit(self, measurement: str, value, round_id: int, phase: str = "") -> None:
+        import queue as queue_mod
+
         line = _influx_line(measurement, value, round_id, phase)
         try:
             self._queue.put_nowait(line)
-        except Exception:  # full: drop the OLDEST so fresh data survives
-            try:
-                self._queue.get_nowait()
-                self._queue.put_nowait(line)
-            except Exception:
-                pass
-            self.dropped += 1
+            return
+        except queue_mod.Full:
+            pass
+        # full: drop the OLDEST so fresh data survives; count every line
+        # actually lost (the evicted one, and the new one if a concurrent
+        # producer refills the freed slot before we take it)
+        self.dropped += 1
+        try:
+            self._queue.get_nowait()
+        except queue_mod.Empty:
+            pass
+        try:
+            self._queue.put_nowait(line)
+        except queue_mod.Full:
+            self.dropped += 1  # the new line was lost as well

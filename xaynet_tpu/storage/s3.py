@@ -163,8 +163,12 @@ async def _http_request(
         raise
     except asyncio.TimeoutError as e:
         raise StorageError(f"object store timeout after {timeout}s") from e
-    except OSError as e:
+    except (OSError, asyncio.IncompleteReadError) as e:
+        # IncompleteReadError is an EOFError, not an OSError: a connection
+        # severed mid-body must still surface as the typed storage failure
         raise StorageError(f"object store unreachable: {e}") from e
+    except ValueError as e:  # malformed lengths/framing from a broken proxy
+        raise StorageError(f"object store sent a malformed response: {e}") from e
 
 
 class S3ModelStorage(ModelStorage):
@@ -203,8 +207,12 @@ class S3ModelStorage(ModelStorage):
             amz_date=amz_date,
         )
 
-    async def _request(self, method: str, path: str, body: bytes = b"") -> _HttpResponse:
+    async def _request(
+        self, method: str, path: str, body: bytes = b"", extra_headers: dict | None = None
+    ) -> _HttpResponse:
         headers = self._request_headers(method, path, body)
+        if extra_headers:
+            headers.update(extra_headers)
         return await _http_request(self.endpoint, method, path, headers, body)
 
     # --- operations (reference: s3.rs:69-200) ----------------------------
@@ -221,12 +229,17 @@ class S3ModelStorage(ModelStorage):
     async def set_global_model(self, round_id: int, round_seed: bytes, model_data: bytes) -> str:
         model_id = self.create_global_model_id(round_id, round_seed)
         key = f"/{self.bucket}/{model_id}"
+        # cheap early refusal without uploading the body ...
         head = await self._request("HEAD", key)
         if head.status == 200:
             raise StorageError(f"global model {model_id} already exists")
         if head.status not in (404,):
             raise StorageError(f"object store HEAD failed: HTTP {head.status}")
-        resp = await self._request("PUT", key, model_data)
+        # ... and an ATOMIC conditional PUT closing the HEAD->PUT race
+        # between concurrent writers (S3/Minio honor If-None-Match: *)
+        resp = await self._request("PUT", key, model_data, {"if-none-match": "*"})
+        if resp.status == 412:
+            raise StorageError(f"global model {model_id} already exists")
         if resp.status not in (200, 201):
             raise StorageError(f"store model failed: HTTP {resp.status} {resp.body[:200]!r}")
         return model_id

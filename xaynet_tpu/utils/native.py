@@ -25,7 +25,13 @@ _tried = False
 
 
 def _build() -> bool:
-    for args in (["make", "-s"], ["make", "-s", "ARCHFLAGS="]):
+    # build ONLY the kernel library this loader consumes — the participant
+    # library additionally links libsodium, which may be absent on hosts
+    # that only need the numpy-fallback-compatible kernels
+    for args in (
+        ["make", "-s", "libxaynet_native.so"],
+        ["make", "-s", "libxaynet_native.so", "ARCHFLAGS="],
+    ):
         try:
             subprocess.run(
                 args, cwd=_NATIVE_DIR, check=True, capture_output=True, timeout=120
