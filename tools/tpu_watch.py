@@ -1,0 +1,171 @@
+"""Opportunistic TPU evidence capture (VERDICT r02, next-round item 1).
+
+The axon tunnel to the real TPU chip has been dead at both end-of-round
+bench captures so far.  Instead of betting the round on one end-of-round
+moment, this watcher loops in the background:
+
+  * every ``--interval`` seconds it probes the accelerator in a fresh
+    subprocess (a wedged tunnel hangs the JAX backend init forever, so the
+    probe must be externally timed out);
+  * every attempt is appended to ``TPU_WATCH.log`` — if the tunnel never
+    comes up all round, that log is the committed proof;
+  * the moment a probe succeeds it immediately runs the full capture
+    suite (``bench.py`` headline + Pallas tile sweep, and
+    ``tools/bench_round.py`` end-to-end round legs at 25M params), appends
+    platform-tagged JSON to ``BENCH_HISTORY.jsonl``, writes
+    ``TPU_EVIDENCE_r03.md``, and exits 0 so the builder can commit.
+
+Run:  python tools/tpu_watch.py [--interval 600] [--probe-timeout 150]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_WATCH.log")
+HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r03.md")
+
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp, numpy as np;"
+    "d = jax.devices();"
+    "x = jax.device_put(np.ones(8, np.float32));"
+    "print('probe-platform:', d[0].platform, float(jnp.sum(x)))"
+)
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def log(line: str) -> None:
+    stamped = f"{_now()} {line}"
+    print(stamped, flush=True)
+    with open(LOG, "a") as f:
+        f.write(stamped + "\n")
+
+
+def probe(timeout: float) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the accelerator plugin claim the backend
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True,
+            timeout=timeout,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"probe TIMEOUT after {timeout:.0f}s (backend init hung - tunnel dead)")
+        return False
+    out = r.stdout.strip()
+    if r.returncode == 0 and "probe-platform:" in out and "probe-platform: cpu" not in out:
+        log(f"probe OK: {out}")
+        return True
+    log(f"probe FAIL rc={r.returncode} stdout={out!r} stderr_tail={r.stderr[-300:]!r}")
+    return False
+
+
+def run_capture(name: str, cmd: list[str], timeout: float) -> dict:
+    """Run one capture command; return a record for the history file."""
+    log(f"capture [{name}] start: {' '.join(cmd)}")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+        )
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        out = (e.stdout or b"").decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+        child_err = (e.stderr or b"").decode(errors="replace") if isinstance(e.stderr, bytes) else (e.stderr or "")
+        err = f"TIMEOUT after {timeout}s\n{child_err}"
+    dt = time.time() - t0
+    # last JSON-looking line of stdout is the parsed result (bench.py contract)
+    parsed = None
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                parsed = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    rec = {
+        "ts": _now(),
+        "source": f"tpu_watch:{name}",
+        "rc": rc,
+        "seconds": round(dt, 1),
+        "parsed": parsed,
+        "stdout_tail": out[-3000:],
+        "stderr_tail": err[-2000:],
+    }
+    log(f"capture [{name}] done rc={rc} in {dt:.0f}s parsed={json.dumps(parsed)}")
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600.0)
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--once", action="store_true", help="probe once and exit")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        log(f"--- probe attempt {attempt} ---")
+        if probe(args.probe_timeout):
+            records = [
+                run_capture("bench_headline", [sys.executable, "bench.py"], 1800),
+                run_capture(
+                    "bench_round_25m",
+                    [sys.executable, "tools/bench_round.py", "--model-len", "25000000",
+                     "--updates", "64", "--batch", "16"],
+                    2400,
+                ),
+            ]
+            with open(HISTORY, "a") as f:
+                for rec in records:
+                    f.write(json.dumps(rec) + "\n")
+            # success = at least one capture actually completed on an
+            # accelerator; a tunnel that died mid-bench must not end the watch
+            good = [
+                r for r in records
+                if r["rc"] == 0 and r["parsed"] and r["parsed"].get("platform") not in (None, "cpu")
+            ]
+            if not good:
+                log("probe succeeded but no capture completed on the accelerator; continuing watch")
+                time.sleep(args.interval)
+                continue
+            with open(EVIDENCE, "w") as f:
+                f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
+                f.write(f"Captured {_now()} after {attempt} probe attempts.\n\n")
+                for rec in records:
+                    f.write(f"## {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")
+                    f.write("```\n" + rec["stdout_tail"] + "\n```\n\n")
+                    if rec["parsed"]:
+                        f.write("Parsed: `" + json.dumps(rec["parsed"]) + "`\n\n")
+            log("TPU capture complete; exiting so the builder can commit")
+            return 0
+        if args.once:
+            return 1
+        time.sleep(args.interval)
+    log("deadline reached without a live accelerator; TPU_WATCH.log is the evidence")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

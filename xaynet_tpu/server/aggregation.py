@@ -34,6 +34,7 @@ class StagedAggregator:
         device: bool = False,
         batch_size: int = 64,
         ingest_workers: int = 4,
+        mesh=None,
     ):
         self.config = config
         self.object_size = object_size
@@ -50,7 +51,7 @@ class StagedAggregator:
             from ..ops import limbs as limb_ops
             from ..parallel.aggregator import ShardedAggregator
 
-            self._device = ShardedAggregator(config.vect, object_size)
+            self._device = ShardedAggregator(config.vect, object_size, mesh=mesh)
             # tiny unit part stays on host
             self._unit_acc = np.zeros(
                 limb_ops.n_limbs_for_order(config.unit.order), dtype=np.uint32
