@@ -1,0 +1,51 @@
+"""Dict-backed handlers for the Redis commands the Lua scripts use.
+
+Shared by the fake RESP server (``test_redis_storage.FakeRedis``) and the
+direct script tests (``test_lua_mini``) so both suites exercise one set of
+command semantics. Handlers return RESP-style Python values (int / bytes /
+None / list) which ``xaynet_tpu.utils.lua_mini`` converts with the Redis
+EVAL conversion rules.
+"""
+
+from __future__ import annotations
+
+
+class DictRedisCommands:
+    """State + the single-command subset ``redis.call`` needs."""
+
+    def __init__(self):
+        self.hashes: dict[bytes, dict[bytes, bytes]] = {}
+        self.sets: dict[bytes, set] = {}
+        self.zsets: dict[bytes, dict[bytes, float]] = {}
+
+    def __call__(self, *parts: bytes):
+        cmd = parts[0].upper()
+        if cmd == b"HSETNX":
+            h = self.hashes.setdefault(parts[1], {})
+            if parts[2] in h:
+                return 0
+            h[parts[2]] = parts[3]
+            return 1
+        if cmd == b"HSET":
+            h = self.hashes.setdefault(parts[1], {})
+            added = int(parts[2] not in h)
+            h[parts[2]] = parts[3]
+            return added
+        if cmd == b"HLEN":
+            return len(self.hashes.get(parts[1], {}))
+        if cmd == b"HEXISTS":
+            return int(parts[2] in self.hashes.get(parts[1], {}))
+        if cmd == b"SISMEMBER":
+            return int(parts[2] in self.sets.get(parts[1], set()))
+        if cmd == b"SADD":
+            s = self.sets.setdefault(parts[1], set())
+            added = sum(1 for m in parts[2:] if m not in s)
+            s.update(parts[2:])
+            return added
+        if cmd == b"ZINCRBY":
+            z = self.zsets.setdefault(parts[1], {})
+            z[parts[3]] = z.get(parts[3], 0.0) + float(parts[2])
+            score = z[parts[3]]
+            # real Redis replies with the score as a bulk string
+            return (b"%d" % int(score)) if float(score).is_integer() else repr(score).encode()
+        raise AssertionError(f"unsupported command in Lua script: {cmd!r}")

@@ -26,7 +26,7 @@ from xaynet_tpu.core.message import Message, Sum, Tag, Update
 from xaynet_tpu.core.message.payloads import Chunk
 from xaynet_tpu.sdk.simulation import keys_for_task
 from xaynet_tpu.server.requests import RequestError
-from xaynet_tpu.server.services import PetMessageHandler, ServiceError
+from xaynet_tpu.server.services import PetMessageHandler
 from xaynet_tpu.server.settings import CountSettings, Settings
 from xaynet_tpu.server.state_machine import StateMachineInitializer
 from xaynet_tpu.storage.memory import (

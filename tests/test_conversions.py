@@ -1,6 +1,5 @@
 """Primitive conversion edges (reference parity: model.rs / scalar.rs)."""
 
-import math
 from fractions import Fraction
 
 import numpy as np

@@ -1,0 +1,278 @@
+"""The mini-Lua interpreter that executes the Redis EVAL scripts.
+
+These tests prove the *actual Lua text* in ``storage/redis.py`` parses and
+runs (a syntax error there fails here), and pin the Lua 5.1 semantics the
+scripts rely on: 1-based tables, ``#``, number/string non-coercing ``==``,
+``and``/``or`` returning operands, numeric ``for`` with step, and the Redis
+EVAL type-conversion rules.
+"""
+
+import pytest
+from redis_commands import DictRedisCommands
+
+from xaynet_tpu.storage.redis import (
+    ADD_LOCAL_SEED_DICT,
+    ADD_SUM_PARTICIPANT,
+    INCR_MASK_SCORE,
+)
+from xaynet_tpu.utils import lua_mini
+from xaynet_tpu.utils.lua_mini import LuaError, LuaTable, parse, run_script, to_redis
+
+
+def run(src, keys=(), argv=(), call=None):
+    return run_script(
+        src if isinstance(src, bytes) else src.encode(),
+        list(keys),
+        list(argv),
+        call or (lambda *a: None),
+    )
+
+
+# --- language semantics ----------------------------------------------------
+
+
+def test_return_number_truncates_like_redis():
+    assert run("return 7 / 2") == 3  # 3.5 -> integer truncation
+
+
+def test_tables_are_one_based_and_length():
+    assert run("return ARGV[1]", argv=[b"first", b"second"]) == b"first"
+    assert run("return #ARGV", argv=[b"a", b"b", b"c"]) == 3
+    assert run("return ARGV[#ARGV]", argv=[b"a", b"z"]) == b"z"
+
+
+def test_out_of_range_index_is_nil():
+    assert run("if ARGV[5] == nil then return 1 end return 0", argv=[b"x"]) == 1
+
+
+def test_number_string_equality_never_coerces():
+    # Lua ==: different types are never equal
+    assert run('if 1 == "1" then return 1 end return 0') == 0
+    assert run('if 1 ~= "1" then return 1 end return 0') == 1
+
+
+def test_and_or_return_operands():
+    assert run("return 0 and 2") == 2  # 0 is truthy in Lua!
+    assert run("return nil or 7") == 7
+    assert run("return false or nil") is None
+
+
+def test_numeric_for_with_step():
+    src = """
+    local acc = 0
+    for i = 2, #ARGV, 2 do
+      acc = acc + tonumber(ARGV[i])
+    end
+    return acc
+    """
+    assert run(src, argv=[b"9", b"1", b"9", b"2", b"9", b"3"]) == 6
+
+
+def test_for_loop_descending_and_break():
+    src = """
+    local n = 0
+    for i = 10, 1, -1 do
+      n = n + 1
+      if i == 8 then break end
+    end
+    return n
+    """
+    assert run(src) == 3
+
+
+def test_while_loop():
+    src = """
+    local i = 0
+    while i < 5 do
+      i = i + 1
+    end
+    return i
+    """
+    assert run(src) == 5
+
+
+def test_concat_coerces_numbers():
+    assert run('return "seed_dict:" .. 42') == b"seed_dict:42"
+    assert run("return ARGV[1] .. ARGV[2]", argv=[b"ab", b"cd"]) == b"abcd"
+
+
+def test_arithmetic_on_string_coerces():
+    # Lua arithmetic coerces numeric strings (unlike ==)
+    assert run('return "4" + 1') == 5
+
+
+def test_modulo_matches_lua():
+    assert run("return -3 % 5") == 2  # Lua: a - floor(a/b)*b
+
+
+def test_comments_and_string_escapes():
+    assert run('-- leading comment\nreturn "a\\"b" -- trailing') == b'a"b'
+
+
+def test_scope_shadowing():
+    src = """
+    local x = 1
+    if true then
+      local x = 2
+    end
+    return x
+    """
+    assert run(src) == 1
+
+
+def test_table_constructor_and_assignment():
+    src = """
+    local t = {}
+    t[1] = "a"
+    t[2] = "b"
+    return #t
+    """
+    assert run(src) == 2
+
+
+# --- error detection (the reason this interpreter exists) ------------------
+
+
+def test_syntax_error_missing_end():
+    with pytest.raises(LuaError):
+        parse(b'if 1 == 1 then return 1')
+
+
+def test_syntax_error_bad_operator():
+    with pytest.raises(LuaError):
+        parse(b"return 1 != 2")  # != is not Lua
+
+
+def test_unreachable_code_after_return():
+    with pytest.raises(LuaError):
+        parse(b"return 1\nlocal x = 2")
+
+
+def test_undefined_variable_is_runtime_error():
+    with pytest.raises(LuaError):
+        run("return undefined_thing")
+
+
+def test_compare_number_with_string_raises():
+    with pytest.raises(LuaError):
+        run('return 1 < "2"')
+
+
+def test_unsupported_construct_rejected():
+    with pytest.raises(LuaError):
+        parse(b"local function f() return 1 end return f()")
+
+
+def test_call_error_propagates_like_redis_call():
+    def boom(*a):
+        raise LuaError("WRONGTYPE")
+
+    with pytest.raises(LuaError):
+        run('return redis.call("GET", "k")', call=boom)
+
+
+# --- Redis conversion rules ------------------------------------------------
+
+
+def test_to_redis_conversions():
+    assert to_redis(None) is None
+    assert to_redis(False) is None  # false -> nil
+    assert to_redis(True) == 1
+    assert to_redis(3.9) == 3  # truncation
+    assert to_redis(b"x") == b"x"
+    assert to_redis(LuaTable([1.0, b"a", None, 2.0])) == [1, b"a"]  # nil ends array
+
+
+def test_nil_reply_becomes_false_in_lua():
+    # RESP nil -> Lua false: scripts branch on it
+    assert run('if redis.call("GET", "k") == false then return 1 end return 0') == 1
+
+
+def test_status_reply_passthrough():
+    assert run('return redis.call("SET", "k", "v")', call=lambda *a: b"OK") == b"OK"
+
+
+# --- the real scripts, executed as Lua -------------------------------------
+
+
+class MiniStore(DictRedisCommands):
+    """The shared dict-backed command handlers, plus call recording."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def __call__(self, *parts):
+        self.calls.append(parts)
+        return super().__call__(*parts)
+
+
+def _seed_entries(pks):
+    argv = [b"updater-1"]
+    for pk in pks:
+        argv += [pk, b"seed-for-" + pk]
+    return argv
+
+
+def test_add_sum_participant_script():
+    store = MiniStore()
+    assert run_script(ADD_SUM_PARTICIPANT, [b"sum_dict"], [b"pk1", b"ephm1"], store) == 1
+    # duplicate pk refused atomically by HSETNX
+    assert run_script(ADD_SUM_PARTICIPANT, [b"sum_dict"], [b"pk1", b"other"], store) == 0
+    assert store.hashes[b"sum_dict"] == {b"pk1": b"ephm1"}
+
+
+def test_add_local_seed_dict_script_error_codes():
+    store = MiniStore()
+    keys = [b"sum_dict", b"update_participants"]
+    store.hashes[b"sum_dict"] = {b"s1": b"e1", b"s2": b"e2"}
+
+    # -1: length mismatch (only one entry for two sum participants)
+    assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1"]), store) == -1
+    # -2: unknown sum pk
+    assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1", b"nope"]), store) == -2
+    # 0: success writes every per-sum-pk hash and marks the updater
+    assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1", b"s2"]), store) == 0
+    assert store.hashes[b"seed_dict:s1"][b"updater-1"] == b"seed-for-s1"
+    assert store.hashes[b"seed_dict:s2"][b"updater-1"] == b"seed-for-s2"
+    assert b"updater-1" in store.sets[b"update_participants"]
+    # -3: same updater again
+    assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1", b"s2"]), store) == -3
+
+
+def test_add_local_seed_dict_partial_submission_detected():
+    # -4: updater not in the set but already present in some seed hash
+    # (the replay-hazard state after a lost reply)
+    store = MiniStore()
+    keys = [b"sum_dict", b"update_participants"]
+    store.hashes[b"sum_dict"] = {b"s1": b"e1"}
+    store.hashes[b"seed_dict:s1"] = {b"updater-1": b"old"}
+    assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1"]), store) == -4
+
+
+def test_incr_mask_score_script():
+    store = MiniStore()
+    keys = [b"sum_dict", b"mask_submitted", b"mask_dict"]
+    store.hashes[b"sum_dict"] = {b"s1": b"e1"}
+
+    # -1: not a sum participant
+    assert run_script(INCR_MASK_SCORE, keys, [b"intruder", b"mask-a"], store) == -1
+    # 0: accepted, mask scored
+    assert run_script(INCR_MASK_SCORE, keys, [b"s1", b"mask-a"], store) == 0
+    assert store.zsets[b"mask_dict"][b"mask-a"] == 1.0
+    # -2: double submission
+    assert run_script(INCR_MASK_SCORE, keys, [b"s1", b"mask-a"], store) == -2
+
+
+def test_scripts_parse_cleanly():
+    # pure parse check: any future syntax slip in storage/redis.py fails here
+    for script in (ADD_SUM_PARTICIPANT, ADD_LOCAL_SEED_DICT, INCR_MASK_SCORE):
+        assert lua_mini.parse(script)
+
+
+def test_mutated_script_fails_to_parse():
+    # the old content-matching fake would happily "run" a broken script;
+    # the interpreter must not
+    broken = ADD_LOCAL_SEED_DICT.replace(b"then", b"thn", 1)
+    with pytest.raises(LuaError):
+        lua_mini.parse(broken)

@@ -1,6 +1,5 @@
 """Wire-format round-trip tests (reference test strategy §4.2)."""
 
-import numpy as np
 import pytest
 
 from xaynet_tpu.core.crypto import EncryptKeyPair, SigningKeyPair

@@ -1,9 +1,7 @@
 """Model families: construction, jitted train steps, flatten round trips."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from xaynet_tpu.models import mlp, lenet, lora, lstm, resnet
 from xaynet_tpu.models.mlp import flatten_params, unflatten_params

@@ -16,9 +16,8 @@ import threading
 from fractions import Fraction
 
 import numpy as np
-import pytest
 
-from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey, SecretEncryptKey
+from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair
 from xaynet_tpu.core.crypto.sign import SigningKeyPair, is_eligible, verify_detached
 from xaynet_tpu.core.mask.config import (
     BoundType,

@@ -16,7 +16,7 @@ from xaynet_tpu.core.mask import (
     ModelType,
     UnmaskingError,
 )
-from xaynet_tpu.server.phases.base import PhaseState, PhaseTimeout, Shared, _Counter
+from xaynet_tpu.server.phases.base import PhaseState, PhaseTimeout, Shared
 from xaynet_tpu.server.requests import RequestError, RequestReceiver, SumRequest
 from xaynet_tpu.server.settings import CountSettings, PhaseSettings, TimeSettings
 
@@ -148,8 +148,6 @@ def test_unmask_length_mismatch_rejected():
 
 
 def test_rest_rejects_oversized_body():
-    from xaynet_tpu.server import rest as rest_mod
-
     async def run():
         from xaynet_tpu.server.rest import RestServer
 

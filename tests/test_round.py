@@ -12,7 +12,6 @@ import asyncio
 from fractions import Fraction
 
 import numpy as np
-import pytest
 
 from xaynet_tpu.sdk.client import InProcessClient
 from xaynet_tpu.sdk.simulation import keys_for_task

@@ -272,7 +272,7 @@ def test_sdk_sum2_device_path_matches_host(monkeypatch):
         MaskSeed,
         ModelType,
     )
-    from xaynet_tpu.sdk.state_machine import PetSettings as SdkSettings, StateMachine
+    from xaynet_tpu.sdk.state_machine import StateMachine
     from xaynet_tpu.sdk.simulation import keys_for_task
 
     cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3)

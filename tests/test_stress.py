@@ -21,7 +21,6 @@ from xaynet_tpu.core.mask import (
     GroupType,
     MaskConfig,
     MaskObject,
-    MaskSeed,
     MaskUnit,
     MaskVect,
     ModelType,

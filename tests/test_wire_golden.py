@@ -7,7 +7,6 @@ the parser's total failure mode is DecodeError — never a crash.
 
 import random
 
-import pytest
 
 from xaynet_tpu.core.crypto.prng import uniform_ints
 from xaynet_tpu.core.crypto.sign import SigningKeyPair

@@ -33,14 +33,6 @@ LOG = os.path.join(REPO, "TPU_WATCH.log")
 HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r03.md")
 
-PROBE_CODE = (
-    "import jax, jax.numpy as jnp, numpy as np;"
-    "d = jax.devices();"
-    "x = jax.device_put(np.ones(8, np.float32));"
-    "print('probe-platform:', d[0].platform, float(jnp.sum(x)))"
-)
-
-
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
@@ -53,26 +45,18 @@ def log(line: str) -> None:
 
 
 def probe(timeout: float) -> bool:
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # let the accelerator plugin claim the backend
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE_CODE],
-            capture_output=True,
-            timeout=timeout,
-            text=True,
-            env=env,
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired:
-        log(f"probe TIMEOUT after {timeout:.0f}s (backend init hung - tunnel dead)")
-        return False
-    out = r.stdout.strip()
-    if r.returncode == 0 and "probe-platform:" in out and "probe-platform: cpu" not in out:
-        log(f"probe OK: {out}")
-        return True
-    log(f"probe FAIL rc={r.returncode} stdout={out!r} stderr_tail={r.stderr[-300:]!r}")
-    return False
+    """One accelerator probe, sharing bench.py's detection contract."""
+    import contextlib
+    import io
+
+    sys.path.insert(0, REPO)
+    from bench import _device_probe_ok
+
+    detail = io.StringIO()
+    with contextlib.redirect_stderr(detail):
+        ok = _device_probe_ok(timeout=timeout, attempts=1)
+    log(("probe OK: " if ok else "probe FAIL: ") + " | ".join(detail.getvalue().split("\n"))[:500])
+    return ok
 
 
 def run_capture(name: str, cmd: list[str], timeout: float) -> dict:
@@ -148,6 +132,8 @@ def main() -> int:
             ]
             if not good:
                 log("probe succeeded but no capture completed on the accelerator; continuing watch")
+                if args.once:
+                    return 1
                 time.sleep(args.interval)
                 continue
             with open(EVIDENCE, "w") as f:

@@ -104,7 +104,12 @@ class StreamSampler:
         while got < count:
             need = count - got
             target = int(need * bpn / max(accept_rate, 1e-6) * 1.15) + 4 * BLOCK_BYTES
-            buf = np.concatenate([self._leftover, self._more_keystream(target - len(self._leftover))]) if len(self._leftover) else self._more_keystream(target)
+            if len(self._leftover):
+                buf = np.concatenate(
+                    [self._leftover, self._more_keystream(target - len(self._leftover))]
+                )
+            else:
+                buf = self._more_keystream(target)
             n_cand = len(buf) // bpn
             cand = limb_ops.bytes_le_to_limbs(buf[: n_cand * bpn], n_cand, bpn)
             keep_mask = limb_ops.lt_const(cand, order_cl)

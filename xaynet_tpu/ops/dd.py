@@ -48,7 +48,8 @@ def two_prod(a, b):
 
 
 def dd(hi, lo=0.0):
-    return np.asarray(hi, dtype=np.float64), np.asarray(lo, dtype=np.float64) * np.ones_like(np.asarray(hi, dtype=np.float64))
+    hi64 = np.asarray(hi, dtype=np.float64)
+    return hi64, np.asarray(lo, dtype=np.float64) * np.ones_like(hi64)
 
 
 def from_fraction(f) -> tuple[float, float]:

@@ -162,7 +162,14 @@ class RestServer:
         ctype: str = "text/plain",
         keep_alive: bool = False,
     ) -> None:
-        reason = {200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found", 413: "Payload Too Large", 500: "Internal Server Error"}.get(status, "")
+        reason = {
+            200: "OK",
+            204: "No Content",
+            400: "Bad Request",
+            404: "Not Found",
+            413: "Payload Too Large",
+            500: "Internal Server Error",
+        }.get(status, "")
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {ctype}\r\n"
