@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Bring up the FULL composed stack (coordinator + redis + minio + influxdb)
+# and complete PET rounds against it over the real socket.
+#
+#   deploy/compose_smoke.sh [rounds]
+#
+# Succeeds only if examples/test_drive.py finishes the rounds, which proves:
+# redis-backed dictionaries (Lua scripts in a real Redis), minio-backed
+# global models (SigV4), influx metrics, and the full message pipeline.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROUNDS="${1:-2}"
+COMPOSE=(docker compose -f deploy/docker-compose.yml --profile full)
+
+cleanup() { "${COMPOSE[@]}" down -v; }
+trap cleanup EXIT
+
+"${COMPOSE[@]}" up -d --build
+
+echo "waiting for the coordinator to answer /params ..."
+for i in $(seq 1 60); do
+  if curl -fsS -o /dev/null http://127.0.0.1:8081/params; then
+    break
+  fi
+  [ "$i" = 60 ] && { echo "coordinator never came up"; "${COMPOSE[@]}" logs coordinator-full | tail -50; exit 1; }
+  sleep 2
+done
+
+# -n/-l must match the coordinator-full PET window + model length env
+JAX_PLATFORMS=cpu python examples/test_drive.py --url http://127.0.0.1:8081 -n 20 -l 1000 -r "$ROUNDS"
+
+echo "checking metrics landed in influxdb ..."
+docker compose -f deploy/docker-compose.yml --profile full exec -T influxdb \
+  influx -database metrics -execute 'SHOW MEASUREMENTS' | head -20 || true
+
+echo "compose smoke OK"

@@ -53,10 +53,14 @@ from xaynet_tpu.storage.memory import (
 from xaynet_tpu.storage.traits import Store
 
 
-def synthetic_cifar(seed: int, n: int = 128):
+def synthetic_cifar(seed: int, n: int = 128, image_size: int = 32):
+    """CIFAR-shaped data with a shared linear teacher so the federated
+    objective is actually learnable (labels = argmax of a fixed random
+    projection of the image)."""
     rng = np.random.default_rng(seed)
-    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
-    y = rng.integers(0, 10, size=n).astype(np.int32)
+    x = rng.normal(size=(n, image_size, image_size, 3)).astype(np.float32)
+    teacher = np.random.default_rng(123).normal(size=(image_size * image_size * 3, 10))
+    y = np.argmax(x.reshape(n, -1) @ teacher, axis=1).astype(np.int32)
     return x, y
 
 
@@ -92,9 +96,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=2)
     ap.add_argument("--participants", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=32, help="synthetic image side (CI smoke: 8)")
+    ap.add_argument("--epochs", type=int, default=1, help="local epochs per round")
+    ap.add_argument("--lr", type=float, default=1e-3, help="local SGD learning rate")
+    ap.add_argument("--check-loss", action="store_true",
+                    help="exit nonzero unless the final global model beats the init loss")
     args = ap.parse_args()
 
-    template = lenet.init_params(jax.random.PRNGKey(0))
+    image_shape = (args.image_size, args.image_size, 3)
+    template = lenet.init_params(jax.random.PRNGKey(0), image_shape=image_shape)
     model_len = model_length(template)
     n_sum, n_update = 2, max(3, args.participants - 2)
     print(f"LeNet: {model_len} parameters; {n_sum} sum + {n_update} update per round")
@@ -105,7 +115,7 @@ def main():
     def sync(coro):
         return asyncio.run(coro)
 
-    shared_step = lenet.make_train_step()
+    shared_step = lenet.make_train_step(learning_rate=args.lr)
     last_seed = None
     threads = []
     for round_no in range(1, args.rounds + 1):
@@ -118,10 +128,10 @@ def main():
 
         def kwargs(i):
             return dict(
-                init_params_fn=lambda: lenet.init_params(jax.random.PRNGKey(1)),
+                init_params_fn=lambda: lenet.init_params(jax.random.PRNGKey(1), image_shape=image_shape),
                 make_step=lambda: shared_step,
-                data=synthetic_cifar(i),
-                epochs=1,
+                data=synthetic_cifar(i, image_size=args.image_size),
+                epochs=args.epochs,
                 batch_size=32,
             )
 
@@ -152,6 +162,19 @@ def main():
 
     for t in threads:
         t.stop()
+
+    if args.check_loss:
+        from eval_check import require_loss_improved
+
+        model_obj, _, _ = shared_step
+        # the shared linear teacher makes every shard the same task
+        require_loss_improved(
+            model_obj,
+            template,
+            lenet.init_params(jax.random.PRNGKey(1), image_shape=image_shape),
+            model,
+            [synthetic_cifar(i, image_size=args.image_size) for i in range(n_update)],
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,34 @@
+"""Shared acceptance gate for the federated examples' ``--check-loss``.
+
+Evaluates the initial participant parameters and the final federated
+global model on the union of the updaters' shards; exits nonzero unless
+federation improved the loss. Both cifar_lenet and shakespeare_lstm use
+this, so the contract CI keys off lives in one place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+
+from xaynet_tpu.models.mlp import unflatten_params
+
+
+def require_loss_improved(model_obj, template, init_params, final_model, shards) -> None:
+    """Exit nonzero unless the federated model beats ``init_params``.
+
+    ``shards`` is a list of (x, y) arrays (the updaters' own data);
+    ``final_model`` the flattened global model vector.
+    """
+    eval_x = np.concatenate([x for x, _ in shards])
+    eval_y = np.concatenate([y for _, y in shards])
+
+    def eval_loss(params) -> float:
+        logits = model_obj.apply(params, eval_x)
+        return float(optax.softmax_cross_entropy_with_integer_labels(logits, eval_y).mean())
+
+    final_params = unflatten_params(template, np.asarray(final_model, dtype=np.float32))
+    before, after = eval_loss(init_params), eval_loss(final_params)
+    print(f"eval loss: init {before:.4f} -> federated {after:.4f}")
+    if not after < before:
+        raise SystemExit("federated model did not improve on the init loss")

@@ -57,11 +57,11 @@ SEQ_LEN = 40
 HIDDEN = 64
 
 
-def synthetic_shards(seed: int, n: int = 64):
+def synthetic_shards(seed: int, n: int = 64, seq_len: int = SEQ_LEN):
     """Per-participant character streams with distinct symbol biases."""
     rng = np.random.default_rng(seed)
     bias = rng.dirichlet(np.ones(lstm.VOCAB_SIZE) * 0.3)
-    tokens = rng.choice(lstm.VOCAB_SIZE, size=(n, SEQ_LEN + 1), p=bias).astype(np.int32)
+    tokens = rng.choice(lstm.VOCAB_SIZE, size=(n, seq_len + 1), p=bias).astype(np.int32)
     return tokens[:, :-1], tokens[:, 1:]
 
 
@@ -69,9 +69,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=1)
     ap.add_argument("--participants", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=HIDDEN)
+    ap.add_argument("--seq-len", type=int, default=SEQ_LEN)
+    ap.add_argument("--check-loss", action="store_true",
+                    help="exit nonzero unless the final global model beats the init loss")
+    ap.add_argument("--epochs", type=int, default=1, help="local epochs per round")
+    ap.add_argument("--lr", type=float, default=1e-3, help="local Adam learning rate")
     args = ap.parse_args()
 
-    template = lstm.init_params(jax.random.PRNGKey(0), seq_len=SEQ_LEN, hidden=HIDDEN)
+    hidden, seq_len = args.hidden, args.seq_len
+    template = lstm.init_params(jax.random.PRNGKey(0), seq_len=seq_len, hidden=hidden)
     model_len = model_length(template)
     n_sum, n_update = 1, max(3, args.participants - 1)
     print(f"char-LSTM: {model_len} parameters (bounded M3 mask config)")
@@ -106,7 +113,7 @@ def main():
     def sync(coro):
         return asyncio.run(coro)
 
-    shared_step = lstm.make_train_step(hidden=HIDDEN)
+    shared_step = lstm.make_train_step(hidden=hidden, learning_rate=args.lr)
     threads = []
     last_seed = None
     for round_no in range(1, args.rounds + 1):
@@ -120,11 +127,11 @@ def main():
         def kwargs(i):
             return dict(
                 init_params_fn=lambda: lstm.init_params(
-                    jax.random.PRNGKey(1), seq_len=SEQ_LEN, hidden=HIDDEN
+                    jax.random.PRNGKey(1), seq_len=seq_len, hidden=hidden
                 ),
                 make_step=lambda: shared_step,
-                data=synthetic_shards(i),
-                epochs=1,
+                data=synthetic_shards(i, seq_len=seq_len),
+                epochs=args.epochs,
                 batch_size=16,
             )
 
@@ -155,6 +162,19 @@ def main():
 
     for t in threads:
         t.stop()
+
+    if args.check_loss:
+        from eval_check import require_loss_improved
+
+        model_obj, _, _ = shared_step
+        # the federated average must at least fit the participating shards
+        require_loss_improved(
+            model_obj,
+            template,
+            lstm.init_params(jax.random.PRNGKey(1), seq_len=seq_len, hidden=hidden),
+            model,
+            [synthetic_shards(i, seq_len=seq_len) for i in range(n_update)],
+        )
 
 
 if __name__ == "__main__":

@@ -84,11 +84,14 @@ def main():
     ap.add_argument("-n", type=int, default=20, help="participants per round")
     ap.add_argument("-l", type=int, default=1000, help="model length")
     ap.add_argument("-r", type=int, default=3, help="rounds")
+    ap.add_argument("--url", default=None,
+                    help="drive an EXISTING coordinator (e.g. the docker-compose stack) "
+                         "instead of starting one in-process; -l and -n must match its config")
     args = ap.parse_args()
 
     n_sum = max(1, args.n // 10)
     n_update = max(3, args.n - n_sum)
-    url = start_coordinator(args.l, n_sum, n_update)
+    url = args.url or start_coordinator(args.l, n_sum, n_update)
     probe = HttpClient(url)
     print(f"coordinator at {url}: {n_sum} sum + {n_update} update participants/round")
 
@@ -106,10 +109,10 @@ def main():
         seed = params.seed.as_bytes()
 
         for i in range(n_sum):
-            keys = keys_for_task(seed, 0.3, 0.6, "sum", start=i * 1000)
+            keys = keys_for_task(seed, params.sum, params.update, "sum", start=i * 1000)
             threads.append(spawn_participant(url, DummyTrainer, args=(args.l,), keys=keys))
         for i in range(n_update):
-            keys = keys_for_task(seed, 0.3, 0.6, "update", start=(1000 + i) * 1000)
+            keys = keys_for_task(seed, params.sum, params.update, "update", start=(1000 + i) * 1000)
             threads.append(
                 spawn_participant(
                     url, DummyTrainer, args=(args.l,), scalar=Fraction(1, n_update), keys=keys

@@ -1,0 +1,102 @@
+"""The deploy artifacts wire up real, working configuration.
+
+Docker cannot run in the build image, so these tests verify the composed
+stack the honest way available: feed the EXACT environment from
+``deploy/docker-compose.yml`` into ``Settings.load`` and assert the runner
+would build the Redis dictionary storage, the S3 model storage and the
+Influx metrics sink from it. An env-var typo in the compose file (or a
+renamed settings key) fails here.
+"""
+
+import os
+
+import yaml
+
+from xaynet_tpu.server.runner import init_metrics, init_store
+from xaynet_tpu.server.settings import Settings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPOSE = os.path.join(REPO, "deploy", "docker-compose.yml")
+
+
+def _compose_env(service: str) -> dict:
+    with open(COMPOSE) as f:
+        doc = yaml.safe_load(f)
+    env = doc["services"][service]["environment"]
+    assert isinstance(env, dict)
+    return {k: str(v) for k, v in env.items()}
+
+
+def test_full_stack_env_builds_redis_s3_influx():
+    settings = Settings.load(path=None, env=_compose_env("coordinator-full"))
+
+    assert settings.storage.coordinator == "redis"
+    assert settings.storage.redis_host == "redis"
+    assert settings.storage.redis_port == 6379
+    assert settings.storage.backend == "s3"
+    assert settings.storage.s3_endpoint == "http://minio:9000"
+    assert settings.storage.s3_bucket == "global-models"
+    assert settings.metrics.enable and settings.metrics.sink == "influx-http"
+    assert settings.metrics.url == "http://influxdb:8086"
+    assert settings.restore.enable
+
+    # the smoke drive's contract: 2 sum + 18 update participants, len 1000
+    assert settings.pet.sum.count.min == settings.pet.sum.count.max == 2
+    assert settings.pet.update.count.min == settings.pet.update.count.max == 18
+    assert settings.pet.sum2.count.min == settings.pet.sum2.count.max == 2
+    assert settings.model.length == 1000
+
+    store = init_store(settings)
+    from xaynet_tpu.storage.redis import RedisCoordinatorStorage
+    from xaynet_tpu.storage.s3 import S3ModelStorage
+
+    assert isinstance(store.coordinator, RedisCoordinatorStorage)
+    assert isinstance(store.models, S3ModelStorage)
+
+    from xaynet_tpu.server.metrics import InfluxHttpMetrics
+
+    assert isinstance(init_metrics(settings), InfluxHttpMetrics)
+
+
+def test_default_service_env_builds_filesystem_jsonl():
+    settings = Settings.load(path=None, env=_compose_env("coordinator"))
+    assert settings.storage.backend == "filesystem"
+    assert settings.metrics.sink == "jsonl"
+    assert settings.restore.enable
+
+    store = init_store(settings)
+    from xaynet_tpu.storage.memory import FilesystemModelStorage
+
+    assert isinstance(store.models, FilesystemModelStorage)
+
+
+def test_k8s_full_overlay_env_matches_settings_keys():
+    """Every XAYNET__* env var in the k8s overlays must resolve to a real
+    settings key (guard against renames drifting the manifests)."""
+    import glob
+
+    baseline = Settings.load(path=None, env={})
+    for manifest in glob.glob(os.path.join(REPO, "deploy", "k8s", "**", "*.yaml"), recursive=True):
+        for doc in yaml.safe_load_all(open(manifest)):
+            if not doc or doc.get("kind") != "Deployment":
+                continue
+            for container in doc["spec"]["template"]["spec"].get("containers", []):
+                env_list = container.get("env", [])
+                env = {
+                    e["name"]: str(e.get("value", "x"))
+                    for e in env_list
+                    if e["name"].startswith("XAYNET__")
+                }
+                if not env:
+                    continue
+                loaded = Settings.load(path=None, env=env)
+                for name in env:
+                    # resolve XAYNET__SECTION__KEY on the loaded settings;
+                    # an unknown key would leave the default untouched AND
+                    # not exist as an attribute path
+                    node = loaded
+                    parts = [p.lower() for p in name.split("__")[1:]]
+                    for part in parts:
+                        assert hasattr(node, part), f"{manifest}: {name} has no settings field"
+                        node = getattr(node, part)
+    assert baseline.storage.backend == "memory"  # library default unchanged

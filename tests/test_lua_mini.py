@@ -276,3 +276,9 @@ def test_mutated_script_fails_to_parse():
     broken = ADD_LOCAL_SEED_DICT.replace(b"then", b"thn", 1)
     with pytest.raises(LuaError):
         lua_mini.parse(broken)
+
+
+def test_error_reply_raises_and_status_reply_passes():
+    with pytest.raises(LuaError, match="wrong state"):
+        run('return redis.error_reply("wrong state")')
+    assert run('return redis.status_reply("OK")') == b"OK"

@@ -72,7 +72,12 @@ def _settings() -> Settings:
     return s
 
 
-async def _run_round(settings: Settings, n_rounds: int = 1):
+async def _run_round(
+    settings: Settings,
+    n_rounds: int = 1,
+    sum_pet_kwargs: dict | None = None,
+    raise_in_drive: bool = False,
+):
     store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
     init = StateMachineInitializer(settings, store)
     machine, request_tx, events = await init.init()
@@ -91,20 +96,21 @@ async def _run_round(settings: Settings, n_rounds: int = 1):
             params = fetcher.round_params()
             seed = params.seed.as_bytes()
 
+            model_len = settings.model.length
             rng = np.random.default_rng(42 + round_no)
             participants = []
-            expected = np.zeros(MODEL_LEN)
+            expected = np.zeros(model_len)
             for i in range(N_SUM):
                 keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
                 sm = ParticipantSM(
-                    PetSettings(keys=keys),
+                    PetSettings(keys=keys, **(sum_pet_kwargs or {})),
                     InProcessClient(fetcher, handler),
                     ArrayModelStore(None),
                 )
                 participants.append(sm)
             for i in range(N_UPDATE):
                 keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(10 + i) * 1000)
-                local = rng.uniform(-1, 1, MODEL_LEN).astype(np.float32)
+                local = rng.uniform(-1, 1, model_len).astype(np.float32)
                 expected += local.astype(np.float64) / N_UPDATE
                 sm = ParticipantSM(
                     PetSettings(keys=keys, scalar=Fraction(1, N_UPDATE)),
@@ -118,7 +124,8 @@ async def _run_round(settings: Settings, n_rounds: int = 1):
                     try:
                         await sm.transition()
                     except Exception:
-                        pass
+                        if raise_in_drive:
+                            raise
                     if fetcher.model() is not None and sm.phase.value == "awaiting":
                         return
                     await asyncio.sleep(0.01)
@@ -295,3 +302,57 @@ def test_sum_participant_save_restore_mid_round():
                 pass
 
     asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_round_with_device_sum2_strict(monkeypatch):
+    """Full federated round with Sum2 on the JAX device path, strict.
+
+    The model length equals the real ``DEVICE_SUM2_THRESHOLD`` (no
+    threshold fudging), ``device_sum2_strict`` turns the silent
+    warn-and-fallback into a hard failure, and a spy proves the device
+    kernel actually ran for every sum participant (VERDICT r02 item 6).
+    """
+    from xaynet_tpu.ops import masking_jax
+
+    length = ParticipantSM.DEVICE_SUM2_THRESHOLD  # 262,144
+    calls = []
+    real = masking_jax.sum_masks
+
+    def spy(seeds, n, config):
+        calls.append((len(seeds), n))
+        return real(seeds, n, config)
+
+    s = _settings()
+    s.model.length = length
+    # headroom for the first-run jit compile of the derivation kernel
+    s.pet.update.time = TimeSettings(min=0.0, max=90.0)
+    s.pet.sum2.time = TimeSettings(min=0.0, max=90.0)
+
+    # warm the jit cache at the exact shapes the round will use (before the
+    # spy is installed), so the in-round sum2 leg measures the protocol,
+    # not XLA compilation
+    cfg = s.mask.to_config()
+    masking_jax.sum_masks([b"\x11" * 32], length, cfg.pair())
+
+    monkeypatch.setattr(masking_jax, "sum_masks", spy)
+
+    models = asyncio.run(
+        asyncio.wait_for(
+            _run_round(
+                s,
+                sum_pet_kwargs={
+                    "device_sum2": True,
+                    "device_sum2_strict": True,
+                    "max_message_size": None,  # single-message sends
+                },
+                raise_in_drive=True,
+            ),
+            timeout=240,
+        )
+    )
+    got, expected = models[0]
+    assert got.shape == (length,)
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+    # both sum participants took the device path over all update seeds
+    assert len(calls) == N_SUM
+    assert all(c == (N_UPDATE, length) for c in calls)

@@ -44,12 +44,15 @@ def log(line: str) -> None:
         f.write(stamped + "\n")
 
 
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
 def probe(timeout: float) -> bool:
     """One accelerator probe, sharing bench.py's detection contract."""
     import contextlib
     import io
 
-    sys.path.insert(0, REPO)
     from bench import _device_probe_ok
 
     detail = io.StringIO()
@@ -105,6 +108,11 @@ def main() -> int:
     ap.add_argument("--max-hours", type=float, default=11.0)
     ap.add_argument("--once", action="store_true", help="probe once and exit")
     args = ap.parse_args()
+
+    # the probe/capture subprocesses must let the accelerator plugin claim
+    # the backend — a forced-cpu JAX_PLATFORMS inherited from the operator's
+    # shell would make every probe report 'cpu' forever
+    os.environ.pop("JAX_PLATFORMS", None)
 
     deadline = time.time() + args.max_hours * 3600
     attempt = 0

@@ -67,6 +67,10 @@ class PetSettings:
     # (kept explicit — initializing an accelerator backend inside an edge
     # participant must be the embedder's decision)
     device_sum2: bool = False
+    # when the device path is requested, fail loudly instead of silently
+    # falling back to the host path (tests set this so a broken device
+    # kernel cannot hide behind the fallback)
+    device_sum2_strict: bool = False
 
     def __post_init__(self):
         if self.max_message_size is not None and self.max_message_size < MIN_MESSAGE_SIZE:
@@ -115,6 +119,7 @@ class StateMachine:
         self.scalar = settings.scalar
         self.max_message_size = settings.max_message_size
         self.device_sum2 = settings.device_sum2
+        self.device_sum2_strict = settings.device_sum2_strict
         self.client = client
         self.model_store = model_store
         self.notify = notify or Notify()
@@ -284,6 +289,8 @@ class StateMachine:
                     MaskUnit(config.unit, unit),
                 )
             except Exception:
+                if self.device_sum2_strict:
+                    raise
                 logger.warning("device mask aggregation failed; using host path", exc_info=True)
         # mask derivations are independent per seed and the native sampler
         # releases the GIL, so they parallelize across threads
@@ -352,6 +359,7 @@ class StateMachine:
             "scalar": [self.scalar.numerator, self.scalar.denominator],
             "max_message_size": self.max_message_size,
             "device_sum2": self.device_sum2,
+            "device_sum2_strict": self.device_sum2_strict,
             "phase": self.phase.value,
             "task": self.task.value,
             "sum_signature": self.sum_signature.hex() if self.sum_signature else None,
@@ -389,6 +397,7 @@ class StateMachine:
             scalar=Fraction(*d["scalar"]),
             max_message_size=d["max_message_size"],
             device_sum2=bool(d.get("device_sum2", False)),
+            device_sum2_strict=bool(d.get("device_sum2_strict", False)),
         )
         machine = cls(settings, client, model_store, notify)
         machine.phase = PhaseKind(d["phase"])

@@ -63,6 +63,13 @@ def init_store(settings: Settings) -> Store:
             region=settings.storage.s3_region,
         )
     else:
+        # memory archives EVERY round's model in RAM (a slow leak in a
+        # long-running coordinator) — fine for tests/benches, wrong for
+        # production; configs/config.toml documents filesystem as default
+        logging.getLogger("xaynet.runner").warning(
+            "model storage backend 'memory' keeps all round models in RAM; "
+            "use [storage] backend = \"filesystem\" in production"
+        )
         models = InMemoryModelStorage()
     return Store(coordinator, models, NoOpTrustAnchor())
 

@@ -384,6 +384,20 @@ class _Return(Exception):
         self.value = value
 
 
+class LuaErrorReply:
+    """``redis.error_reply(msg)``: converted to a RESP error on return."""
+
+    def __init__(self, message: bytes):
+        self.message = message
+
+
+class LuaStatusReply:
+    """``redis.status_reply(msg)``: converted to a RESP status on return."""
+
+    def __init__(self, message: bytes):
+        self.message = message
+
+
 class LuaTable:
     """A Lua array-style table (1-based)."""
 
@@ -681,7 +695,16 @@ def _from_redis(value):
 
 
 def to_redis(value):
-    """Lua value -> RESP reply (Redis EVAL conversion rules)."""
+    """Lua value -> RESP reply (Redis EVAL conversion rules).
+
+    An error reply raises ``LuaError`` so the RESP layer sends a ``-ERR``;
+    a status reply becomes its message (the fake encodes bytes as bulk,
+    which the client reads equivalently to a simple status here).
+    """
+    if isinstance(value, LuaErrorReply):
+        raise LuaError(value.message.decode(errors="replace"))
+    if isinstance(value, LuaStatusReply):
+        return value.message
     if value is None or value is False:
         return None
     if value is True:
@@ -760,8 +783,8 @@ def run_script(
             "redis": {
                 "call": lua_call,
                 "pcall": lua_call,
-                "error_reply": lambda msg: LuaTable([msg]),
-                "status_reply": lambda msg: LuaTable([msg]),
+                "error_reply": lambda msg: LuaErrorReply(msg),
+                "status_reply": lambda msg: LuaStatusReply(msg),
             },
             "tonumber": lua_tonumber,
             "tostring": lua_tostring,
