@@ -20,7 +20,7 @@ trap cleanup EXIT
 
 echo "waiting for the coordinator to answer /params ..."
 for i in $(seq 1 60); do
-  if curl -fsS -o /dev/null http://127.0.0.1:8081/params; then
+  if curl -fsS -o /dev/null http://127.0.0.1:8082/params; then
     break
   fi
   [ "$i" = 60 ] && { echo "coordinator never came up"; "${COMPOSE[@]}" logs coordinator-full | tail -50; exit 1; }
@@ -28,10 +28,10 @@ for i in $(seq 1 60); do
 done
 
 # -n/-l must match the coordinator-full PET window + model length env
-JAX_PLATFORMS=cpu python examples/test_drive.py --url http://127.0.0.1:8081 -n 20 -l 1000 -r "$ROUNDS"
+JAX_PLATFORMS=cpu python examples/test_drive.py --url http://127.0.0.1:8082 -n 20 -l 1000 -r "$ROUNDS"
 
 echo "checking metrics landed in influxdb ..."
-docker compose -f deploy/docker-compose.yml --profile full exec -T influxdb \
+"${COMPOSE[@]}" exec -T influxdb \
   influx -database metrics -execute 'SHOW MEASUREMENTS' | head -20 || true
 
 echo "compose smoke OK"

@@ -1,0 +1,136 @@
+/* End-to-end native participant over the built-in HTTP transport.
+ *
+ * Usage: http_demo <host> <port> <signing_seed_hex64> <model_len> [value]
+ *
+ * Completes a PET round against a live coordinator with NO embedder
+ * transport code and NO Python anywhere on the client side — the parity
+ * demo for the reference's reqwest-backed mobile client
+ * (rust/xaynet-mobile/src/reqwest_client.rs + examples).
+ *
+ * Ticks the FSM; when selected as an update participant it submits a
+ * constant model [value, value, ...]; prints one line per state change and
+ * "global-model n=<len> first=<v>" once the new global model arrives
+ * (consumed by tests/test_native_participant.py).
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+typedef struct {
+  uint8_t* data;
+  uint64_t len;
+} XnBuffer;
+typedef int (*xn_transport_fn)(void* user, const char* request, const uint8_t* body,
+                               uint64_t body_len, XnBuffer* out);
+
+/* libxaynet_participant.so */
+extern int xaynet_ffi_crypto_init(void);
+extern void* xaynet_ffi_participant_new(const uint8_t signing_seed[32], int64_t scalar_num,
+                                        int64_t scalar_den, uint32_t max_message_size,
+                                        xn_transport_fn transport, void* user);
+extern int xaynet_ffi_participant_tick(void* p);
+extern int xaynet_ffi_participant_task(void* p);
+extern int xaynet_ffi_participant_should_set_model(void* p);
+extern int xaynet_ffi_participant_set_model(void* p, const float* data, uint64_t len);
+extern int64_t xaynet_ffi_participant_global_model(void* p, const double** out);
+extern void xaynet_ffi_participant_destroy(void* p);
+
+/* libxaynet_http_transport.so */
+typedef struct XnHttpClient XnHttpClient;
+extern XnHttpClient* xn_http_client_new(const char* host, uint16_t port);
+extern void xn_http_client_free(XnHttpClient* c);
+extern int xn_http_transport(void* user, const char* request, const uint8_t* body,
+                             uint64_t body_len, XnBuffer* out);
+
+static int hex_nibble(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <host> <port> <signing_seed_hex64> <model_len> [value]\n", argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  uint16_t port = (uint16_t)atoi(argv[2]);
+  uint64_t model_len = (uint64_t)strtoull(argv[4], NULL, 10);
+  float value = argc > 5 ? (float)atof(argv[5]) : 0.5f;
+
+  uint8_t seed[32];
+  if (strlen(argv[3]) != 64) {
+    fprintf(stderr, "signing seed must be 64 hex chars\n");
+    return 2;
+  }
+  for (int i = 0; i < 32; i++) {
+    int hi = hex_nibble(argv[3][2 * i]), lo = hex_nibble(argv[3][2 * i + 1]);
+    if (hi < 0 || lo < 0) {
+      fprintf(stderr, "bad hex in signing seed\n");
+      return 2;
+    }
+    seed[i] = (uint8_t)((hi << 4) | lo);
+  }
+
+  if (xaynet_ffi_crypto_init() != 0) {
+    fprintf(stderr, "crypto init failed\n");
+    return 1;
+  }
+  XnHttpClient* http = xn_http_client_new(host, port);
+  if (!http) {
+    fprintf(stderr, "http client alloc failed\n");
+    return 1;
+  }
+  /* scalar 1/3: the smoke round runs 3 update participants */
+  void* p = xaynet_ffi_participant_new(seed, 1, 3, 4096, xn_http_transport, http);
+  if (!p) {
+    fprintf(stderr, "participant_new failed\n");
+    return 1;
+  }
+
+  float* model = (float*)malloc(model_len * sizeof(float));
+  for (uint64_t i = 0; i < model_len; i++) model[i] = value;
+
+  int last_task = -1;
+  for (int i = 0; i < 600; i++) {
+    int rc = xaynet_ffi_participant_tick(p);
+    if (rc < 0 && rc != -2 /* transport errors are transient: keep polling */) {
+      fprintf(stderr, "fatal tick error %d\n", rc);
+      free(model);
+      xaynet_ffi_participant_destroy(p);
+      xn_http_client_free(http);
+      return 1;
+    }
+    int task = xaynet_ffi_participant_task(p);
+    if (task != last_task) {
+      printf("task=%d\n", task);
+      fflush(stdout);
+      last_task = task;
+    }
+    if (xaynet_ffi_participant_should_set_model(p)) {
+      if (xaynet_ffi_participant_set_model(p, model, model_len) != 0) {
+        fprintf(stderr, "set_model failed\n");
+        return 1;
+      }
+      printf("model-set n=%llu\n", (unsigned long long)model_len);
+      fflush(stdout);
+    }
+    const double* global = NULL;
+    int64_t n = xaynet_ffi_participant_global_model(p, &global);
+    if (n > 0 && global) {
+      printf("global-model n=%lld first=%.6f\n", (long long)n, global[0]);
+      fflush(stdout);
+      free(model);
+      xaynet_ffi_participant_destroy(p);
+      xn_http_client_free(http);
+      return 0;
+    }
+    usleep(100000); /* 100ms poll cadence */
+  }
+  fprintf(stderr, "no global model within the tick budget\n");
+  return 1;
+}

@@ -1,0 +1,181 @@
+/* Built-in HTTP/1.1 transport for the interpreter-free native participant.
+ *
+ * Parity with the reference's bundled client
+ * (rust/xaynet-mobile/src/reqwest_client.rs): an embedder links this file
+ * (or libxaynet_http_transport.so) and passes `xn_http_transport` +
+ * `xn_http_client_new(host, port)` straight into
+ * `xaynet_ffi_participant_new` — no caller-written transport required.
+ *
+ * Plain POSIX sockets, one request per connection (`Connection: close`),
+ * no third-party dependencies. TLS termination is expected at a proxy /
+ * sidecar, as in the k8s development overlay (deploy/k8s/.../ingress.yaml).
+ *
+ * Contract (native/xaynet_participant.cpp:745-753): `request` is
+ * "METHOD /path", the body is sent for POSTs; return 0 on HTTP 200 with a
+ * malloc'd body in *out (the participant library frees it), 1 on 204/empty,
+ * negative on transport failure.
+ */
+
+#include <errno.h>
+#include <netdb.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+typedef struct {
+  uint8_t* data;
+  uint64_t len;
+} XnBuffer;
+
+typedef struct {
+  char host[256];
+  char port[16];
+} XnHttpClient;
+
+XnHttpClient* xn_http_client_new(const char* host, uint16_t port) {
+  if (!host || strlen(host) >= sizeof(((XnHttpClient*)0)->host)) return NULL;
+  XnHttpClient* c = (XnHttpClient*)calloc(1, sizeof(XnHttpClient));
+  if (!c) return NULL;
+  snprintf(c->host, sizeof(c->host), "%s", host);
+  snprintf(c->port, sizeof(c->port), "%u", (unsigned)port);
+  return c;
+}
+
+void xn_http_client_free(XnHttpClient* c) { free(c); }
+
+static int xn_connect(const XnHttpClient* c) {
+  struct addrinfo hints, *res = NULL, *ai;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(c->host, c->port, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+static int xn_write_all(int fd, const void* buf, size_t len) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (len) {
+    ssize_t n = write(fd, p, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += n;
+    len -= (size_t)n;
+  }
+  return 0;
+}
+
+/* Read the whole response (Connection: close => until EOF). */
+static int xn_read_all(int fd, uint8_t** out, size_t* out_len) {
+  size_t cap = 8192, len = 0;
+  uint8_t* buf = (uint8_t*)malloc(cap);
+  if (!buf) return -1;
+  for (;;) {
+    if (len == cap) {
+      cap *= 2;
+      uint8_t* next = (uint8_t*)realloc(buf, cap);
+      if (!next) {
+        free(buf);
+        return -1;
+      }
+      buf = next;
+    }
+    ssize_t n = read(fd, buf + len, cap - len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      free(buf);
+      return -1;
+    }
+    if (n == 0) break;
+    len += (size_t)n;
+  }
+  *out = buf;
+  *out_len = len;
+  return 0;
+}
+
+int xn_http_transport(void* user, const char* request, const uint8_t* body,
+                      uint64_t body_len, XnBuffer* out) {
+  const XnHttpClient* c = (const XnHttpClient*)user;
+  if (!c || !request || !out) return -1;
+  out->data = NULL;
+  out->len = 0;
+
+  const char* space = strchr(request, ' ');
+  if (!space || strlen(space + 1) == 0) return -1;
+  size_t method_len = (size_t)(space - request);
+  const char* path = space + 1;
+
+  int fd = xn_connect(c);
+  if (fd < 0) return -2;
+
+  char header[1024];
+  int hn = snprintf(header, sizeof(header),
+                    "%.*s %s HTTP/1.1\r\n"
+                    "Host: %s:%s\r\n"
+                    "Connection: close\r\n"
+                    "Content-Length: %llu\r\n"
+                    "\r\n",
+                    (int)method_len, request, path, c->host, c->port,
+                    (unsigned long long)body_len);
+  if (hn <= 0 || (size_t)hn >= sizeof(header) || xn_write_all(fd, header, (size_t)hn) != 0 ||
+      (body_len && xn_write_all(fd, body, body_len) != 0)) {
+    close(fd);
+    return -2;
+  }
+
+  uint8_t* resp = NULL;
+  size_t resp_len = 0;
+  int rr = xn_read_all(fd, &resp, &resp_len);
+  close(fd);
+  if (rr != 0) return -2;
+
+  /* status line: "HTTP/1.1 NNN ..." */
+  int status = 0;
+  if (resp_len > 12 && memcmp(resp, "HTTP/1.", 7) == 0) status = atoi((const char*)resp + 9);
+
+  /* locate the header/body split */
+  const uint8_t* body_start = NULL;
+  for (size_t i = 0; i + 3 < resp_len; i++) {
+    if (resp[i] == '\r' && resp[i + 1] == '\n' && resp[i + 2] == '\r' && resp[i + 3] == '\n') {
+      body_start = resp + i + 4;
+      break;
+    }
+  }
+  if (!body_start || status == 0) {
+    free(resp);
+    return -3;
+  }
+  size_t content_len = resp_len - (size_t)(body_start - resp);
+
+  if (status == 204 || (status == 200 && content_len == 0)) {
+    free(resp);
+    return 1;
+  }
+  if (status != 200) {
+    free(resp);
+    return -status;
+  }
+  out->data = (uint8_t*)malloc(content_len ? content_len : 1);
+  if (!out->data) {
+    free(resp);
+    return -1;
+  }
+  memcpy(out->data, body_start, content_len);
+  out->len = content_len;
+  free(resp);
+  return 0;
+}

@@ -9,7 +9,8 @@
 // against libsodium for Ed25519/X25519/ChaCha20-Poly1305 (the reference
 // links the same library through sodiumoxide).
 //
-// Transport is caller-provided (one callback receiving "GET /params",
+// Transport is a callback (bundled HTTP client: xaynet_http_transport.c;
+// or caller-provided — one callback receiving "GET /params",
 // "POST /message", ... and returning the response bytes), which keeps the
 // library free of any network stack — the right shape for constrained
 // edge targets; the embedding app brings its own HTTP/TLS.
