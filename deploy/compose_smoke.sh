@@ -11,7 +11,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ROUNDS="${1:-2}"
-COMPOSE=(docker compose -f deploy/docker-compose.yml --profile full)
+# a dedicated project name namespaces containers AND volumes away from any
+# standing deployment: the cleanup's `down -v` can only remove smoke state
+COMPOSE=(docker compose -p xaynet-smoke -f deploy/docker-compose.yml --profile full)
 
 cleanup() { "${COMPOSE[@]}" down -v; }
 trap cleanup EXIT

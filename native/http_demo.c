@@ -19,31 +19,7 @@
 #include <string.h>
 #include <unistd.h>
 
-typedef struct {
-  uint8_t* data;
-  uint64_t len;
-} XnBuffer;
-typedef int (*xn_transport_fn)(void* user, const char* request, const uint8_t* body,
-                               uint64_t body_len, XnBuffer* out);
-
-/* libxaynet_participant.so */
-extern int xaynet_ffi_crypto_init(void);
-extern void* xaynet_ffi_participant_new(const uint8_t signing_seed[32], int64_t scalar_num,
-                                        int64_t scalar_den, uint32_t max_message_size,
-                                        xn_transport_fn transport, void* user);
-extern int xaynet_ffi_participant_tick(void* p);
-extern int xaynet_ffi_participant_task(void* p);
-extern int xaynet_ffi_participant_should_set_model(void* p);
-extern int xaynet_ffi_participant_set_model(void* p, const float* data, uint64_t len);
-extern int64_t xaynet_ffi_participant_global_model(void* p, const double** out);
-extern void xaynet_ffi_participant_destroy(void* p);
-
-/* libxaynet_http_transport.so */
-typedef struct XnHttpClient XnHttpClient;
-extern XnHttpClient* xn_http_client_new(const char* host, uint16_t port);
-extern void xn_http_client_free(XnHttpClient* c);
-extern int xn_http_transport(void* user, const char* request, const uint8_t* body,
-                             uint64_t body_len, XnBuffer* out);
+#include "xaynet_participant.h"
 
 static int hex_nibble(char c) {
   if (c >= '0' && c <= '9') return c - '0';

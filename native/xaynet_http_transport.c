@@ -16,6 +16,7 @@
  * negative on transport failure.
  */
 
+#include <ctype.h>
 #include <errno.h>
 #include <netdb.h>
 #include <stdint.h>
@@ -25,15 +26,12 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
-typedef struct {
-  uint8_t* data;
-  uint64_t len;
-} XnBuffer;
+#include "xaynet_participant.h"
 
-typedef struct {
+struct XnHttpClient {
   char host[256];
   char port[16];
-} XnHttpClient;
+};
 
 XnHttpClient* xn_http_client_new(const char* host, uint16_t port) {
   if (!host || strlen(host) >= sizeof(((XnHttpClient*)0)->host)) return NULL;
@@ -78,15 +76,16 @@ static int xn_write_all(int fd, const void* buf, size_t len) {
   return 0;
 }
 
-/* Read the whole response (Connection: close => until EOF). */
+/* Read the whole response (Connection: close => until EOF); the buffer is
+ * NUL-terminated one past `*out_len` so bounded string scans are safe. */
 static int xn_read_all(int fd, uint8_t** out, size_t* out_len) {
   size_t cap = 8192, len = 0;
-  uint8_t* buf = (uint8_t*)malloc(cap);
+  uint8_t* buf = (uint8_t*)malloc(cap + 1);
   if (!buf) return -1;
   for (;;) {
     if (len == cap) {
       cap *= 2;
-      uint8_t* next = (uint8_t*)realloc(buf, cap);
+      uint8_t* next = (uint8_t*)realloc(buf, cap + 1);
       if (!next) {
         free(buf);
         return -1;
@@ -102,9 +101,67 @@ static int xn_read_all(int fd, uint8_t** out, size_t* out_len) {
     if (n == 0) break;
     len += (size_t)n;
   }
+  buf[len] = 0;
   *out = buf;
   *out_len = len;
   return 0;
+}
+
+/* Case-insensitive header lookup inside [headers, headers_end). Returns the
+ * value start (past ':' and spaces) or NULL. */
+static const char* xn_find_header(const char* headers, const char* headers_end,
+                                  const char* name) {
+  size_t name_len = strlen(name);
+  const char* line = headers;
+  while (line < headers_end) {
+    const char* eol = strstr(line, "\r\n");
+    if (!eol || eol > headers_end) eol = headers_end;
+    if ((size_t)(eol - line) > name_len && line[name_len] == ':' &&
+        strncasecmp(line, name, name_len) == 0) {
+      const char* v = line + name_len + 1;
+      while (v < eol && (*v == ' ' || *v == '\t')) v++;
+      return v;
+    }
+    line = eol + 2;
+  }
+  return NULL;
+}
+
+/* De-chunk a Transfer-Encoding: chunked body in place into a fresh buffer.
+ * Returns 0 and fills out/out_len, or -1 on framing errors. */
+static int xn_dechunk(const uint8_t* body, size_t body_len, uint8_t** out, size_t* out_len) {
+  uint8_t* acc = (uint8_t*)malloc(body_len ? body_len : 1);
+  if (!acc) return -1;
+  size_t acc_len = 0, i = 0;
+  for (;;) {
+    /* chunk-size line (hex, optional extensions after ';') */
+    size_t j = i;
+    size_t size = 0;
+    int digits = 0;
+    while (j < body_len && isxdigit(body[j])) {
+      int c = body[j];
+      size = size * 16 + (size_t)(c <= '9' ? c - '0' : (c | 32) - 'a' + 10);
+      j++;
+      digits++;
+    }
+    if (!digits) goto fail;
+    while (j < body_len && body[j] != '\n') j++; /* skip extensions + CR */
+    if (j >= body_len) goto fail;
+    j++; /* past LF */
+    if (size == 0) break; /* terminal chunk */
+    if (j + size > body_len) goto fail;
+    memcpy(acc + acc_len, body + j, size);
+    acc_len += size;
+    i = j + size;
+    if (i + 2 <= body_len && body[i] == '\r' && body[i + 1] == '\n') i += 2;
+    else goto fail;
+  }
+  *out = acc;
+  *out_len = acc_len;
+  return 0;
+fail:
+  free(acc);
+  return -1;
 }
 
 int xn_http_transport(void* user, const char* request, const uint8_t* body,
@@ -143,7 +200,7 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
   close(fd);
   if (rr != 0) return -2;
 
-  /* status line: "HTTP/1.1 NNN ..." */
+  /* status line: "HTTP/1.1 NNN ..." (xn_read_all NUL-terminates) */
   int status = 0;
   if (resp_len > 12 && memcmp(resp, "HTTP/1.", 7) == 0) status = atoi((const char*)resp + 9);
 
@@ -159,23 +216,45 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
     free(resp);
     return -3;
   }
-  size_t content_len = resp_len - (size_t)(body_start - resp);
+  const char* headers = (const char*)resp;
+  const char* headers_end = (const char*)body_start - 2;
+  size_t raw_len = resp_len - (size_t)(body_start - resp);
+
+  /* body framing: chunked (a proxy may re-frame), else Content-Length,
+   * else everything until EOF (Connection: close) */
+  uint8_t* body_buf = NULL;
+  size_t content_len = 0;
+  const char* te = xn_find_header(headers, headers_end, "Transfer-Encoding");
+  if (te && strncasecmp(te, "chunked", 7) == 0) {
+    if (xn_dechunk(body_start, raw_len, &body_buf, &content_len) != 0) {
+      free(resp);
+      return -3;
+    }
+  } else {
+    const char* cl = xn_find_header(headers, headers_end, "Content-Length");
+    content_len = cl ? (size_t)strtoull(cl, NULL, 10) : raw_len;
+    if (content_len > raw_len) { /* truncated response */
+      free(resp);
+      return -3;
+    }
+    body_buf = (uint8_t*)malloc(content_len ? content_len : 1);
+    if (!body_buf) {
+      free(resp);
+      return -1;
+    }
+    memcpy(body_buf, body_start, content_len);
+  }
+  free(resp);
 
   if (status == 204 || (status == 200 && content_len == 0)) {
-    free(resp);
+    free(body_buf);
     return 1;
   }
   if (status != 200) {
-    free(resp);
+    free(body_buf);
     return -status;
   }
-  out->data = (uint8_t*)malloc(content_len ? content_len : 1);
-  if (!out->data) {
-    free(resp);
-    return -1;
-  }
-  memcpy(out->data, body_start, content_len);
+  out->data = body_buf;
   out->len = content_len;
-  free(resp);
   return 0;
 }

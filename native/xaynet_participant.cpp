@@ -743,29 +743,9 @@ bool encode_and_seal(const uint8_t sk64[64], const uint8_t pk[32], const uint8_t
 // C API surface
 // --------------------------------------------------------------------------
 
-// transport callback: method+path in `request` ("GET /params",
-// "POST /message", "GET /seeds?pk=<hex>", "GET /model"), body for POSTs.
-// Returns 0 on 200 (fill *out with malloc'd bytes, the library frees),
-// 1 on 204/empty, negative on failure.
-typedef struct {
-  uint8_t* data;
-  uint64_t len;
-} XnBuffer;
-typedef int (*xn_transport_fn)(void* user, const char* request, const uint8_t* body,
-                               uint64_t body_len, XnBuffer* out);
-
-enum XnTask { XN_TASK_NONE = 0, XN_TASK_SUM = 1, XN_TASK_UPDATE = 2 };
-enum {
-  XN_OK = 0,
-  XN_ERR_NULL = -1,
-  XN_ERR_TRANSPORT = -2,
-  XN_ERR_PARSE = -3,
-  XN_ERR_CRYPTO = -4,
-  XN_ERR_STATE = -5,
-  XN_ERR_CONFIG = -6,
-  XN_ERR_MODEL = -7,
-  XN_ERR_RESTORE = -8,
-};
+// transport callback contract + exported prototypes live in the shared
+// header (single source of truth for the C ABI)
+#include "xaynet_participant.h"
 
 namespace {
 

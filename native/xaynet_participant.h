@@ -1,0 +1,86 @@
+/* Public C ABI of the interpreter-free native participant
+ * (libxaynet_participant.so) and the bundled HTTP transport
+ * (libxaynet_http_transport.so).
+ *
+ * The single source of truth for the transport callback contract and the
+ * exported prototypes — included by xaynet_participant.cpp,
+ * xaynet_http_transport.c and every embedder (http_demo.c), so an ABI
+ * change is a compile error everywhere instead of a silent runtime
+ * mismatch. Reference analogue: the cbindgen-generated header of
+ * rust/xaynet-mobile/src/ffi/.
+ */
+
+#ifndef XAYNET_PARTICIPANT_H
+#define XAYNET_PARTICIPANT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Transport callback: method+path in `request` ("GET /params",
+ * "POST /message", "GET /seeds?pk=<hex>", "GET /model"), body for POSTs.
+ * Returns 0 on HTTP 200 (fill *out with malloc'd bytes — the participant
+ * library frees them), 1 on 204/empty, negative on transport failure. */
+typedef struct {
+  uint8_t* data;
+  uint64_t len;
+} XnBuffer;
+typedef int (*xn_transport_fn)(void* user, const char* request, const uint8_t* body,
+                               uint64_t body_len, XnBuffer* out);
+
+enum XnTask { XN_TASK_NONE = 0, XN_TASK_SUM = 1, XN_TASK_UPDATE = 2 };
+enum {
+  XN_OK = 0,
+  XN_ERR_NULL = -1,
+  XN_ERR_TRANSPORT = -2,
+  XN_ERR_PARSE = -3,
+  XN_ERR_CRYPTO = -4,
+  XN_ERR_STATE = -5,
+  XN_ERR_CONFIG = -6,
+  XN_ERR_MODEL = -7,
+  XN_ERR_RESTORE = -8,
+};
+
+/* --- participant lifecycle (libxaynet_participant.so) ------------------- */
+uint32_t xaynet_ffi_abi_version(void);
+int xaynet_ffi_crypto_init(void);
+void* xaynet_ffi_participant_new(const uint8_t signing_seed[32], int64_t scalar_num,
+                                 int64_t scalar_den, uint32_t max_message_size,
+                                 xn_transport_fn transport, void* user);
+void* xaynet_ffi_participant_restore(const uint8_t* data, uint64_t len,
+                                     xn_transport_fn transport, void* user);
+void xaynet_ffi_participant_destroy(void* handle);
+int xaynet_ffi_participant_tick(void* handle);
+int xaynet_ffi_participant_task(void* handle);
+int xaynet_ffi_participant_made_progress(void* handle);
+int xaynet_ffi_participant_should_set_model(void* handle);
+int xaynet_ffi_participant_new_round(void* handle);
+int xaynet_ffi_participant_set_model(void* handle, const float* data, uint64_t len);
+int xaynet_ffi_participant_set_model_i64(void* handle, const int64_t* data, uint64_t len);
+int xaynet_ffi_participant_set_model_f64(void* handle, const double* data, uint64_t len);
+int64_t xaynet_ffi_participant_global_model(void* handle, const double** out);
+int xaynet_ffi_participant_save(void* handle, uint8_t** out, uint64_t* out_len);
+void xaynet_ffi_free(void* ptr);
+
+/* --- crypto helpers (cross-language interop tests) ---------------------- */
+int xaynet_ffi_seal(const uint8_t* msg, uint64_t len, const uint8_t pk[32], uint8_t* out,
+                    uint64_t* out_len);
+int xaynet_ffi_seal_open(const uint8_t* sealed, uint64_t len, const uint8_t sk[32], uint8_t* out,
+                         uint64_t* out_len);
+int xaynet_ffi_sign(const uint8_t seed[32], const uint8_t* msg, uint64_t len, uint8_t sig[64]);
+int xaynet_ffi_is_eligible(const uint8_t sig[64], double threshold);
+
+/* --- bundled HTTP/1.1 transport (libxaynet_http_transport.so) ----------- */
+typedef struct XnHttpClient XnHttpClient;
+XnHttpClient* xn_http_client_new(const char* host, uint16_t port);
+void xn_http_client_free(XnHttpClient* c);
+int xn_http_transport(void* user, const char* request, const uint8_t* body, uint64_t body_len,
+                      XnBuffer* out);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* XAYNET_PARTICIPANT_H */

@@ -715,3 +715,86 @@ def test_native_round_over_builtin_http_transport():
         assert abs(first - expected) < 1e-6
     # the three updaters each submitted a model
     assert sum("model-set" in o for o in outs) == 3
+
+
+def test_http_transport_handles_chunked_responses():
+    """A proxy may re-frame responses as Transfer-Encoding: chunked; the
+    bundled client must de-chunk (and honor Content-Length) correctly."""
+    import socket
+
+    subprocess.run(
+        ["make", "-s", "libxaynet_http_transport.so"],
+        cwd=_NATIVE_DIR,
+        check=True,
+        capture_output=True,
+    )
+    lib = ctypes.CDLL(os.path.join(_NATIVE_DIR, "libxaynet_http_transport.so"))
+    lib.xn_http_client_new.restype = ctypes.c_void_p
+    lib.xn_http_client_new.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.xn_http_transport.restype = ctypes.c_int
+    lib.xn_http_transport.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_uint64,
+        ctypes.POINTER(XnBuffer),
+    ]
+    lib.xn_http_client_free.argtypes = [ctypes.c_void_p]
+
+    payload = b"A" * 5 + b"B" * 7 + b"C" * 3
+    responses = {
+        b"GET /chunked": (
+            b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+            b"5\r\nAAAAA\r\n7;ext=1\r\nBBBBBBB\r\n3\r\nCCC\r\n0\r\n\r\n"
+        ),
+        b"GET /plain": (
+            b"HTTP/1.1 200 OK\r\nContent-Length: 15\r\n\r\n" + payload + b"TRAILING-JUNK"
+        ),
+        b"GET /empty": b"HTTP/1.1 204 No Content\r\nContent-Length: 0\r\n\r\n",
+        b"GET /boom": b"HTTP/1.1 500 Internal Server Error\r\nContent-Length: 0\r\n\r\n",
+    }
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+                first = data.split(b"\r\n", 1)[0]
+                key = b" ".join(first.split(b" ")[:2])
+                conn.sendall(responses.get(key, responses[b"GET /boom"]))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    client = lib.xn_http_client_new(b"127.0.0.1", port)
+    assert client
+
+    def call(req):
+        buf = XnBuffer(None, 0)
+        rc = lib.xn_http_transport(client, req, None, 0, ctypes.byref(buf))
+        data = ctypes.string_at(buf.data, buf.len) if buf.data else b""
+        return rc, data
+
+    rc, data = call(b"GET /chunked")
+    assert rc == 0 and data == payload  # extensions skipped, exact re-assembly
+    rc, data = call(b"GET /plain")
+    assert rc == 0 and data == payload  # Content-Length bounds the body
+    rc, _ = call(b"GET /empty")
+    assert rc == 1  # 204 -> empty
+    rc, _ = call(b"GET /boom")
+    assert rc == -500  # error status surfaces as negative
+    lib.xn_http_client_free(client)
+    srv.close()
