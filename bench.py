@@ -124,11 +124,18 @@ def main() -> None:
     host_stack = rng.integers(0, 2**32, size=(k, n_limb, model_len), dtype=np.uint32)
     host_stack[:, n_limb - 1, :] &= np.uint32((1 << 20) - 1)
     stack = jax.device_put(host_stack)
+    if not on_tpu:
+        host_stack_np = host_stack  # CPU: the native candidate reads it directly
     del host_stack
 
-    # candidate kernels: XLA fold and (on real accelerators) the Pallas fold
-    # at several tile sizes; calibrate quickly and measure with the fastest
-    candidates = {"xla": lambda a, s: fold_planar_batch(a, s, order)}
+    # candidate kernels: XLA fold, (on real accelerators) the Pallas fold at
+    # several tile sizes, and (on CPU) the native single-pass u64 fold;
+    # calibrate quickly and measure with the fastest. Each candidate carries
+    # its own initial-accumulator factory so host kernels run on numpy.
+    def _zero_acc_jax():
+        return jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+
+    candidates = {"xla": (lambda a, s: fold_planar_batch(a, s, order), _zero_acc_jax)}
     if on_tpu:
         try:
             from xaynet_tpu.ops.fold_pallas import fold_planar_batch_pallas
@@ -138,12 +145,27 @@ def main() -> None:
                 def _pallas(a, s, _t=tile):
                     return fold_planar_batch_pallas(a, s, order, tile_size=_t)
 
-                candidates[f"pallas-t{tile}"] = _pallas
+                candidates[f"pallas-t{tile}"] = (_pallas, _zero_acc_jax)
         except Exception:
             pass
+    else:
+        from xaynet_tpu.utils import native as native_lib
 
-    def calibrate(fn):
-        acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+        order_limbs = host_limbs.order_limbs_for(order)
+
+        def _native(a, s):
+            return host_limbs.fold_planar_batch_host(a, host_stack_np, order_limbs)
+
+        def _zero_acc_np():
+            return np.zeros((n_limb, model_len), dtype=np.uint32)
+
+        # only register when the C kernel is actually loadable — the label
+        # in the headline JSON must never claim 'native' for a numpy run
+        if native_lib.load() is not None:
+            candidates["native-u64"] = (_native, _zero_acc_np)
+
+    def calibrate(fn, make_acc):
+        acc = make_acc()
         acc = fn(acc, stack)  # compile
         _sync(acc)
         t0 = time.perf_counter()
@@ -153,16 +175,16 @@ def main() -> None:
         return time.perf_counter() - t0
 
     timings = {}
-    for name, fn in candidates.items():
+    for name, (fn, make_acc) in candidates.items():
         try:
-            timings[name] = calibrate(fn)
+            timings[name] = calibrate(fn, make_acc)
         except Exception as e:  # a kernel variant failing must not sink the bench
             print(f"kernel {name} unavailable: {type(e).__name__}: {e}", file=sys.stderr)
     best = min(timings, key=timings.get)
-    fold = candidates[best]
+    fold, make_acc = candidates[best]
     print(f"kernel selection: {timings} -> {best}", file=sys.stderr)
 
-    acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+    acc = make_acc()
     acc = fold(acc, stack)  # compile against the zeroed accumulator shape
     _sync(acc)
 

@@ -224,8 +224,17 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
    * else everything until EOF (Connection: close) */
   uint8_t* body_buf = NULL;
   size_t content_len = 0;
+  // chunked must be the FINAL coding (RFC 7230): search the value's tokens
   const char* te = xn_find_header(headers, headers_end, "Transfer-Encoding");
-  if (te && strncasecmp(te, "chunked", 7) == 0) {
+  int is_chunked = 0;
+  if (te) {
+    const char* eol = strstr(te, "\r\n");
+    const char* end = eol ? eol : headers_end;
+    const char* last = end;
+    while (last > te && (last[-1] == ' ' || last[-1] == '\t')) last--;
+    if (last - te >= 7 && strncasecmp(last - 7, "chunked", 7) == 0) is_chunked = 1;
+  }
+  if (is_chunked) {
     if (xn_dechunk(body_start, raw_len, &body_buf, &content_len) != 0) {
       free(resp);
       return -3;

@@ -282,6 +282,109 @@ XN_EXPORT void xn_mod_add(const uint32_t* a, const uint32_t* b, uint32_t* out,
   }
 }
 
+namespace {
+
+// Shared core of the single-pass u64 batch folds. `Wire` selects the data
+// layout: planar uint32[L, n] (limb-major) or wire uint32[n, L] (for L == 2
+// a wire row is one little-endian u64 — contiguous 8-byte loads). The
+// arithmetic — double-reciprocal quotient with two rounding fixups, u64
+// wraparound on pow2-boundary orders — lives exactly once here.
+template <bool Wire>
+void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
+                   uint32_t n_limbs, uint64_t k, const uint32_t* order_limbs) {
+  uint64_t order = 0;
+  for (uint32_t j = 0; j < n_limbs; j++) order |= (uint64_t)order_limbs[j] << (32 * j);
+  const bool pow2_boundary = order == 0;
+  const bool two_limbs = n_limbs == 2;
+  // quotient sum/order is tiny (< K+1): one double multiply approximates it
+  // to +-1 and two fixups make it exact — far cheaper than a u64 divide
+  const double inv_order = pow2_boundary ? 0.0 : 1.0 / (double)order;
+
+  const auto load2 = [n](const uint32_t* base, uint64_t s, uint64_t i) -> uint64_t {
+    if (Wire) {
+      const uint32_t* row = base + 2 * (s + i);
+      return (uint64_t)row[0] | ((uint64_t)row[1] << 32);
+    }
+    return (uint64_t)base[s + i] | ((uint64_t)base[n + s + i] << 32);
+  };
+  const auto store2 = [n](uint32_t* base, uint64_t s, uint64_t i, uint64_t v) {
+    if (Wire) {
+      base[2 * (s + i)] = (uint32_t)v;
+      base[2 * (s + i) + 1] = (uint32_t)(v >> 32);
+    } else {
+      base[s + i] = (uint32_t)v;
+      base[n + s + i] = (uint32_t)(v >> 32);
+    }
+  };
+
+  // i-blocked so every inner loop is a flat auto-vectorizable stream and
+  // the u64 partial sums stay in L1/L2 while the K streams are read once
+  constexpr uint64_t BLOCK = 4096;
+  uint64_t sum[BLOCK];
+  for (uint64_t s = 0; s < n; s += BLOCK) {
+    const uint64_t bn = (n - s) < BLOCK ? (n - s) : BLOCK;
+    if (two_limbs) {
+      for (uint64_t i = 0; i < bn; i++) sum[i] = load2(acc, s, i);
+      for (uint64_t kk = 0; kk < k; kk++) {
+        const uint32_t* up = stack + kk * 2 * n;
+        for (uint64_t i = 0; i < bn; i++) sum[i] += load2(up, s, i);
+      }
+    } else {
+      for (uint64_t i = 0; i < bn; i++) sum[i] = acc[s + i];
+      for (uint64_t kk = 0; kk < k; kk++) {
+        const uint32_t* up = stack + kk * n + s;
+        for (uint64_t i = 0; i < bn; i++) sum[i] += up[i];
+      }
+    }
+    if (!pow2_boundary) {
+      for (uint64_t i = 0; i < bn; i++) {
+        const uint64_t q = (uint64_t)((double)sum[i] * inv_order);
+        uint64_t r = sum[i] - q * order;
+        // double rounding can land one order off in either direction
+        r += (r >> 63) ? order : 0;     // q overshot (r went negative)
+        r -= (r >= order) ? order : 0;  // q undershot
+        sum[i] = r;
+      }
+    } else if (!two_limbs) {
+      for (uint64_t i = 0; i < bn; i++) sum[i] &= 0xFFFFFFFFull;
+    }  // order == 2^64: u64 arithmetic wraps naturally
+    if (two_limbs) {
+      for (uint64_t i = 0; i < bn; i++) store2(out, s, i, sum[i]);
+    } else {
+      for (uint64_t i = 0; i < bn; i++) out[s + i] = (uint32_t)sum[i];
+    }
+  }
+}
+
+}  // namespace
+
+// Single-pass batch fold for orders that fit in 64 bits (n_limbs <= 2 —
+// every f32/i32 B0-B6 config): fold K planar uint32[L, n] updates plus the
+// accumulator in ONE read of the batch. The host analogue of
+// ops/fold_jax.fold_planar_batch, used as a bench/aggregation fast path on
+// CPU where XLA's strided u16 reduction leaves ~10x bandwidth unused
+// (reference hot loop analogue: rust/xaynet-core/src/mask/masking.rs:292-316).
+//
+// Layouts: acc/out uint32[L, n] planar (limb-major), stack uint32[K, L, n].
+// Requirements: every input element < order; (K+1) * order < 2^64 for
+// non-pow2 orders (callers bound K exactly as MAX_LAZY_BATCH does for the
+// device fold). order_limbs all zero means order == 2^(32*L): natural
+// wraparound, valid for any K.
+XN_EXPORT void xn_fold_planar_u64(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
+                                  uint64_t n, uint32_t n_limbs, uint64_t k,
+                                  const uint32_t* order_limbs) {
+  fold_u64_core<false>(acc, stack, out, n, n_limbs, k, order_limbs);
+}
+
+// Wire-layout variant: acc/out uint32[n, L], stack uint32[K, n, L] — the
+// layout the coordinator's host aggregation path
+// (`Aggregation.aggregate_batch`) already holds, with no transposes.
+XN_EXPORT void xn_fold_wire_u64(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
+                                uint64_t n, uint32_t n_limbs, uint64_t k,
+                                const uint32_t* order_limbs) {
+  fold_u64_core<true>(acc, stack, out, n, n_limbs, k, order_limbs);
+}
+
 // (a - b) mod order, elementwise (same layout/conventions as xn_mod_add).
 XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
                           uint64_t n, uint32_t n_limbs, const uint32_t* order_limbs) {

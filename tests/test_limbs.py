@@ -76,3 +76,64 @@ def test_edge_values():
     b = limb_ops.ints_to_limbs([order - 1, 0, 1], n_limb)
     assert limb_ops.limbs_to_ints(limb_ops.mod_add(a, b, ol)) == [order - 2, 0, 0]
     assert limb_ops.limbs_to_ints(limb_ops.mod_sub(b, a, ol)) == [0, 0, 2 % order]
+
+
+def test_fold_planar_batch_host_matches_bigint_oracle():
+    """Native single-pass u64 fold == python big-int result (1 and 2 limb
+    orders, prime / integer / power2-boundary, elements at order-1)."""
+    import numpy as np
+
+    from xaynet_tpu.ops import limbs as L
+
+    cases = [
+        (2**48 - 59, 9),          # prime-ish, 2 limbs
+        ((1 << 45) * 10**3, 16),  # integer-style composite, 2 limbs
+        (1 << 64, 5),             # power2 boundary: natural u64 wrap
+        (1 << 32, 7),             # power2 boundary: one limb
+        (2**31 - 1, 12),          # one limb, odd order
+    ]
+    rng = np.random.default_rng(3)
+    for order, k in cases:
+        nl = L.n_limbs_for_order(order)
+        ol = L.order_limbs_for(order)
+        n = 257
+        vals = [[int(rng.integers(0, min(order, 2**63))) % order for _ in range(n)]]
+        vals += [[order - 1] * n]  # a row of maximal elements
+        vals += [[int(rng.integers(0, min(order, 2**63))) % order for _ in range(n)]
+                 for _ in range(k - 1)]
+        acc_planar = np.ascontiguousarray(L.ints_to_limbs(vals[0], nl).T)
+        stack_planar = np.stack([np.ascontiguousarray(L.ints_to_limbs(v, nl).T) for v in vals[1:]])
+        out = L.fold_planar_batch_host(acc_planar, stack_planar, ol)
+        want = [sum(v[i] for v in vals) % order for i in range(n)]
+        got = [L.limbs_to_int(np.ascontiguousarray(out[:, i])) for i in range(n)]
+        assert got == want, (order, k)
+
+        # wire-layout variant agrees (or declines when unsupported)
+        acc_wire = np.ascontiguousarray(acc_planar.T)
+        stack_wire = np.ascontiguousarray(stack_planar.transpose(0, 2, 1))
+        wire_out = L.fold_wire_batch_host(acc_wire, stack_wire, ol)
+        if wire_out is not None:
+            got_w = [L.limbs_to_int(wire_out[i]) for i in range(n)]
+            assert got_w == want, (order, k, "wire")
+
+
+def test_fold_host_declines_oversized_batch():
+    """(K+1) * order must fit u64; larger batches fall back (planar) or
+    return None (wire)."""
+    import numpy as np
+
+    from xaynet_tpu.ops import limbs as L
+
+    order = 1 << 62
+    nl, ol = L.n_limbs_for_order(order), L.order_limbs_for(order)
+    n, k = 33, 8  # (8+1) * 2^62 > 2^64 -> no u64 fast path
+    rng = np.random.default_rng(4)
+    vals = [[int(rng.integers(0, 2**62)) for _ in range(n)] for _ in range(k + 1)]
+    acc = np.ascontiguousarray(L.ints_to_limbs(vals[0], nl).T)
+    stack = np.stack([np.ascontiguousarray(L.ints_to_limbs(v, nl).T) for v in vals[1:]])
+    out = L.fold_planar_batch_host(acc, stack, ol)  # falls back to the tree
+    want = [sum(v[i] for v in vals) % order for i in range(n)]
+    got = [L.limbs_to_int(np.ascontiguousarray(out[:, i])) for i in range(n)]
+    assert got == want
+    assert L.fold_wire_batch_host(np.ascontiguousarray(acc.T),
+                                  np.ascontiguousarray(stack.transpose(0, 2, 1)), ol) is None

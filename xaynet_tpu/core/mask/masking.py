@@ -230,15 +230,25 @@ class Aggregation:
         if k == 0:
             return
         config_n, config_1 = self.object.vect.config, self.object.unit.config
-        batch_v = limb_ops.batch_mod_sum(stack, _order_limbs(config_n))
+        ol_n = _order_limbs(config_n)
         batch_u = limb_ops.batch_mod_sum(unit_stack[:, None, :], _order_limbs(config_1))[0]
+        # vector part: native single-pass fold (batch + accumulator in one
+        # read) for <=2-limb orders; pairwise tree otherwise
+        acc_v = self.object.vect.data if self.nb_models else np.zeros_like(stack[0])
+        fast = limb_ops.fold_wire_batch_host(acc_v, stack, ol_n)
+        if fast is not None:
+            self.object.vect.data = fast
+        else:
+            batch_v = limb_ops.batch_mod_sum(stack, ol_n)
+            if self.nb_models == 0:
+                self.object.vect.data = batch_v
+            else:
+                self.object.vect.data = limb_ops.mod_add(
+                    self.object.vect.data, batch_v, ol_n
+                )
         if self.nb_models == 0:
-            self.object.vect.data = batch_v
             self.object.unit.data = batch_u
         else:
-            self.object.vect.data = limb_ops.mod_add(
-                self.object.vect.data, batch_v, _order_limbs(config_n)
-            )
             self.object.unit.data = limb_ops.mod_add(
                 self.object.unit.data[None, :], batch_u[None, :], _order_limbs(config_1)
             )[0]

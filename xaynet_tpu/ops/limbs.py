@@ -212,3 +212,84 @@ def batch_mod_sum(stack: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
             merged = np.concatenate([merged, stack[2 * half :]], axis=0)
         stack = merged
     return stack[0]
+
+
+def fold_planar_batch_host(
+    acc: np.ndarray, stack: np.ndarray, order_limbs: np.ndarray
+) -> np.ndarray:
+    """Single-pass host fold of planar ``uint32[K, L, n]`` updates into the
+    planar ``uint32[L, n]`` accumulator (host analogue of
+    ``ops.fold_jax.fold_planar_batch``; reference hot loop:
+    rust/xaynet-core/src/mask/masking.rs:292-316).
+
+    Native fast path for orders that fit 64 bits (every 1-2 limb config) —
+    reads the batch once instead of XLA-CPU's strided half-word reduction
+    or the ``ceil(log2 K)``-pass pairwise tree. Falls back to the pairwise
+    numpy tree otherwise.
+    """
+    k, n_limb, n = stack.shape
+    if acc.shape != (n_limb, n):
+        raise ValueError("accumulator/batch shape mismatch")
+    order = limbs_to_int(order_limbs) or (1 << (32 * n_limb))
+    # pow2-boundary orders (all-zero limbs) wrap exactly in u64 for any K;
+    # otherwise the running sum (K+1 terms) must fit u64
+    pow2_boundary = not np.any(order_limbs)
+    if n_limb <= 2 and (pow2_boundary or (k + 1) <= ((1 << 64) // order)):
+        from ..utils import native
+
+        lib = native.load()
+        if lib is not None:
+            acc_c = np.ascontiguousarray(acc, dtype=_U32)
+            stack_c = np.ascontiguousarray(stack, dtype=_U32)
+            out = np.empty_like(acc_c)
+            lib.xn_fold_planar_u64(
+                native.np_u32p(acc_c),
+                native.np_u32p(stack_c),
+                native.np_u32p(out),
+                n,
+                n_limb,
+                k,
+                native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
+            )
+            return out
+    # fallback: wire layout pairwise tree (exact for any limb count)
+    wire = np.ascontiguousarray(stack.transpose(0, 2, 1))
+    folded = batch_mod_sum(wire, order_limbs)
+    acc_wire = np.ascontiguousarray(acc.T)
+    return np.ascontiguousarray(mod_add(acc_wire, folded, order_limbs).T)
+
+
+def fold_wire_batch_host(
+    acc: np.ndarray, stack: np.ndarray, order_limbs: np.ndarray
+) -> np.ndarray | None:
+    """Native single-pass fold over wire-layout ``uint32[K, n, L]`` into the
+    wire ``uint32[n, L]`` accumulator; None when the fast path is
+    unavailable (callers fall back to the pairwise tree).
+
+    For 2-limb configs a wire row is one little-endian u64, so every access
+    is a contiguous 8-byte load — no transposes, one read of the batch.
+    """
+    k, n, n_limb = stack.shape
+    if acc.shape != (n, n_limb) or n_limb > 2:
+        return None
+    order = limbs_to_int(order_limbs) or (1 << (32 * n_limb))
+    if np.any(order_limbs) and (k + 1) > ((1 << 64) // order):
+        return None  # non-pow2 order: the running sum must fit u64
+    from ..utils import native
+
+    lib = native.load()
+    if lib is None:
+        return None
+    acc_c = np.ascontiguousarray(acc, dtype=_U32)
+    stack_c = np.ascontiguousarray(stack, dtype=_U32)
+    out = np.empty_like(acc_c)
+    lib.xn_fold_wire_u64(
+        native.np_u32p(acc_c),
+        native.np_u32p(stack_c),
+        native.np_u32p(out),
+        n,
+        n_limb,
+        k,
+        native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
+    )
+    return out

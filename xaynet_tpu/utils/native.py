@@ -83,6 +83,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.xn_sample_uniform.restype = ctypes.c_uint64
         lib.xn_mod_add.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_mod_add.restype = None
+        lib.xn_fold_planar_u64.argtypes = [
+            u32p,
+            u32p,
+            u32p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            u32p,
+        ]
+        lib.xn_fold_planar_u64.restype = None
+        lib.xn_fold_wire_u64.argtypes = list(lib.xn_fold_planar_u64.argtypes)
+        lib.xn_fold_wire_u64.restype = None
         lib.xn_mod_sub.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_mod_sub.restype = None
         lib.xn_decode_f64.argtypes = [
@@ -124,8 +136,10 @@ def load() -> Optional[ctypes.CDLL]:
         ]
         lib.xn_mask_f32.restype = ctypes.c_uint64
         _lib = lib
-    except OSError as e:
-        logger.debug("native library load failed: %s", e)
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale prebuilt .so missing newer symbols when the
+        # rebuild could not run — degrade to the python fallback, not a crash
+        logger.warning("native library load failed; using python fallback: %s", e)
         _lib = None
     return _lib
 
