@@ -145,14 +145,30 @@ def main() -> None:
     jax.block_until_ready(agg.acc)
     t_update_phase = time.perf_counter() - t_total0
 
-    # 5. sum2 participant leg: derive + sum k_sum2 masks on device
+    # 5. sum2 participant leg: derive + sum k_sum2 masks. On the
+    # accelerator this is the device ChaCha kernel; on CPU it is the path a
+    # real CPU sum participant takes (native AVX2 sampler + the single-pass
+    # host fold), not the device kernel emulated on the host.
     t0 = time.perf_counter()
-    mask_acc = None
-    for i in range(k_sum2):
-        seed = bytes([i & 0xFF, i >> 8]) + b"\x33" * 30
-        vect = chacha_jax.derive_uniform_limbs(seed, model_len, order)
-        mask_acc = vect if mask_acc is None else limbs_jax.mod_add(mask_acc, vect, ol)
-    jax.block_until_ready(mask_acc)
+    if on_tpu:
+        mask_acc = None
+        for i in range(k_sum2):
+            seed = bytes([i & 0xFF, i >> 8]) + b"\x33" * 30
+            vect = chacha_jax.derive_uniform_limbs(seed, model_len, order)
+            mask_acc = vect if mask_acc is None else limbs_jax.mod_add(mask_acc, vect, ol)
+        jax.block_until_ready(mask_acc)
+    else:
+        from xaynet_tpu.core.crypto.prng import StreamSampler
+
+        host_masks = np.stack(
+            [
+                StreamSampler(bytes([i & 0xFF, i >> 8]) + b"\x33" * 30).draw_limbs(
+                    model_len, order
+                )
+                for i in range(k_sum2)
+            ]
+        )
+        mask_acc = host_limbs.batch_mod_sum(host_masks, ol)
     t_sum2 = time.perf_counter() - t0
 
     # 6. unmask + fixed-point decode to float

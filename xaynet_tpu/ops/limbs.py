@@ -199,11 +199,19 @@ def mod_sub(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray
 
 
 def batch_mod_sum(stack: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
-    """Modular sum over axis 0 of ``uint32[K, n, L]`` via pairwise tree reduce.
+    """Modular sum over axis 0 of ``uint32[K, n, L]``.
 
-    Each pairwise step keeps every element ``< order``, so the depth is
-    ``ceil(log2 K)`` and every level is a flat elementwise kernel.
+    Native single-pass u64 fold when the order allows (<=2 limbs); pairwise
+    tree reduce otherwise — each pairwise step keeps every element
+    ``< order``, so the depth is ``ceil(log2 K)`` and every level is a flat
+    elementwise kernel.
     """
+    if stack.shape[0] > 1:
+        fast = fold_wire_batch_host(
+            np.zeros_like(stack[0]), stack, order_limbs
+        )
+        if fast is not None:
+            return fast
     while stack.shape[0] > 1:
         k = stack.shape[0]
         half = k // 2

@@ -27,7 +27,7 @@ import numpy as np
 from ..core.common import RoundParameters
 from ..core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey
 from ..core.crypto.sign import SigningKeyPair, is_eligible
-from ..core.mask.masking import Aggregation, Masker
+from ..core.mask.masking import Aggregation, AggregationError, Masker
 from ..core.mask.model import Scalar
 from ..core.mask.object import MaskObject
 from ..core.message import Message, Sum, Sum2, Update
@@ -302,9 +302,20 @@ class StateMachine:
                 masks = list(pool.map(lambda s: s.derive_mask(length, config), mask_seeds))
         else:
             masks = [s.derive_mask(length, config) for s in mask_seeds]
+        # same bounds (and error kinds, in the same precedence) the
+        # incremental loop hit via validate_aggregation's nb_models checks
+        if len(masks) > config.vect.max_nb_models:
+            raise AggregationError("TooManyModels")
+        if len(masks) > config.unit.max_nb_models:
+            raise AggregationError("TooManyScalars")
         for mask in masks:
             mask_agg.validate_aggregation(mask)
-            mask_agg.aggregate(mask)
+        # one batched fold (native single-pass on <=2-limb configs) instead
+        # of len(masks) sequential modular adds
+        mask_agg.aggregate_batch(
+            np.stack([m.vect.data for m in masks]),
+            np.stack([m.unit.data for m in masks]),
+        )
         return mask_agg.object
 
     # --- sending ----------------------------------------------------------
