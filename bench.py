@@ -113,9 +113,26 @@ def main() -> None:
     n_limb = host_limbs.n_limbs_for_order(config.order)
     order = config.order
 
-    model_len = 25_000_000 if on_tpu else 1_000_000
-    k = 16 if on_tpu else 8  # updates per staged batch (HBM budget)
-    n_batches = 24 if on_tpu else 4
+    if on_tpu:
+        model_len, k, n_batches = 25_000_000, 16, 24
+    else:
+        # CPU fallback: measure the REAL 25M-param case when the host has
+        # room for it (stack is k*n_limb*25M*4B twice: numpy + jax copies),
+        # so the headline number needs no "scaled from a smaller model"
+        # caveat; only tiny machines drop to the scaled 1M smoke.
+        try:
+            with open("/proc/meminfo") as f:
+                avail_kb = next(
+                    int(line.split()[1]) for line in f if line.startswith("MemAvailable:")
+                )
+        except (OSError, StopIteration):
+            avail_kb = 0
+        if avail_kb >= 16 * 1024 * 1024:
+            # k=16 amortizes the accumulator read/write against the
+            # mandatory one-read-of-the-batch (measured +10% vs k=8)
+            model_len, k, n_batches = 25_000_000, 16, 3
+        else:
+            model_len, k, n_batches = 1_000_000, 8, 4
     warmup = 2
 
     # Synthesize K masked updates host-side in the planar device layout
@@ -203,12 +220,18 @@ def main() -> None:
     # scale CPU smoke runs to the 25M-param metric so the number is comparable
     scaled_ups = ups * (model_len / 25_000_000)
     baseline = 10_000 / 60.0  # north-star floor: 10k updates in 60s
-    metric = (
-        "masked-update aggregation throughput @25M params (PET update phase)"
-        if on_tpu
-        else f"masked-update aggregation throughput, CPU fallback @{model_len} params "
-        "scaled to the 25M metric (PET update phase)"
-    )
+    if on_tpu:
+        metric = "masked-update aggregation throughput @25M params (PET update phase)"
+    elif model_len == 25_000_000:
+        metric = (
+            "masked-update aggregation throughput @25M params, CPU fallback "
+            "(PET update phase)"
+        )
+    else:
+        metric = (
+            f"masked-update aggregation throughput, CPU fallback @{model_len} params "
+            "scaled to the 25M metric (PET update phase)"
+        )
     print(
         json.dumps(
             {

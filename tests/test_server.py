@@ -288,6 +288,31 @@ def test_sdk_sum2_device_path_matches_host(monkeypatch):
     assert host_obj == dev_obj
 
 
+def test_sdk_sum2_batched_fold_keeps_count_cap():
+    """The batched host fold enforces max_nb_models with the incremental
+    loop's error kind: one seed over M3's cap raises TooManyModels."""
+    import pytest
+
+    from xaynet_tpu.core.mask import (
+        AggregationError,
+        BoundType,
+        DataType,
+        GroupType,
+        MaskConfig,
+        MaskSeed,
+        ModelType,
+    )
+    from xaynet_tpu.sdk.state_machine import StateMachine
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3)
+    cap = cfg.max_nb_models
+    sm = StateMachine.__new__(StateMachine)
+    sm.device_sum2 = False
+    seeds = [MaskSeed(i.to_bytes(32, "little")) for i in range(1, cap + 2)]
+    with pytest.raises(AggregationError, match="TooManyModels"):
+        StateMachine._aggregate_masks(sm, seeds, 8, cfg.pair())
+
+
 def test_round_failure_then_successful_round():
     """A timed-out round restarts; the next round completes end to end."""
     import numpy as np

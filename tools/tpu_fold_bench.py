@@ -1,0 +1,176 @@
+"""Minimal, failure-resilient TPU fold capture.
+
+`bench.py` is the driver-facing headline (one JSON line at the very end) —
+which means a tunnel that dies mid-run leaves NOTHING. This tool is the
+opportunistic-capture complement (VERDICT r02 item 1): it prints one JSON
+line per stage the moment that stage has a number, so partial evidence
+survives any mid-run failure. Stages:
+
+  1. device transfer (device_put of the masked-update stack, timed)
+  2. XLA single-pass lazy-carry fold (ops/fold_jax.fold_planar_batch)
+  3. Pallas fold at a couple of tile sizes (ops/fold_pallas) — the first
+     time this kernel ever runs on real hardware, so each tile is isolated
+     in try/except and reported individually
+  4. a final headline-format line with the best kernel
+
+Every line is also appended to BENCH_HISTORY.jsonl with platform tags.
+
+Run:  python tools/tpu_fold_bench.py [--model-len 25000000] [--k 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+
+
+def emit(rec: dict) -> None:
+    rec = {"ts": round(time.time(), 3), "source": "tpu_fold_bench", **rec}
+    line = json.dumps(rec)
+    print(line, flush=True)
+    with open(HISTORY, "a") as f:
+        f.write(line + "\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-len", type=int, default=25_000_000)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--folds", type=int, default=8)
+    args = ap.parse_args()
+
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
+
+    from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
+    from xaynet_tpu.ops import limbs as host_limbs
+    from xaynet_tpu.ops.fold_jax import fold_planar_batch
+
+    platform = jax.devices()[0].platform
+    emit({"stage": "backend", "platform": platform, "device": str(jax.devices()[0])})
+
+    config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    order = config.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    model_len, k = args.model_len, args.k
+
+    rng = np.random.default_rng(0)
+    host_stack = rng.integers(0, 2**32, size=(k, n_limb, model_len), dtype=np.uint32)
+    host_stack[:, n_limb - 1, :] &= np.uint32((1 << 20) - 1)
+    nbytes = host_stack.nbytes
+
+    # per-update transfers (~200 MB each @25M) — the round-3 headline
+    # capture died with UNAVAILABLE inside one 3.2 GB device_put, so never
+    # hand the tunnel a multi-GB single transfer
+    t0 = time.perf_counter()
+    slices = []
+    for i in range(k):
+        s = jax.device_put(host_stack[i])
+        jax.block_until_ready(s)
+        slices.append(s)
+    stack = jnp.stack(slices)
+    jax.block_until_ready(stack)
+    del slices
+    dt = time.perf_counter() - t0
+    emit(
+        {
+            "stage": "transfer",
+            "platform": platform,
+            "bytes": nbytes,
+            "seconds": round(dt, 3),
+            "gb_per_s": round(nbytes / dt / 1e9, 3),
+        }
+    )
+    del host_stack
+
+    def sync(x):
+        np.asarray(x[:1, :8])
+
+    results = {}
+
+    def run_kernel(name: str, fn) -> None:
+        try:
+            acc = jnp.zeros((n_limb, model_len), dtype=jnp.uint32)
+            t0 = time.perf_counter()
+            acc = fn(acc, stack)
+            sync(acc)
+            compile_s = time.perf_counter() - t0
+            acc = fn(acc, stack)  # warmup post-compile
+            sync(acc)
+            t0 = time.perf_counter()
+            for _ in range(args.folds):
+                acc = fn(acc, stack)
+            sync(acc)
+            dt = time.perf_counter() - t0
+            ups = args.folds * k / dt
+            results[name] = ups
+            emit(
+                {
+                    "stage": f"fold:{name}",
+                    "platform": platform,
+                    "model_len": model_len,
+                    "k": k,
+                    "compile_seconds": round(compile_s, 2),
+                    "updates_per_s": round(ups, 2),
+                    "hbm_gb_per_s": round(args.folds * nbytes / dt / 1e9, 2),
+                    "vs_baseline": round(ups / (10_000 / 60.0), 3),
+                }
+            )
+        except Exception as e:
+            emit({"stage": f"fold:{name}", "platform": platform, "error": f"{type(e).__name__}: {e}"[:500]})
+
+    run_kernel("xla", lambda a, s: fold_planar_batch(a, s, order))
+
+    if platform != "cpu":
+        try:
+            from xaynet_tpu.ops.fold_pallas import fold_planar_batch_pallas
+
+            for tile in (2048, 8192):
+                run_kernel(
+                    f"pallas-t{tile}",
+                    lambda a, s, _t=tile: fold_planar_batch_pallas(a, s, order, tile_size=_t),
+                )
+        except Exception as e:
+            emit({"stage": "pallas-import", "error": f"{type(e).__name__}: {e}"[:300]})
+
+    if results:
+        best = max(results, key=results.get)
+        emit(
+            {
+                "stage": "headline",
+                "metric": "masked-update aggregation throughput @25M params (PET update phase)"
+                if model_len == 25_000_000
+                else f"masked-update aggregation throughput @{model_len} params",
+                "value": round(results[best], 2),
+                "unit": "updates/s",
+                "vs_baseline": round(results[best] / (10_000 / 60.0), 3),
+                "platform": platform,
+                "kernel": best,
+                "model_len": model_len,
+            }
+        )
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

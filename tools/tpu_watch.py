@@ -9,11 +9,14 @@ moment, this watcher loops in the background:
     probe must be externally timed out);
   * every attempt is appended to ``TPU_WATCH.log`` — if the tunnel never
     comes up all round, that log is the committed proof;
-  * the moment a probe succeeds it immediately runs the full capture
-    suite (``bench.py`` headline + Pallas tile sweep, and
-    ``tools/bench_round.py`` end-to-end round legs at 25M params), appends
-    platform-tagged JSON to ``BENCH_HISTORY.jsonl``, writes
-    ``TPU_EVIDENCE_r03.md``, and exits 0 so the builder can commit.
+  * the moment a probe succeeds it runs the capture suite cheapest-first
+    (``tools/tpu_fold_bench.py`` at 2.5M then 25M params, ``bench.py``
+    headline + Pallas tile sweep, ``tools/bench_round.py`` round legs),
+    appending platform-tagged JSON to ``BENCH_HISTORY.jsonl`` after every
+    capture and short-circuiting when a re-probe says the tunnel died;
+  * it exits 0 only once a **25M-param accelerator number** is on record
+    (writing ``TPU_EVIDENCE_r03.md``); smaller partial captures are kept
+    but the watch continues for the real headline.
 
 Run:  python tools/tpu_watch.py [--interval 600] [--probe-timeout 150]
 """
@@ -89,7 +92,10 @@ def run_capture(name: str, cmd: list[str], timeout: float) -> dict:
             except json.JSONDecodeError:
                 continue
     rec = {
-        "ts": _now(),
+        # float epoch `ts` is the machine-sortable key across every
+        # BENCH_HISTORY.jsonl producer; `ts_iso` is for humans
+        "ts": round(time.time(), 3),
+        "ts_iso": _now(),
         "source": f"tpu_watch:{name}",
         "rc": rc,
         "seconds": round(dt, 1),
@@ -120,40 +126,61 @@ def main() -> int:
         attempt += 1
         log(f"--- probe attempt {attempt} ---")
         if probe(args.probe_timeout):
-            records = [
-                run_capture("bench_headline", [sys.executable, "bench.py"], 1800),
-                run_capture(
-                    "bench_round_25m",
-                    [sys.executable, "tools/bench_round.py", "--model-len", "25000000",
-                     "--updates", "64", "--batch", "16"],
-                    2400,
-                ),
+            # cheapest-first: the round-2/3 tunnel windows lasted ~20 min and
+            # died mid-capture, so grab a small committed number BEFORE the
+            # expensive full-scale runs (each fold_bench stage appends its
+            # own history line the moment it has a number)
+            specs = [
+                ("fold_2.5m",
+                 [sys.executable, "tools/tpu_fold_bench.py",
+                  "--model-len", "2500000", "--k", "8"], 600),
+                ("fold_25m",
+                 [sys.executable, "tools/tpu_fold_bench.py",
+                  "--model-len", "25000000", "--k", "8"], 1200),
+                ("bench_headline", [sys.executable, "bench.py"], 1800),
+                ("bench_round_25m",
+                 [sys.executable, "tools/bench_round.py", "--model-len", "25000000",
+                  "--updates", "64", "--batch", "16"], 2400),
             ]
-            with open(HISTORY, "a") as f:
-                for rec in records:
+            records = []
+            for name, cmd, cap_timeout in specs:
+                rec = run_capture(name, cmd, cap_timeout)
+                records.append(rec)
+                with open(HISTORY, "a") as f:  # crash-safe: append as we go
                     f.write(json.dumps(rec) + "\n")
-            # success = at least one capture actually completed on an
-            # accelerator; a tunnel that died mid-bench must not end the watch
+                # a failed capture usually means the tunnel died mid-window;
+                # don't burn an hour timing out the remaining (bigger)
+                # captures against a dead tunnel — re-probe to decide
+                if rec["rc"] != 0 and not probe(args.probe_timeout):
+                    log("tunnel gone mid-suite; abandoning remaining captures")
+                    break
             good = [
                 r for r in records
                 if r["rc"] == 0 and r["parsed"] and r["parsed"].get("platform") not in (None, "cpu")
             ]
-            if not good:
+            if good:
+                with open(EVIDENCE, "a") as f:
+                    f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
+                    f.write(f"Captured {_now()} after {attempt} probe attempts.\n\n")
+                    for rec in records:
+                        f.write(f"## {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")
+                        f.write("```\n" + rec["stdout_tail"] + "\n```\n\n")
+                        if rec["parsed"]:
+                            f.write("Parsed: `" + json.dumps(rec["parsed"]) + "`\n\n")
+            # only a 25M-scale accelerator number ends the watch: exiting on
+            # the small 2.5M capture alone would abandon later windows that
+            # could yield the headline the round actually needs
+            if any((r["parsed"] or {}).get("model_len") == 25_000_000 for r in good):
+                log("TPU capture complete at 25M; exiting so the builder can commit")
+                return 0
+            if good:
+                log("partial TPU evidence captured (sub-25M); continuing watch for the full headline")
+            else:
                 log("probe succeeded but no capture completed on the accelerator; continuing watch")
-                if args.once:
-                    return 1
-                time.sleep(args.interval)
-                continue
-            with open(EVIDENCE, "w") as f:
-                f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
-                f.write(f"Captured {_now()} after {attempt} probe attempts.\n\n")
-                for rec in records:
-                    f.write(f"## {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")
-                    f.write("```\n" + rec["stdout_tail"] + "\n```\n\n")
-                    if rec["parsed"]:
-                        f.write("Parsed: `" + json.dumps(rec["parsed"]) + "`\n\n")
-            log("TPU capture complete; exiting so the builder can commit")
-            return 0
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
         if args.once:
             return 1
         time.sleep(args.interval)

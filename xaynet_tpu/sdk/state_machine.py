@@ -27,7 +27,7 @@ import numpy as np
 from ..core.common import RoundParameters
 from ..core.crypto.encrypt import EncryptKeyPair, PublicEncryptKey
 from ..core.crypto.sign import SigningKeyPair, is_eligible
-from ..core.mask.masking import Aggregation, AggregationError, Masker
+from ..core.mask.masking import Aggregation, Masker
 from ..core.mask.model import Scalar
 from ..core.mask.object import MaskObject
 from ..core.message import Message, Sum, Sum2, Update
@@ -302,14 +302,14 @@ class StateMachine:
                 masks = list(pool.map(lambda s: s.derive_mask(length, config), mask_seeds))
         else:
             masks = [s.derive_mask(length, config) for s in mask_seeds]
-        # same bounds (and error kinds, in the same precedence) the
-        # incremental loop hit via validate_aggregation's nb_models checks
-        if len(masks) > config.vect.max_nb_models:
-            raise AggregationError("TooManyModels")
-        if len(masks) > config.unit.max_nb_models:
-            raise AggregationError("TooManyScalars")
-        for mask in masks:
+        # replicate the incremental loop's per-mask error precedence exactly:
+        # mask i is validated against the state where i models are already
+        # folded, so a mismatched/invalid mask at a low index still raises
+        # before a count overflow at a higher one (masking.rs check order)
+        for i, mask in enumerate(masks):
+            mask_agg.nb_models = i
             mask_agg.validate_aggregation(mask)
+        mask_agg.nb_models = 0
         # one batched fold (native single-pass on <=2-limb configs) instead
         # of len(masks) sequential modular adds
         mask_agg.aggregate_batch(
