@@ -137,3 +137,46 @@ def test_fold_host_declines_oversized_batch():
     assert got == want
     assert L.fold_wire_batch_host(np.ascontiguousarray(acc.T),
                                   np.ascontiguousarray(stack.transpose(0, 2, 1)), ol) is None
+
+
+def test_wire_codec_native_matches_numpy_oracle():
+    """Native wire<->limb codecs: exact vs the numpy pad/slice path across
+    the wire-width grid (incl. the bytewise tail element and the 173-byte
+    f64/Bmax worst case), plus serialize round-trip."""
+    import numpy as np
+
+    from xaynet_tpu.ops import limbs as L
+
+    rng = np.random.default_rng(7)
+    for bpn in [1, 2, 3, 4, 5, 6, 7, 8, 9, 12, 16, 21, 173]:
+        n_limb = max(1, (bpn + 3) // 4)
+        for count in (1, 2, 57):  # count=1 exercises the tail-only path
+            buf = rng.integers(0, 256, size=count * bpn, dtype=np.uint8).tobytes()
+            got = L.bytes_le_to_limbs(buf, count, bpn)
+            raw = np.frombuffer(buf, dtype=np.uint8, count=count * bpn)
+            padded = np.zeros((count, n_limb * 4), dtype=np.uint8)
+            padded[:, :bpn] = raw.reshape(count, bpn)
+            want = padded.view("<u4")
+            assert np.array_equal(got, want), (bpn, count)
+            assert L.limbs_to_bytes_le(got, bpn) == buf, (bpn, count)
+
+
+def test_all_lt_order_matches_elementwise():
+    """Scalar validity count == np.all over the per-element compare, incl.
+    the 2^(32L) boundary orders and exact order-1/order edge values."""
+    import numpy as np
+
+    from xaynet_tpu.ops import limbs as L
+
+    rng = np.random.default_rng(8)
+    for order in [251, 2**20 + 7, 2**32, 2**52 - 47, 2**64 - 59, 2**64, 2**96]:
+        nl = L.n_limbs_for_order(order)
+        data = rng.integers(0, 2**32, size=(500, nl), dtype=np.uint32)
+        assert L.all_lt_order(data, order) == bool(
+            np.all(L.elements_lt_order(data, order))
+        ), order
+        ok = L.ints_to_limbs([0, order // 2, order - 1], nl)
+        assert L.all_lt_order(ok, order) is True, order
+        if order != 1 << (32 * nl):
+            mixed = np.vstack([ok, L.ints_to_limbs([order], nl)])
+            assert L.all_lt_order(mixed, order) is False, order

@@ -159,14 +159,17 @@ def main() -> int:
                 if r["rc"] == 0 and r["parsed"] and r["parsed"].get("platform") not in (None, "cpu")
             ]
             if good:
+                # one document header across however many windows contribute;
+                # only successful captures get sections (failures are in
+                # TPU_WATCH.log + BENCH_HISTORY.jsonl)
                 with open(EVIDENCE, "a") as f:
-                    f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
-                    f.write(f"Captured {_now()} after {attempt} probe attempts.\n\n")
-                    for rec in records:
-                        f.write(f"## {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")
+                    if f.tell() == 0:
+                        f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
+                    f.write(f"## window at {_now()} (probe attempt {attempt})\n\n")
+                    for rec in good:
+                        f.write(f"### {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")
                         f.write("```\n" + rec["stdout_tail"] + "\n```\n\n")
-                        if rec["parsed"]:
-                            f.write("Parsed: `" + json.dumps(rec["parsed"]) + "`\n\n")
+                        f.write("Parsed: `" + json.dumps(rec["parsed"]) + "`\n\n")
             # only a 25M-scale accelerator number ends the watch: exiting on
             # the small 2.5M capture alone would abandon later windows that
             # could yield the headline the round actually needs

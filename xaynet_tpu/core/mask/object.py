@@ -59,7 +59,7 @@ class MaskVect:
         n_limb = limb_ops.n_limbs_for_order(self.config.order)
         if self.data.shape[1] != n_limb:
             return False
-        return bool(np.all(limb_ops.elements_lt_order(self.data, self.config.order)))
+        return limb_ops.all_lt_order(self.data, self.config.order)
 
     def __eq__(self, other) -> bool:
         return (

@@ -46,6 +46,26 @@ def order_limbs_for(order: int) -> np.ndarray:
     return int_to_limbs(order, n_limb)
 
 
+def all_lt_order(data: np.ndarray, order: int) -> bool:
+    """``bool(np.all(elements_lt_order(data, order)))`` without the bool
+    temporaries — native single-pass count of out-of-group elements (the
+    per-update validity check on the coordinator's ingest path)."""
+    n_limb = n_limbs_for_order(order)
+    if order == 1 << (32 * n_limb):
+        return True
+    flat = np.ascontiguousarray(data.reshape(-1, n_limb), dtype=_U32)
+    from ..utils import native
+
+    lib = native.load()
+    if lib is not None:
+        ol = np.ascontiguousarray(int_to_limbs(order, n_limb))
+        bad = lib.xn_count_ge(
+            native.np_u32p(flat), flat.shape[0], n_limb, native.np_u32p(ol)
+        )
+        return bad == 0
+    return bool(np.all(lt_const(flat, int_to_limbs(order, n_limb))))
+
+
 def elements_lt_order(data: np.ndarray, order: int) -> np.ndarray:
     """Per-row validity ``element < order`` handling the 2^(32L) boundary."""
     n_limb = n_limbs_for_order(order)
@@ -94,9 +114,24 @@ def limbs_to_ints(arr: np.ndarray) -> list[int]:
 
 
 def bytes_le_to_limbs(buf: bytes | np.ndarray, count: int, bytes_per_number: int) -> np.ndarray:
-    """Parse ``count`` fixed-width little-endian integers into ``uint32[count, L]``."""
+    """Parse ``count`` fixed-width little-endian integers into ``uint32[count, L]``.
+
+    Native single-pass codec when available (~memory bandwidth; the numpy
+    pad/slice path measures ~370 MB/s and parse sits on the coordinator's
+    per-update critical path — one 25M-param update is a 150 MB payload).
+    """
     n_limb = max(1, (bytes_per_number + 3) // 4)
     raw = np.frombuffer(buf, dtype=np.uint8, count=count * bytes_per_number)
+    from ..utils import native
+
+    lib = native.load()
+    if lib is not None and count > 0:
+        raw_c = np.ascontiguousarray(raw)
+        out = np.empty((count, n_limb), dtype=_U32)
+        lib.xn_wire_to_limbs(
+            native.np_u8p(raw_c), count, bytes_per_number, n_limb, native.np_u32p(out)
+        )
+        return out
     padded = np.zeros((count, n_limb * 4), dtype=np.uint8)
     padded[:, :bytes_per_number] = raw.reshape(count, bytes_per_number)
     return padded.view("<u4").astype(_U32, copy=False)
@@ -106,6 +141,16 @@ def limbs_to_bytes_le(arr: np.ndarray, bytes_per_number: int) -> bytes:
     """Serialize ``uint32[n, L]`` limbs as fixed-width little-endian integers."""
     arr = np.ascontiguousarray(np.asarray(arr, dtype=_U32))
     n = arr.shape[0]
+    from ..utils import native
+
+    lib = native.load()
+    # native codec assumes the wire width and limb count agree (L == ceil(bpn/4))
+    if lib is not None and n > 0 and arr.shape[1] == max(1, (bytes_per_number + 3) // 4):
+        out = np.empty(n * bytes_per_number, dtype=np.uint8)
+        lib.xn_limbs_to_wire(
+            native.np_u32p(arr), n, bytes_per_number, arr.shape[1], native.np_u8p(out)
+        )
+        return out.tobytes()
     raw = arr.astype("<u4").view(np.uint8).reshape(n, -1)
     return raw[:, :bytes_per_number].tobytes()
 

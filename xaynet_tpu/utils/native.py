@@ -18,7 +18,7 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -135,6 +135,24 @@ def load() -> Optional[ctypes.CDLL]:
             u8p,
         ]
         lib.xn_mask_f32.restype = ctypes.c_uint64
+        lib.xn_wire_to_limbs.argtypes = [
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            u32p,
+        ]
+        lib.xn_wire_to_limbs.restype = None
+        lib.xn_limbs_to_wire.argtypes = [
+            u32p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            u8p,
+        ]
+        lib.xn_limbs_to_wire.restype = None
+        lib.xn_count_ge.argtypes = [u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
+        lib.xn_count_ge.restype = ctypes.c_uint64
         _lib = lib
     except (OSError, AttributeError) as e:
         # AttributeError: a stale prebuilt .so missing newer symbols when the
