@@ -409,6 +409,98 @@ XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
   }
 }
 
+// Generic n-limb single-pass fold (wire layout): covers every config the
+// u64 fast path cannot — f64 families (3-6 limbs) through the 173-byte
+// f64/Bmax worst case (44 limbs). One read of the batch: per-limb column
+// sums accumulate in u64 (exact for K+1 <= 2^32 terms), then each element
+// carry-propagates into an (L+1)-limb value and reduces modulo the order
+// with ceil(log2(K+1)) conditional subtracts of order << b — the same
+// reduction schedule as the device fold (ops/fold_jax.fold_planar_batch).
+//
+// Layouts: acc/out uint32[n, L] wire-order, stack uint32[K, n, L].
+// Requirements: elements < order; K <= 65535; L <= 63. All-zero
+// order_limbs means order == 2^(32L): natural wraparound. Returns 0 on
+// success, 1 on a parameter violation.
+XN_EXPORT int xn_fold_wire_nlimb(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
+                                 uint64_t n, uint32_t n_limbs, uint64_t k,
+                                 const uint32_t* order_limbs) {
+  if (n_limbs == 0 || n_limbs > 63 || k > 65535) return 1;
+  const uint32_t L = n_limbs;
+  int pow2_boundary = 1;
+  for (uint32_t l = 0; l < L; l++) pow2_boundary &= (order_limbs[l] == 0);
+
+  // how many conditional-subtract rounds the reduction needs: value < (K+1)*order
+  uint32_t kbits = 0;
+  while ((1ull << kbits) < k + 1) kbits++;
+
+  // precompute order << b for every reduction round (kbits <= 16, so the
+  // shift never crosses a limb boundary by more than one limb)
+  std::vector<uint32_t> shifted((kbits + 1) * (L + 1));
+  for (uint32_t b = 0; b <= kbits; b++) {
+    uint32_t* so = shifted.data() + b * (L + 1);
+    const uint32_t limb_off = b >> 5;
+    const uint32_t bit_off = b & 31;
+    for (uint32_t l = 0; l <= L; l++) {
+      uint64_t ol = 0;
+      const int src_hi = (int)l - (int)limb_off;
+      if (src_hi >= 0 && src_hi < (int)L) ol = ((uint64_t)order_limbs[src_hi] << bit_off) & 0xFFFFFFFFull;
+      if (bit_off && src_hi - 1 >= 0 && src_hi - 1 < (int)L)
+        ol |= order_limbs[src_hi - 1] >> (32 - bit_off);
+      so[l] = (uint32_t)ol;
+    }
+  }
+
+  // block over elements so each batch row is read as one contiguous
+  // stretch (element-at-a-time order would reload every cache line
+  // ~elements-per-line times); block sized to keep the u64 column
+  // accumulator ~16 KB regardless of L
+  uint64_t block = 2048 / L;
+  if (block == 0) block = 1;
+  std::vector<uint64_t> colbuf(block * L);
+  uint32_t w[64];  // carry-propagated (L+1)-limb value, one element
+  for (uint64_t i0 = 0; i0 < n; i0 += block) {
+    const uint64_t bn = (i0 + block <= n) ? block : n - i0;
+    uint64_t* col = colbuf.data();
+    for (uint64_t j = 0; j < bn * L; j++) col[j] = acc[i0 * L + j];
+    for (uint64_t kk = 0; kk < k; kk++) {
+      const uint32_t* row = stack + (kk * n + i0) * L;
+      for (uint64_t j = 0; j < bn * L; j++) col[j] += row[j];
+    }
+    for (uint64_t bi = 0; bi < bn; bi++) {
+    const uint64_t i = i0 + bi;
+    uint64_t carry = 0;
+    for (uint32_t l = 0; l < L; l++) {
+      const uint64_t t = col[bi * L + l] + carry;
+      w[l] = (uint32_t)t;
+      carry = t >> 32;
+    }
+    w[L] = (uint32_t)carry;  // < K+1 <= 2^16
+    if (pow2_boundary) {
+      for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
+      continue;
+    }
+    // reduce: repeated conditional subtract of the precomputed order << b
+    for (int b = (int)kbits; b >= 0; b--) {
+      const uint32_t* so = shifted.data() + (uint32_t)b * (L + 1);
+      int ge = 1;  // lexicographic w >= (order << b), from the top limb down
+      for (int l = (int)L; l >= 0; l--) {
+        if (w[l] > so[l]) { ge = 1; break; }
+        if (w[l] < so[l]) { ge = 0; break; }
+      }
+      if (!ge) continue;
+      uint64_t borrow = 0;
+      for (uint32_t l = 0; l <= L; l++) {
+        const uint64_t d = (uint64_t)w[l] - so[l] - borrow;
+        w[l] = (uint32_t)d;
+        borrow = (d >> 63) & 1;
+      }
+    }
+    for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
+    }
+  }
+  return 0;
+}
+
 // --- wire <-> limb codecs --------------------------------------------------
 //
 // The coordinator ingests every masked update as `count` fixed-width
@@ -501,7 +593,7 @@ XN_EXPORT uint64_t xn_count_ge(const uint32_t* limbs, uint64_t count, uint32_t n
   return bad;
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 3; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 4; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout

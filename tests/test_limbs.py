@@ -117,9 +117,9 @@ def test_fold_planar_batch_host_matches_bigint_oracle():
             assert got_w == want, (order, k, "wire")
 
 
-def test_fold_host_declines_oversized_batch():
-    """(K+1) * order must fit u64; larger batches fall back (planar) or
-    return None (wire)."""
+def test_fold_host_oversized_batch_uses_generic_kernel():
+    """(K+1) * order over the u64 bound routes to the generic n-limb
+    kernel (round 3) and stays exact."""
     import numpy as np
 
     from xaynet_tpu.ops import limbs as L
@@ -131,12 +131,51 @@ def test_fold_host_declines_oversized_batch():
     vals = [[int(rng.integers(0, 2**62)) for _ in range(n)] for _ in range(k + 1)]
     acc = np.ascontiguousarray(L.ints_to_limbs(vals[0], nl).T)
     stack = np.stack([np.ascontiguousarray(L.ints_to_limbs(v, nl).T) for v in vals[1:]])
-    out = L.fold_planar_batch_host(acc, stack, ol)  # falls back to the tree
+    out = L.fold_planar_batch_host(acc, stack, ol)
     want = [sum(v[i] for v in vals) % order for i in range(n)]
     got = [L.limbs_to_int(np.ascontiguousarray(out[:, i])) for i in range(n)]
     assert got == want
-    assert L.fold_wire_batch_host(np.ascontiguousarray(acc.T),
-                                  np.ascontiguousarray(stack.transpose(0, 2, 1)), ol) is None
+    wire_out = L.fold_wire_batch_host(
+        np.ascontiguousarray(acc.T), np.ascontiguousarray(stack.transpose(0, 2, 1)), ol
+    )
+    if wire_out is not None:  # native present: the generic kernel must agree
+        assert L.limbs_to_ints(wire_out) == want
+
+
+def test_fold_host_nlimb_matches_bigint_oracle():
+    """Generic n-limb single-pass fold: exact vs the big-int oracle across
+    multi-limb orders (f64 families through a Bmax-scale 1384-bit order),
+    batch sizes, and the pow2-boundary wraparound case."""
+    import numpy as np
+
+    from xaynet_tpu.ops import limbs as L
+    from xaynet_tpu.utils import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    orders = [2**65 + 7, 2**96, 2**96 - 17, 2**127 - 1, (1 << 192) - 237,
+              (1 << 1384) - 1234567]
+    for order in orders:
+        nl, ol = L.n_limbs_for_order(order), L.order_limbs_for(order)
+        for k in (1, 8, 31):
+            n = 17
+
+            def big():
+                b = 0
+                for _ in range(nl):
+                    b = (b << 32) | int(rng.integers(0, 2**32))
+                return b % order
+
+            vals = [[big() for _ in range(n)] for _ in range(k + 1)]
+            acc = L.ints_to_limbs(vals[0], nl)
+            stack = np.stack([L.ints_to_limbs(v, nl) for v in vals[1:]])
+            out = L.fold_wire_batch_host(acc, stack, ol)
+            assert out is not None, (order.bit_length(), k)
+            want = [sum(v[i] for v in vals) % order for i in range(n)]
+            assert L.limbs_to_ints(out) == want, (order.bit_length(), k)
 
 
 def test_wire_codec_native_matches_numpy_oracle():

@@ -233,7 +233,8 @@ class Aggregation:
         ol_n = _order_limbs(config_n)
         batch_u = limb_ops.batch_mod_sum(unit_stack[:, None, :], _order_limbs(config_1))[0]
         # vector part: native single-pass fold (batch + accumulator in one
-        # read) for <=2-limb orders; pairwise tree otherwise
+        # read) — u64 kernel for <=2-limb orders, generic n-limb kernel for
+        # the rest; numpy pairwise tree only without the native library
         acc_v = self.object.vect.data if self.nb_models else np.zeros_like(stack[0])
         fast = limb_ops.fold_wire_batch_host(acc_v, stack, ol_n)
         if fast is not None:

@@ -246,10 +246,11 @@ def mod_sub(a: np.ndarray, b: np.ndarray, order_limbs: np.ndarray) -> np.ndarray
 def batch_mod_sum(stack: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
     """Modular sum over axis 0 of ``uint32[K, n, L]``.
 
-    Native single-pass u64 fold when the order allows (<=2 limbs); pairwise
-    tree reduce otherwise — each pairwise step keeps every element
-    ``< order``, so the depth is ``ceil(log2 K)`` and every level is a flat
-    elementwise kernel.
+    Native single-pass fold when available (u64 kernel for <=2-limb
+    orders, generic n-limb kernel for the rest); numpy pairwise tree
+    reduce otherwise — each pairwise step keeps every element ``< order``,
+    so the depth is ``ceil(log2 K)`` and every level is a flat elementwise
+    kernel.
     """
     if stack.shape[0] > 1:
         fast = fold_wire_batch_host(
@@ -316,27 +317,33 @@ def fold_wire_batch_host(
     acc: np.ndarray, stack: np.ndarray, order_limbs: np.ndarray
 ) -> np.ndarray | None:
     """Native single-pass fold over wire-layout ``uint32[K, n, L]`` into the
-    wire ``uint32[n, L]`` accumulator; None when the fast path is
-    unavailable (callers fall back to the pairwise tree).
+    wire ``uint32[n, L]`` accumulator; None when no native path applies
+    (callers fall back to the pairwise tree).
 
     For 2-limb configs a wire row is one little-endian u64, so every access
-    is a contiguous 8-byte load — no transposes, one read of the batch.
+    is a contiguous 8-byte load; multi-limb orders (f64 families through
+    the 44-limb Bmax) take the generic blocked n-limb kernel. Either way:
+    no transposes, one read of the batch.
     """
     k, n, n_limb = stack.shape
-    if acc.shape != (n, n_limb) or n_limb > 2:
+    if acc.shape != (n, n_limb):
         return None
-    order = limbs_to_int(order_limbs) or (1 << (32 * n_limb))
-    if np.any(order_limbs) and (k + 1) > ((1 << 64) // order):
-        return None  # non-pow2 order: the running sum must fit u64
     from ..utils import native
 
     lib = native.load()
     if lib is None:
         return None
+    order = limbs_to_int(order_limbs) or (1 << (32 * n_limb))
+    # generic single-pass kernel for any limb count (f64 families through
+    # the 44-limb Bmax order) and for 2-limb orders whose running sum
+    # overflows u64; the u64 kernel otherwise
+    generic = n_limb > 2 or (np.any(order_limbs) and (k + 1) > ((1 << 64) // order))
+    if generic and (n_limb > 63 or k > 65535):
+        return None
     acc_c = np.ascontiguousarray(acc, dtype=_U32)
     stack_c = np.ascontiguousarray(stack, dtype=_U32)
     out = np.empty_like(acc_c)
-    lib.xn_fold_wire_u64(
+    args = (
         native.np_u32p(acc_c),
         native.np_u32p(stack_c),
         native.np_u32p(out),
@@ -345,4 +352,7 @@ def fold_wire_batch_host(
         k,
         native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
     )
+    if generic:
+        return out if lib.xn_fold_wire_nlimb(*args) == 0 else None
+    lib.xn_fold_wire_u64(*args)
     return out

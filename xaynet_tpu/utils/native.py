@@ -18,7 +18,7 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -153,6 +153,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.xn_limbs_to_wire.restype = None
         lib.xn_count_ge.argtypes = [u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_count_ge.restype = ctypes.c_uint64
+        lib.xn_fold_wire_nlimb.argtypes = list(lib.xn_fold_wire_u64.argtypes)
+        lib.xn_fold_wire_nlimb.restype = ctypes.c_int
         _lib = lib
     except (OSError, AttributeError) as e:
         # AttributeError: a stale prebuilt .so missing newer symbols when the
