@@ -467,35 +467,35 @@ XN_EXPORT int xn_fold_wire_nlimb(const uint32_t* acc, const uint32_t* stack, uin
       for (uint64_t j = 0; j < bn * L; j++) col[j] += row[j];
     }
     for (uint64_t bi = 0; bi < bn; bi++) {
-    const uint64_t i = i0 + bi;
-    uint64_t carry = 0;
-    for (uint32_t l = 0; l < L; l++) {
-      const uint64_t t = col[bi * L + l] + carry;
-      w[l] = (uint32_t)t;
-      carry = t >> 32;
-    }
-    w[L] = (uint32_t)carry;  // < K+1 <= 2^16
-    if (pow2_boundary) {
+      const uint64_t i = i0 + bi;
+      uint64_t carry = 0;
+      for (uint32_t l = 0; l < L; l++) {
+        const uint64_t t = col[bi * L + l] + carry;
+        w[l] = (uint32_t)t;
+        carry = t >> 32;
+      }
+      w[L] = (uint32_t)carry;  // < K+1 <= 2^16
+      if (pow2_boundary) {
+        for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
+        continue;
+      }
+      // reduce: repeated conditional subtract of the precomputed order << b
+      for (int b = (int)kbits; b >= 0; b--) {
+        const uint32_t* so = shifted.data() + (uint32_t)b * (L + 1);
+        int ge = 1;  // lexicographic w >= (order << b), from the top limb down
+        for (int l = (int)L; l >= 0; l--) {
+          if (w[l] > so[l]) { ge = 1; break; }
+          if (w[l] < so[l]) { ge = 0; break; }
+        }
+        if (!ge) continue;
+        uint64_t borrow = 0;
+        for (uint32_t l = 0; l <= L; l++) {
+          const uint64_t d = (uint64_t)w[l] - so[l] - borrow;
+          w[l] = (uint32_t)d;
+          borrow = (d >> 63) & 1;
+        }
+      }
       for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
-      continue;
-    }
-    // reduce: repeated conditional subtract of the precomputed order << b
-    for (int b = (int)kbits; b >= 0; b--) {
-      const uint32_t* so = shifted.data() + (uint32_t)b * (L + 1);
-      int ge = 1;  // lexicographic w >= (order << b), from the top limb down
-      for (int l = (int)L; l >= 0; l--) {
-        if (w[l] > so[l]) { ge = 1; break; }
-        if (w[l] < so[l]) { ge = 0; break; }
-      }
-      if (!ge) continue;
-      uint64_t borrow = 0;
-      for (uint32_t l = 0; l <= L; l++) {
-        const uint64_t d = (uint64_t)w[l] - so[l] - borrow;
-        w[l] = (uint32_t)d;
-        borrow = (d >> 63) & 1;
-      }
-    }
-    for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
     }
   }
   return 0;

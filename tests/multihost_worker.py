@@ -1,19 +1,25 @@
-"""Worker process for the 2-process multi-host aggregation test.
+"""Worker process for the multi-process multi-host aggregation tests.
 
-Each worker is one "host": it joins the JAX distributed runtime, owns 4
-virtual CPU devices of the 8-device global mesh, parses/stages ONLY its
-slice of the model axis, and verifies its slice of the unmasked result
-against the host oracle. Run by tests/test_multihost.py, never directly
-by pytest.
+Each worker is one "host": it joins the JAX distributed runtime, owns
+``devs_per_proc`` virtual CPU devices of the 8-device global mesh,
+parses/stages ONLY its slice of the model axis, and verifies its slice of
+the unmasked result against the host oracle. Run by
+tests/test_multihost.py (2-process default, 4-process under
+XAYNET_STRESS=1), never directly by pytest.
+
+argv: port process_id n_procs devs_per_proc
 """
 
 import os
 import sys
 
+_DEVS = sys.argv[4] if len(sys.argv) > 4 else "4"
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_DEVS}"
+    ).strip()
 
 import jax  # noqa: E402
 
@@ -36,9 +42,10 @@ from xaynet_tpu.parallel.multihost import MultiHostAggregator, initialize  # noq
 
 def main() -> None:
     port, process_id = sys.argv[1], int(sys.argv[2])
-    initialize(f"127.0.0.1:{port}", num_processes=2, process_id=process_id)
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 8, jax.device_count()
+    n_procs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    initialize(f"127.0.0.1:{port}", num_processes=n_procs, process_id=process_id)
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert jax.device_count() == n_procs * int(_DEVS), jax.device_count()
 
     config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
     order = config.order

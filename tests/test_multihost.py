@@ -22,7 +22,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_ingest():
+def _run_sharded_ingest(n_procs: int, devs_per_proc: int, timeout: float = 240):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = {
@@ -32,18 +32,18 @@ def test_two_process_sharded_ingest():
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(port), str(i)],
+            [sys.executable, worker, str(port), str(i), str(n_procs), str(devs_per_proc)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -52,6 +52,20 @@ def test_two_process_sharded_ingest():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out[-3000:]}"
         assert f"WORKER {i} OK" in out, out[-3000:]
+
+
+def test_two_process_sharded_ingest():
+    _run_sharded_ingest(2, 4)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("XAYNET_STRESS"),
+    reason="4 concurrent jax processes; run with XAYNET_STRESS=1",
+)
+def test_four_process_sharded_ingest():
+    """Pod-scale shape: 4 hosts x 2 devices over the same 8-device mesh
+    (roadmap item 'multi-host beyond 2 processes')."""
+    _run_sharded_ingest(4, 2, timeout=480)
 
 
 def test_single_process_multihost_aggregator_matches_oracle():
