@@ -140,9 +140,24 @@ def main() -> None:
     rng = np.random.default_rng(0)
     host_stack = rng.integers(0, 2**32, size=(k, n_limb, model_len), dtype=np.uint32)
     host_stack[:, n_limb - 1, :] &= np.uint32((1 << 20) - 1)
-    stack = jax.device_put(host_stack)
-    if not on_tpu:
-        host_stack_np = host_stack  # CPU: the native candidate reads it directly
+    if on_tpu:
+        # transfer per-update slices (~200 MB each @25M), never one multi-GB
+        # RPC: the round-3 tunnel window died with UNAVAILABLE inside a
+        # single 3.2 GB device_put before any kernel ran
+        slices = []
+        for i in range(k):
+            s = jax.device_put(host_stack[i])
+            jax.block_until_ready(s)
+            slices.append(s)
+            print(f"staged update {i + 1}/{k}", file=sys.stderr)
+        stack = jnp.stack(slices)
+        jax.block_until_ready(stack)
+        del slices
+    else:
+        # local CPU device: one copy, no RPC to protect against (the 16 GB
+        # gate above is sized for exactly numpy + jax copies of the stack)
+        stack = jax.device_put(host_stack)
+        host_stack_np = host_stack  # the native candidate reads it directly
     del host_stack
 
     # candidate kernels: XLA fold, (on real accelerators) the Pallas fold at

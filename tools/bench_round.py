@@ -100,7 +100,8 @@ def main() -> None:
         class _HostAggregator:
             def __init__(self):
                 self._agg = Aggregation(config.pair(), model_len)
-                self._unit_l = host_limbs.n_limbs_for_order(config.pair().unit.order)
+                unit_l = host_limbs.n_limbs_for_order(config.pair().unit.order)
+                self._zero_units = np.zeros((k_batch, unit_l), dtype=np.uint32)
 
             @property
             def acc(self):
@@ -111,8 +112,7 @@ def main() -> None:
                 return self._agg.nb_models
 
             def add_batch(self, stack):
-                units = np.zeros((stack.shape[0], self._unit_l), dtype=np.uint32)
-                self._agg.aggregate_batch(stack, units)
+                self._agg.aggregate_batch(stack, self._zero_units[: stack.shape[0]])
 
             def unmask_limbs(self, mask_vect):
                 return host_limbs.mod_sub(self.acc, mask_vect, ol)
@@ -129,6 +129,7 @@ def main() -> None:
 
     asyncio.run(_seed_store())
 
+    stage_label = "stage + fold (device)" if on_tpu else "stage + fold (host)"
     t_parse = t_validate = t_seed = t_stage = 0.0
     pool = ThreadPoolExecutor(max_workers=max(2, (os.cpu_count() or 2)))
     t_total0 = time.perf_counter()
@@ -238,7 +239,7 @@ def main() -> None:
         ("wire parse (thread pool)", t_parse),
         ("validate", t_validate),
         ("seed-dict inserts", t_seed),
-        ("stage + fold (device)", t_stage),
+        (stage_label, t_stage),
         ("update phase wall", t_update_phase),
         (f"sum2 mask derive+sum ({k_sum2} seeds)", t_sum2),
         ("unmask + decode", t_unmask),
