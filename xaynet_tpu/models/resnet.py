@@ -57,13 +57,15 @@ class ResNet50(nn.Module):
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 
 
-def init_params(rng, image_shape=(64, 64, 3), num_classes: int = 1000):
-    model = ResNet50(num_classes)
+def init_params(rng, image_shape=(64, 64, 3), num_classes: int = 1000,
+                stage_sizes: Sequence[int] = (3, 4, 6, 3)):
+    model = ResNet50(num_classes, stage_sizes=tuple(stage_sizes))
     return model.init(rng, jnp.zeros((1, *image_shape)))
 
 
-def make_train_step(num_classes: int = 1000, learning_rate: float = 0.1):
-    model = ResNet50(num_classes)
+def make_train_step(num_classes: int = 1000, learning_rate: float = 0.1,
+                    stage_sizes: Sequence[int] = (3, 4, 6, 3)):
+    model = ResNet50(num_classes, stage_sizes=tuple(stage_sizes))
     tx = optax.sgd(learning_rate, momentum=0.9)
 
     def loss_fn(params, x, y):
