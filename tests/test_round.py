@@ -12,6 +12,7 @@ import asyncio
 from fractions import Fraction
 
 import numpy as np
+import pytest
 
 from xaynet_tpu.sdk.client import InProcessClient
 from xaynet_tpu.sdk.simulation import keys_for_task
@@ -156,14 +157,33 @@ def test_full_pet_round():
     np.testing.assert_allclose(got, expected, atol=1e-9)
 
 
-def test_round_with_chunked_updates_and_device_aggregation():
-    """Multipart update messages + TPU-mesh aggregation, end to end."""
+@pytest.mark.parametrize("kernel", ["auto", "pallas-interpret"])
+def test_round_with_chunked_updates_and_device_aggregation(kernel, monkeypatch):
+    """Multipart update messages + TPU-mesh aggregation, end to end.
+
+    ``auto`` resolves to the XLA fold on the CPU backend; the
+    ``pallas-interpret`` leg drives the whole round through the Pallas
+    grid/BlockSpec path (via shard_map on the 8-device mesh) so the fused
+    kernel is continuously exercised, with a spy proving it folded.
+    """
+    import xaynet_tpu.ops.fold_pallas as fold_pallas
+
+    pallas_calls = []
+    if kernel == "pallas-interpret":
+        real = fold_pallas.fold_planar_batch_pallas
+
+        def spy(acc, stack, order, interpret=False, tile_size=None):
+            pallas_calls.append(interpret)
+            return real(acc, stack, order, interpret=interpret, tile_size=tile_size)
+
+        monkeypatch.setattr(fold_pallas, "fold_planar_batch_pallas", spy)
 
     async def run():
         settings = _settings()
         settings.model.length = 600  # update payload >> max_message_size
         settings.aggregation.device = True
         settings.aggregation.batch_size = 2
+        settings.aggregation.kernel = kernel
         store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
         machine, request_tx, events = await StateMachineInitializer(settings, store).init()
         handler = PetMessageHandler(events, request_tx)
@@ -219,8 +239,10 @@ def test_round_with_chunked_updates_and_device_aggregation():
             except (asyncio.CancelledError, Exception):
                 pass
 
-    got, expected = asyncio.run(asyncio.wait_for(run(), timeout=90))
+    got, expected = asyncio.run(asyncio.wait_for(run(), timeout=180))
     np.testing.assert_allclose(got, expected, atol=1e-9)
+    if kernel == "pallas-interpret":
+        assert pallas_calls and all(pallas_calls), "round did not fold through the Pallas kernel"
 
 
 def test_sum_participant_save_restore_mid_round():

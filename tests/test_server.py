@@ -227,8 +227,14 @@ bound_type = "b2"
     with pytest.raises(SettingsError):
         bad.validate()
 
+    bad = _settings()
+    bad.aggregation.kernel = "mosaic"  # not a valid fold kernel name
+    with pytest.raises(SettingsError):
+        bad.validate()
 
-def test_staged_aggregator_device_matches_host():
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas-interpret"])
+def test_staged_aggregator_device_matches_host(kernel):
     """Device (mesh) aggregation path == host path, including unmask."""
     import numpy as np
 
@@ -247,7 +253,7 @@ def test_staged_aggregator_device_matches_host():
     n, k = 57, 7
     rng = np.random.default_rng(9)
     host = StagedAggregator(cfg.pair(), n, device=False, batch_size=3)
-    dev = StagedAggregator(cfg.pair(), n, device=True, batch_size=3)
+    dev = StagedAggregator(cfg.pair(), n, device=True, batch_size=3, kernel=kernel)
     for _ in range(k):
         w = rng.uniform(-1, 1, n).astype(np.float32)
         _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
@@ -258,6 +264,8 @@ def test_staged_aggregator_device_matches_host():
     a, b = host.finalize(), dev.finalize()
     assert a.nb_models == b.nb_models == k
     assert a.object == b.object
+    assert host.kernel_used == "host"
+    assert dev.kernel_used == kernel
 
 
 def test_sdk_sum2_device_path_matches_host(monkeypatch):

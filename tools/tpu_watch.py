@@ -34,7 +34,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_WATCH.log")
 HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
-EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r03.md")
+EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r04.md")
 
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
@@ -164,7 +164,7 @@ def main() -> int:
                 # TPU_WATCH.log + BENCH_HISTORY.jsonl)
                 with open(EVIDENCE, "a") as f:
                     if f.tell() == 0:
-                        f.write("# TPU evidence — round 3 (captured by tools/tpu_watch.py)\n\n")
+                        f.write("# TPU evidence — round 4 (captured by tools/tpu_watch.py)\n\n")
                     f.write(f"## window at {_now()} (probe attempt {attempt})\n\n")
                     for rec in good:
                         f.write(f"### {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")

@@ -12,6 +12,9 @@ tiny passes, no collectives (the length axis is embarrassingly parallel).
 
 from __future__ import annotations
 
+import logging
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,16 +23,45 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.mask.config import MaskConfig
 from ..ops import limbs as host_limbs
 from ..ops.fold_jax import MAX_LAZY_BATCH, fold_planar_batch, p_mod_sub, wire_to_planar
+from ..utils.kernels import FOLD_KERNELS
 from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple
+
+logger = logging.getLogger(__name__)
 
 _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 
+# auto-calibration verdicts, process-wide: a long-running coordinator builds
+# a fresh aggregator every round but the (backend, shape, order) question has
+# the same answer every time
+_AUTO_KERNEL_CACHE: dict[tuple, str] = {}
+
 
 class ShardedAggregator:
-    """Accumulates masked updates on-device, sharded over the model axis."""
+    """Accumulates masked updates on-device, sharded over the model axis.
 
-    def __init__(self, config: MaskConfig, model_length: int, mesh=None, use_pallas: bool = False):
-        self.use_pallas = use_pallas
+    ``kernel`` picks the fold implementation: ``"xla"`` (``ops.fold_jax``),
+    ``"pallas"`` (the fused VMEM kernel, ``ops.fold_pallas``),
+    ``"pallas-interpret"`` (same kernel through the Pallas interpreter — the
+    CI path that keeps the grid/BlockSpec layout continuously exercised
+    without a Mosaic compiler), or ``"auto"``: on accelerator backends the
+    first fold times XLA vs Pallas on the real staged batch and keeps the
+    winner; on CPU it short-circuits to XLA (interpret-mode Pallas is an
+    oracle, not a production kernel). The choice actually taken is reported
+    in ``kernel_used``.
+    """
+
+    def __init__(
+        self,
+        config: MaskConfig,
+        model_length: int,
+        mesh=None,
+        kernel: str = "xla",
+    ):
+        if kernel not in FOLD_KERNELS:
+            raise ValueError(f"kernel must be one of {FOLD_KERNELS}, got {kernel!r}")
+        self.kernel = kernel
+        self.kernel_used: str | None = None  # resolved on first fold
+        self._fold_fn = None  # built once kernel_used resolves
         self.config = config
         self.model_length = model_length
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -66,23 +98,96 @@ class ShardedAggregator:
         if stack.shape[0] > MAX_LAZY_BATCH:
             raise ValueError("batch too large for lazy-carry fold")
         staged = jax.device_put(self._to_planar_padded(stack), self._batch_sharding)
-        if self.use_pallas:
-            from ..ops.fold_pallas import fold_planar_batch_pallas
-
-            self.acc = fold_planar_batch_pallas(self.acc, staged, self.order)
-        else:
-            self.acc = fold_planar_batch(self.acc, staged, self.order)
+        self.acc = self._fold(self.acc, staged)
         self.nb_models += stack.shape[0]
 
     def add_planar_batch(self, stack_planar: jax.Array) -> None:
         """Fold an already device-resident planar ``[K, L, padded_len]`` batch."""
-        if self.use_pallas:
-            from ..ops.fold_pallas import fold_planar_batch_pallas
-
-            self.acc = fold_planar_batch_pallas(self.acc, stack_planar, self.order)
-        else:
-            self.acc = fold_planar_batch(self.acc, stack_planar, self.order)
+        self.acc = self._fold(self.acc, stack_planar)
         self.nb_models += stack_planar.shape[0]
+
+    # -- kernel selection ---------------------------------------------------
+
+    def _zero_acc(self):
+        return jax.device_put(
+            jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding
+        )
+
+    def _make_fold_fn(self, kernel: str):
+        """Build the fold callable for ``kernel``, wrapped once for reuse."""
+        if kernel in ("pallas", "pallas-interpret"):
+            from ..ops import fold_pallas
+
+            interpret = kernel == "pallas-interpret"
+            order = self.order
+
+            def call(a, s):
+                # late module-attribute lookup so test spies see the call
+                return fold_pallas.fold_planar_batch_pallas(a, s, order, interpret=interpret)
+
+            if self.mesh.devices.size > 1:
+                # the fold is elementwise along the model axis, so each
+                # device runs the Pallas kernel on its local shard —
+                # shard_map makes the kernel multichip without a custom
+                # partitioner; the outer jit restores accumulator donation
+                return jax.jit(
+                    jax.shard_map(
+                        call,
+                        mesh=self.mesh,
+                        in_specs=(P(None, MODEL_AXIS), P(None, None, MODEL_AXIS)),
+                        out_specs=P(None, MODEL_AXIS),
+                        check_vma=False,  # pallas_call's out_shape carries no vma
+                    ),
+                    donate_argnums=(0,),
+                )
+            return call
+        return lambda a, s: fold_planar_batch(a, s, self.order)
+
+    def _fold(self, acc, staged):
+        if self._fold_fn is None:
+            self._resolve_kernel(staged)
+            self._fold_fn = self._make_fold_fn(self.kernel_used)
+        return self._fold_fn(acc, staged)
+
+    def _resolve_kernel(self, staged) -> None:
+        """Fix ``kernel_used`` for the aggregator's lifetime.
+
+        ``auto`` calibrates both kernels against the first real staged batch
+        (fresh zero accumulators — the folds donate their accumulator), takes
+        the faster steady-state time, and falls back to XLA if the Pallas
+        (Mosaic) compile fails so a broken kernel can never sink a round.
+        Verdicts are memoized process-wide: a coordinator builds a fresh
+        aggregator every round, but the answer only depends on the backend
+        and the problem shape.
+        """
+        if self.kernel != "auto":
+            self.kernel_used = self.kernel
+            return
+        backend = jax.default_backend()
+        key = (backend, self.n_limbs, self.padded_length, self.order)
+        cached = _AUTO_KERNEL_CACHE.get(key)
+        if cached is not None:
+            self.kernel_used = cached
+            return
+        if backend == "cpu":
+            # interpret-mode Pallas is an oracle, not a production kernel
+            self.kernel_used = "xla"
+        else:
+            timings = {}
+            for name in ("xla", "pallas"):
+                try:
+                    fold = self._make_fold_fn(name)
+                    fold(self._zero_acc(), staged).block_until_ready()  # compile
+                    t0 = time.perf_counter()
+                    fold(self._zero_acc(), staged).block_until_ready()
+                    timings[name] = time.perf_counter() - t0
+                except Exception as e:  # Mosaic compile/run failure -> keep XLA
+                    logger.warning(
+                        "aggregation kernel %s unavailable: %s: %s", name, type(e).__name__, e
+                    )
+            self.kernel_used = min(timings, key=timings.get) if timings else "xla"
+            logger.info("aggregation kernel auto-calibration: %s -> %s", timings, self.kernel_used)
+        _AUTO_KERNEL_CACHE[key] = self.kernel_used
 
     def unmask_limbs(self, mask_vect) -> np.ndarray:
         """Subtract the aggregated mask; returns host wire ``uint32[model_len, L]``."""

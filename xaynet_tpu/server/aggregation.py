@@ -35,6 +35,7 @@ class StagedAggregator:
         batch_size: int = 64,
         ingest_workers: int = 4,
         mesh=None,
+        kernel: str = "auto",
     ):
         self.config = config
         self.object_size = object_size
@@ -51,7 +52,7 @@ class StagedAggregator:
             from ..ops import limbs as limb_ops
             from ..parallel.aggregator import ShardedAggregator
 
-            self._device = ShardedAggregator(config.vect, object_size, mesh=mesh)
+            self._device = ShardedAggregator(config.vect, object_size, mesh=mesh, kernel=kernel)
             # tiny unit part stays on host
             self._unit_acc = np.zeros(
                 limb_ops.n_limbs_for_order(config.unit.order), dtype=np.uint32
@@ -62,6 +63,14 @@ class StagedAggregator:
             self._ingest_pool = ThreadPoolExecutor(
                 max_workers=max(1, ingest_workers), thread_name_prefix="xn-ingest"
             )
+
+    @property
+    def kernel_used(self) -> str:
+        """Which fold kernel actually ran (``host`` off-device; on device the
+        resolved choice, or the configured one before the first fold)."""
+        if self._device is None:
+            return "host"
+        return self._device.kernel_used or self._device.kernel
 
     @property
     def nb_models(self) -> int:

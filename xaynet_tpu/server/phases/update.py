@@ -36,6 +36,7 @@ class UpdatePhase(PhaseState):
             object_size=shared.state.round_params.model_length,
             device=settings.aggregation.device,
             batch_size=settings.aggregation.batch_size,
+            kernel=settings.aggregation.kernel,
         )
         self._seed_dict = None
 

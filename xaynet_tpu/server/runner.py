@@ -139,11 +139,31 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         logger.info("coordinator stopped")
 
 
+def _pin_jax_platform() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative for the coordinator process.
+
+    Site configurations that register experimental accelerator plugins can
+    override ``jax_platforms`` at import time; when ``aggregation.device`` is
+    on, the first fold would then initialize that backend even though the
+    operator asked for another (and a dead accelerator tunnel hangs backend
+    init forever). Re-assert the env var on the live config before any
+    backend is touched. No-op when the operator didn't set it.
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="xaynet-tpu coordinator")
     parser.add_argument("-c", "--config", help="TOML configuration file", default=None)
     args = parser.parse_args()
     settings = Settings.load(args.config)
+    _pin_jax_platform()
     asyncio.run(serve(settings))
 
 

@@ -17,6 +17,7 @@ from typing import Any, Optional
 
 from ..core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
 from ..core.message import SUM_COUNT_MIN, UPDATE_COUNT_MIN
+from ..utils.kernels import FOLD_KERNELS
 
 
 class SettingsError(ValueError):
@@ -143,6 +144,9 @@ class LoggingSettings:
 class AggregationSettings:
     device: bool = False  # fold updates on the TPU mesh instead of host numpy
     batch_size: int = 64  # staged updates per device fold
+    # fold kernel when device=True: auto (calibrate XLA vs Pallas on the
+    # first flush), xla, pallas, or pallas-interpret (CI oracle path)
+    kernel: str = "auto"
 
 
 @dataclass
@@ -164,6 +168,10 @@ class Settings:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
             raise SettingsError("aggregation.batch_size must be >= 1")
+        if self.aggregation.kernel not in FOLD_KERNELS:
+            raise SettingsError(
+                "aggregation.kernel must be one of: " + " | ".join(FOLD_KERNELS)
+            )
 
     @classmethod
     def default(cls) -> "Settings":
@@ -282,6 +290,7 @@ class Settings:
             aggregation=AggregationSettings(
                 device=bool(agg_raw.get("device", False)),
                 batch_size=int(agg_raw.get("batch_size", base.aggregation.batch_size)),
+                kernel=str(agg_raw.get("kernel", base.aggregation.kernel)),
             ),
         )
 

@@ -1,0 +1,8 @@
+"""Fold-kernel registry.
+
+Single source of truth for the aggregation fold kernel names, shared by
+``parallel.aggregator`` (which executes them) and ``server.settings`` (which
+validates configs without importing jax).
+"""
+
+FOLD_KERNELS = ("auto", "xla", "pallas", "pallas-interpret")
