@@ -15,6 +15,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -126,7 +127,16 @@ def main() -> None:
                     int(line.split()[1]) for line in f if line.startswith("MemAvailable:")
                 )
         except (OSError, StopIteration):
-            avail_kb = 0
+            # non-Linux hosts: estimate from total physical pages rather
+            # than silently dropping a well-provisioned box to the scaled
+            # 1M smoke (ADVICE r3)
+            try:
+                avail_kb = (
+                    os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE") // 1024 // 2
+                )
+            except (ValueError, OSError, AttributeError):
+                avail_kb = 0
+                print("meminfo unavailable; falling back to scaled smoke", file=sys.stderr)
         if avail_kb >= 16 * 1024 * 1024:
             # k=16 amortizes the accumulator read/write against the
             # mandatory one-read-of-the-batch (measured +10% vs k=8)

@@ -421,6 +421,11 @@ XN_EXPORT void xn_mod_sub(const uint32_t* a, const uint32_t* b, uint32_t* out,
 // Requirements: elements < order; K <= 65535; L <= 63. All-zero
 // order_limbs means order == 2^(32L): natural wraparound. Returns 0 on
 // success, 1 on a parameter violation.
+// PRECONDITION (not checked here, cost would double the single pass):
+// every acc/stack element must already be < order — the kbits reduction
+// relies on the running value staying < (K+1)*order, so out-of-range
+// input silently yields a result >= order. Python callers route inbound
+// data through elements_lt_order/is_valid before folding.
 XN_EXPORT int xn_fold_wire_nlimb(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
                                  uint64_t n, uint32_t n_limbs, uint64_t k,
                                  const uint32_t* order_limbs) {

@@ -7,9 +7,16 @@ batches, and a down/slow sink costs bounded memory (drop + count), never
 coordinator latency.
 """
 
+import json
+import os
 import threading
 import time
+import urllib.parse
+import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
 
 from xaynet_tpu.server.metrics import InfluxHttpMetrics
 
@@ -77,6 +84,99 @@ def test_dispatcher_never_blocks_when_sink_is_down():
     assert m._queue.qsize() <= 32
     assert m.dropped > 0  # overflow was counted, not silently lost
     m.close()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("XAYNET_INFLUX"),
+    reason="set XAYNET_INFLUX=host:port to test against a live InfluxDB",
+)
+def test_dispatcher_against_live_influxdb():
+    """The line protocol we emit parses in a REAL InfluxDB: write the full
+    measurement families through the production sink, then query the points
+    back over /query and check tags/values survived the round trip.
+    (CI `test-live-influxdb` job, influxdb:1.8 service container — the
+    reference's equivalent: .github/workflows/rust.yml:212-227.)"""
+    host, _, port = os.environ["XAYNET_INFLUX"].partition(":")
+    base = f"http://{host}:{int(port or 8086)}"
+    db = f"xn_test_{uuid.uuid4().hex[:12]}"
+
+    def query(q, use_db=True):
+        params = {"q": q}
+        if use_db:
+            params["db"] = db
+        encoded = urllib.parse.urlencode(params)
+        # InfluxDB 1.x: SELECT/SHOW go over GET; management statements
+        # (CREATE/DROP DATABASE) must be POSTed
+        if q.split()[0].upper() in ("SELECT", "SHOW"):
+            req = urllib.request.Request(f"{base}/query?{encoded}")
+        else:
+            req = urllib.request.Request(
+                f"{base}/query",
+                data=encoded.encode(),
+                headers={"Content-Type": "application/x-www-form-urlencoded"},
+                method="POST",
+            )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    query(f'CREATE DATABASE "{db}"', use_db=False)
+    try:
+        m = InfluxHttpMetrics(base, db, flush_interval=0.05)
+        m.phase(3, "sum")
+        m.round_total(3)
+        m.message_accepted(3, "sum")
+        m.message_rejected(3, "sum")
+        m.message_discarded(3, "sum")
+        m.masks_total(3, 7)
+        m.phase_duration(3, "sum", 1.25)
+        m.event(3, "phase_error", 'timeout "quoted"')
+        deadline = time.time() + 15
+        series = {}
+        want = {
+            "xaynet_phase",
+            "xaynet_round_total_number",
+            "xaynet_message_accepted",
+            "xaynet_message_rejected",
+            "xaynet_message_discarded",
+            "xaynet_masks_total_number",
+            "xaynet_phase_duration_seconds",
+            "xaynet_event_phase_error",
+        }
+        while time.time() < deadline and set(series) != want:
+            res = query("SHOW MEASUREMENTS")
+            names = {
+                v[0]
+                for s in res["results"][0].get("series", [])
+                for v in s.get("values", [])
+            }
+            for name in names & want - set(series):
+                pts = query(f'SELECT * FROM "{name}"')
+                series[name] = pts["results"][0].get("series", [])
+            time.sleep(0.1)
+        m.close()
+        assert set(series) == want, f"missing measurements: {want - set(series)}"
+        phase_series = series["xaynet_phase"][0]
+        cols = phase_series["columns"]
+        row = phase_series["values"][0]
+        point = dict(zip(cols, row))
+        assert point["round_id"] == "3"
+        assert point["phase"] == "sum"
+        dur = dict(
+            zip(
+                series["xaynet_phase_duration_seconds"][0]["columns"],
+                series["xaynet_phase_duration_seconds"][0]["values"][0],
+            )
+        )
+        assert abs(float(dur["value"]) - 1.25) < 1e-9
+        ev = dict(
+            zip(
+                series["xaynet_event_phase_error"][0]["columns"],
+                series["xaynet_event_phase_error"][0]["values"][0],
+            )
+        )
+        assert ev["value"] == 'timeout "quoted"'
+    finally:
+        query(f'DROP DATABASE "{db}"', use_db=False)
 
 
 def test_dispatcher_close_flushes_tail():

@@ -5,10 +5,20 @@ HEAD/PUT/GET object) and — crucially — *recomputes and verifies the AWS
 SigV4 signature* of every request with the shared secret, so the from-
 scratch signing implementation is actually validated, not just exercised
 (reference matrix: rust/xaynet-server/src/storage/model_storage/s3.rs).
+
+Set ``XAYNET_S3=host:port`` (plus ``XAYNET_S3_ACCESS``/``XAYNET_S3_SECRET``,
+default minioadmin) to additionally run the data-model tests against a real
+S3-compatible server — the CI ``test-live-minio`` job does this with a
+pinned `minio/minio` container (started via docker run; the official image
+needs its `server /data` command), the way the reference tests against
+Minio (.github/workflows/rust.yml:212-227). That run validates the SigV4
+signer against an implementation we did not write.
 """
 
 import asyncio
 import hashlib
+import os
+import uuid
 
 import pytest
 
@@ -115,22 +125,72 @@ class FakeS3:
         return 400, b"bad request"
 
 
-def _store(port):
-    return S3ModelStorage(
-        endpoint=f"http://127.0.0.1:{port}",
-        bucket="global-models",
-        access_key=ACCESS,
-        secret_key=SECRET,
-        region=REGION,
-    )
+class _S3Backend:
+    """One S3 endpoint for a data-model test: the in-process SigV4-verifying
+    fake, or a live server at ``XAYNET_S3=host:port``. Buckets are
+    uniquified per test so live runs don't see earlier state."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.fake = None
+
+    async def __aenter__(self) -> "_S3Backend":
+        if self.kind == "live":
+            host, _, port = os.environ["XAYNET_S3"].partition(":")
+            self.endpoint = f"http://{host}:{int(port or 9000)}"
+            self.access = os.environ.get("XAYNET_S3_ACCESS", "minioadmin")
+            self.secret = os.environ.get("XAYNET_S3_SECRET", "minioadmin")
+        else:
+            self.fake = FakeS3()
+            port = await self.fake.start()
+            self.endpoint = f"http://127.0.0.1:{port}"
+            self.access, self.secret = ACCESS, SECRET
+        self.bucket = f"xn-test-{uuid.uuid4().hex[:12]}"
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.fake is not None:
+            await self.fake.stop()
+        else:
+            # best-effort: don't leak uuid buckets on a shared live server
+            try:
+                store = self.store()
+                listing = await store._request("GET", f"/{self.bucket}")
+                if listing.status == 200:
+                    import re
+
+                    for key in re.findall(rb"<Key>([^<]+)</Key>", listing.body):
+                        await store._request("DELETE", f"/{self.bucket}/{key.decode()}")
+                await store._request("DELETE", f"/{self.bucket}")
+            except Exception:
+                pass
+
+    def store(self, secret_key: str | None = None) -> S3ModelStorage:
+        return S3ModelStorage(
+            endpoint=self.endpoint,
+            bucket=self.bucket,
+            access_key=self.access,
+            secret_key=secret_key or self.secret,
+            region=REGION,
+        )
 
 
-def test_s3_full_cycle_with_signature_verification():
+def _backend_params():
+    params = ["fake"]
+    if os.environ.get("XAYNET_S3"):
+        params.append("live")
+    return params
+
+
+@pytest.fixture(params=_backend_params())
+def s3_kind(request):
+    return request.param
+
+
+def test_s3_full_cycle_with_signature_verification(s3_kind):
     async def run():
-        fake = FakeS3()
-        port = await fake.start()
-        store = _store(port)
-        try:
+        async with _S3Backend(s3_kind) as be:
+            store = be.store()
             # bucket lifecycle: create, idempotent re-create, readiness
             with pytest.raises(StorageError):
                 await store.is_ready()  # bucket doesn't exist yet
@@ -149,28 +209,16 @@ def test_s3_full_cycle_with_signature_verification():
             with pytest.raises(StorageError, match="already exists"):
                 await store.set_global_model(7, seed, b"other-bytes")
             assert await store.global_model(model_id) == b"model-bytes-7"
-        finally:
-            await fake.stop()
 
     asyncio.run(run())
 
 
-def test_s3_bad_credentials_rejected():
+def test_s3_bad_credentials_rejected(s3_kind):
     async def run():
-        fake = FakeS3()
-        port = await fake.start()
-        bad = S3ModelStorage(
-            endpoint=f"http://127.0.0.1:{port}",
-            bucket="global-models",
-            access_key=ACCESS,
-            secret_key="wrong-secret",
-            region=REGION,
-        )
-        try:
+        async with _S3Backend(s3_kind) as be:
+            bad = be.store(secret_key="wrong-secret")
             with pytest.raises(StorageError, match="403|failed"):
                 await bad.create_bucket()
-        finally:
-            await fake.stop()
 
     asyncio.run(run())
 
@@ -180,22 +228,28 @@ def test_s3_unreachable_raises_typed_error():
         fake = FakeS3()
         port = await fake.start()
         await fake.stop()  # nothing listening
-        store = _store(port)
+        store = S3ModelStorage(
+            endpoint=f"http://127.0.0.1:{port}",
+            bucket="global-models",
+            access_key=ACCESS,
+            secret_key=SECRET,
+            region=REGION,
+        )
         with pytest.raises(StorageError, match="unreachable"):
             await store.is_ready()
 
     asyncio.run(run())
 
 
-def test_s3_conditional_put_closes_head_put_race():
+def test_s3_conditional_put_closes_head_put_race(s3_kind):
     """Even if the HEAD pre-check is bypassed (two concurrent writers), the
-    conditional PUT refuses the second write atomically."""
+    conditional PUT refuses the second write atomically. Minio supports
+    `If-None-Match: *` since RELEASE.2024-08; the CI service container is
+    recent enough."""
 
     async def run():
-        fake = FakeS3()
-        port = await fake.start()
-        store = _store(port)
-        try:
+        async with _S3Backend(s3_kind) as be:
+            store = be.store()
             await store.create_bucket()
             seed = b"\x11" * 32
             await store.set_global_model(3, seed, b"first")
@@ -206,7 +260,5 @@ def test_s3_conditional_put_closes_head_put_race():
             )
             assert resp.status == 412
             assert await store.global_model(model_id) == b"first"
-        finally:
-            await fake.stop()
 
     asyncio.run(run())

@@ -145,8 +145,9 @@ class ShardedAggregator:
 
     def _fold(self, acc, staged):
         if self._fold_fn is None:
-            self._resolve_kernel(staged)
-            self._fold_fn = self._make_fold_fn(self.kernel_used)
+            self._resolve_kernel(staged)  # may already set _fold_fn (winner)
+            if self._fold_fn is None:
+                self._fold_fn = self._make_fold_fn(self.kernel_used)
         return self._fold_fn(acc, staged)
 
     def _resolve_kernel(self, staged) -> None:
@@ -164,7 +165,9 @@ class ShardedAggregator:
             self.kernel_used = self.kernel
             return
         backend = jax.default_backend()
-        key = (backend, self.n_limbs, self.padded_length, self.order)
+        # K is part of the key: a verdict timed on a small remainder flush
+        # must not bind the steady-state batch size (and vice versa)
+        key = (backend, self.n_limbs, self.padded_length, self.order, staged.shape[0])
         cached = _AUTO_KERNEL_CACHE.get(key)
         if cached is not None:
             self.kernel_used = cached
@@ -173,7 +176,7 @@ class ShardedAggregator:
             # interpret-mode Pallas is an oracle, not a production kernel
             self.kernel_used = "xla"
         else:
-            timings = {}
+            timings, fns = {}, {}
             for name in ("xla", "pallas"):
                 try:
                     fold = self._make_fold_fn(name)
@@ -181,11 +184,14 @@ class ShardedAggregator:
                     t0 = time.perf_counter()
                     fold(self._zero_acc(), staged).block_until_ready()
                     timings[name] = time.perf_counter() - t0
+                    fns[name] = fold
                 except Exception as e:  # Mosaic compile/run failure -> keep XLA
                     logger.warning(
                         "aggregation kernel %s unavailable: %s: %s", name, type(e).__name__, e
                     )
             self.kernel_used = min(timings, key=timings.get) if timings else "xla"
+            # keep the winner's already-compiled callable
+            self._fold_fn = fns.get(self.kernel_used)
             logger.info("aggregation kernel auto-calibration: %s -> %s", timings, self.kernel_used)
         _AUTO_KERNEL_CACHE[key] = self.kernel_used
 

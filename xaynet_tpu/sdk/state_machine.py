@@ -297,6 +297,9 @@ class StateMachine:
         from concurrent.futures import ThreadPoolExecutor
 
         mask_agg = Aggregation(config, length)
+        # the validation loop below scribbles on nb_models and resets it to
+        # 0; that is only correct against a freshly-built Aggregation
+        assert mask_agg.nb_models == 0
         if len(mask_seeds) > 1:
             with ThreadPoolExecutor(max_workers=min(8, len(mask_seeds))) as pool:
                 masks = list(pool.map(lambda s: s.derive_mask(length, config), mask_seeds))
