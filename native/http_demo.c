@@ -56,9 +56,15 @@ int main(int argc, char** argv) {
     fprintf(stderr, "crypto init failed\n");
     return 1;
   }
-  XnHttpClient* http = xn_http_client_new(host, port);
+  /* XN_TLS_CA pins the coordinator's root cert (in-process TLS);
+   * XN_TLS_CERT + XN_TLS_KEY add a client identity (mutual TLS) */
+  const char* tls_ca = getenv("XN_TLS_CA");
+  XnHttpClient* http =
+      tls_ca ? xn_http_client_new_tls(host, port, tls_ca, getenv("XN_TLS_CERT"),
+                                      getenv("XN_TLS_KEY"))
+             : xn_http_client_new(host, port);
   if (!http) {
-    fprintf(stderr, "http client alloc failed\n");
+    fprintf(stderr, "http client alloc failed%s\n", tls_ca ? " (tls)" : "");
     return 1;
   }
   /* scalar 1/3: the smoke round runs 3 update participants */
@@ -72,9 +78,25 @@ int main(int argc, char** argv) {
   for (uint64_t i = 0; i < model_len; i++) model[i] = value;
 
   int last_task = -1;
+  int consecutive_transport_errors = 0, ever_reached = 0;
   for (int i = 0; i < 600; i++) {
     int rc = xaynet_ffi_participant_tick(p);
-    if (rc < 0 && rc != -2 /* transport errors are transient: keep polling */) {
+    if (rc == -2) {
+      /* transient once the coordinator has been reached at least once;
+       * 20 straight failures from the start means the endpoint/TLS config
+       * is wrong (e.g. a root-pin mismatch) — abort instead of spinning */
+      if (!ever_reached && ++consecutive_transport_errors >= 20) {
+        fprintf(stderr, "transport unreachable from the first tick (endpoint/TLS config?)\n");
+        free(model);
+        xaynet_ffi_participant_destroy(p);
+        xn_http_client_free(http);
+        return 1;
+      }
+    } else {
+      ever_reached = 1;
+      consecutive_transport_errors = 0;
+    }
+    if (rc < 0 && rc != -2) {
       fprintf(stderr, "fatal tick error %d\n", rc);
       free(model);
       xaynet_ffi_participant_destroy(p);

@@ -7,8 +7,11 @@
  * `xaynet_ffi_participant_new` — no caller-written transport required.
  *
  * Plain POSIX sockets, one request per connection (`Connection: close`),
- * no third-party dependencies. TLS termination is expected at a proxy /
- * sidecar, as in the k8s development overlay (deploy/k8s/.../ingress.yaml).
+ * no third-party link-time dependencies. TLS (reqwest_client.rs:58-71
+ * parity: root-cert PINNING + optional in-process client identity) comes
+ * from `xn_http_client_new_tls`, which loads the system's libssl at
+ * runtime via dlopen — the plain-HTTP build and embedders that terminate
+ * TLS at a sidecar pay nothing for it.
  *
  * Contract (native/xaynet_participant.cpp:745-753): `request` is
  * "METHOD /path", the body is sent for POSTs; return 0 on HTTP 200 with a
@@ -16,7 +19,9 @@
  * negative on transport failure.
  */
 
+#include <arpa/inet.h>
 #include <ctype.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <netdb.h>
 #include <stdint.h>
@@ -31,6 +36,10 @@
 struct XnHttpClient {
   char host[256];
   char port[16];
+  int use_tls;
+  char ca_path[512];    /* pinned root(s); the ONLY trust anchors used */
+  char cert_path[512];  /* optional client identity (mutual TLS) */
+  char key_path[512];
 };
 
 XnHttpClient* xn_http_client_new(const char* host, uint16_t port) {
@@ -43,6 +52,166 @@ XnHttpClient* xn_http_client_new(const char* host, uint16_t port) {
 }
 
 void xn_http_client_free(XnHttpClient* c) { free(c); }
+
+/* --- TLS via the system libssl, loaded at runtime ----------------------- */
+
+/* Minimal prototypes for the stable OpenSSL (1.1+/3.x) C ABI we use; the
+ * build needs no OpenSSL headers. Opaque pointers throughout. */
+typedef struct {
+  void* libssl;
+  void* libcrypto;
+  const void* (*TLS_client_method)(void);
+  void* (*SSL_CTX_new)(const void*);
+  void (*SSL_CTX_free)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  int (*SSL_CTX_check_private_key)(const void*);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  void* (*SSL_get0_param)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*X509_VERIFY_PARAM_set1_host)(void*, const char*, size_t);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*);
+} XnTlsApi;
+
+#define XN_SSL_VERIFY_PEER 0x01
+#define XN_SSL_FILETYPE_PEM 1
+#define XN_SSL_CTRL_SET_TLSEXT_HOSTNAME 55
+#define XN_TLSEXT_NAMETYPE_host_name 0
+
+static void* xn_dl(void* lib, const char* name) { return lib ? dlsym(lib, name) : NULL; }
+
+static const XnTlsApi* xn_tls_api(void) {
+  static XnTlsApi api;
+  static int state = 0; /* 0 unloaded, 1 ok, -1 failed */
+  if (state) return state > 0 ? &api : NULL;
+  /* RTLD_LOCAL: never pollute the embedder's symbol namespace */
+  api.libssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
+  if (!api.libssl) api.libssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_LOCAL);
+  if (!api.libssl) api.libssl = dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
+  /* X509_* live in libcrypto; resolving them through the libssl handle
+   * searches its own dependency chain, guaranteeing a version-matched
+   * libssl/libcrypto pair */
+  api.libcrypto = api.libssl;
+  *(void**)&api.TLS_client_method = xn_dl(api.libssl, "TLS_client_method");
+  *(void**)&api.SSL_CTX_new = xn_dl(api.libssl, "SSL_CTX_new");
+  *(void**)&api.SSL_CTX_free = xn_dl(api.libssl, "SSL_CTX_free");
+  *(void**)&api.SSL_CTX_load_verify_locations = xn_dl(api.libssl, "SSL_CTX_load_verify_locations");
+  *(void**)&api.SSL_CTX_set_verify = xn_dl(api.libssl, "SSL_CTX_set_verify");
+  *(void**)&api.SSL_CTX_use_certificate_chain_file =
+      xn_dl(api.libssl, "SSL_CTX_use_certificate_chain_file");
+  *(void**)&api.SSL_CTX_use_PrivateKey_file = xn_dl(api.libssl, "SSL_CTX_use_PrivateKey_file");
+  *(void**)&api.SSL_CTX_check_private_key = xn_dl(api.libssl, "SSL_CTX_check_private_key");
+  *(void**)&api.SSL_new = xn_dl(api.libssl, "SSL_new");
+  *(void**)&api.SSL_free = xn_dl(api.libssl, "SSL_free");
+  *(void**)&api.SSL_set_fd = xn_dl(api.libssl, "SSL_set_fd");
+  *(void**)&api.SSL_connect = xn_dl(api.libssl, "SSL_connect");
+  *(void**)&api.SSL_read = xn_dl(api.libssl, "SSL_read");
+  *(void**)&api.SSL_write = xn_dl(api.libssl, "SSL_write");
+  *(void**)&api.SSL_shutdown = xn_dl(api.libssl, "SSL_shutdown");
+  *(void**)&api.SSL_get0_param = xn_dl(api.libssl, "SSL_get0_param");
+  *(void**)&api.SSL_ctrl = xn_dl(api.libssl, "SSL_ctrl");
+  *(void**)&api.X509_VERIFY_PARAM_set1_host = xn_dl(api.libcrypto, "X509_VERIFY_PARAM_set1_host");
+  *(void**)&api.X509_VERIFY_PARAM_set1_ip_asc =
+      xn_dl(api.libcrypto, "X509_VERIFY_PARAM_set1_ip_asc");
+  int ok = api.TLS_client_method && api.SSL_CTX_new && api.SSL_CTX_free &&
+           api.SSL_CTX_load_verify_locations && api.SSL_CTX_set_verify && api.SSL_new &&
+           api.SSL_free && api.SSL_set_fd && api.SSL_connect && api.SSL_read && api.SSL_write &&
+           api.SSL_shutdown && api.SSL_get0_param && api.SSL_ctrl &&
+           api.X509_VERIFY_PARAM_set1_host && api.X509_VERIFY_PARAM_set1_ip_asc &&
+           api.SSL_CTX_use_certificate_chain_file && api.SSL_CTX_use_PrivateKey_file &&
+           api.SSL_CTX_check_private_key;
+  state = ok ? 1 : -1;
+  return ok ? &api : NULL;
+}
+
+XnHttpClient* xn_http_client_new_tls(const char* host, uint16_t port, const char* ca_pem_path,
+                                     const char* client_cert_pem_path,
+                                     const char* client_key_pem_path) {
+  if (!ca_pem_path || strlen(ca_pem_path) >= sizeof(((XnHttpClient*)0)->ca_path)) return NULL;
+  if (client_cert_pem_path &&
+      strlen(client_cert_pem_path) >= sizeof(((XnHttpClient*)0)->cert_path))
+    return NULL;
+  if (client_key_pem_path && strlen(client_key_pem_path) >= sizeof(((XnHttpClient*)0)->key_path))
+    return NULL;
+  if ((client_cert_pem_path == NULL) != (client_key_pem_path == NULL)) return NULL;
+  if (!xn_tls_api()) return NULL; /* no usable libssl on this system */
+  XnHttpClient* c = xn_http_client_new(host, port);
+  if (!c) return NULL;
+  c->use_tls = 1;
+  snprintf(c->ca_path, sizeof(c->ca_path), "%s", ca_pem_path);
+  if (client_cert_pem_path) {
+    snprintf(c->cert_path, sizeof(c->cert_path), "%s", client_cert_pem_path);
+    snprintf(c->key_path, sizeof(c->key_path), "%s", client_key_pem_path);
+  }
+  return c;
+}
+
+/* One open connection: plain fd, or fd + TLS state. */
+typedef struct {
+  int fd;
+  void* ssl;
+  void* ctx;
+} XnConn;
+
+static void xn_conn_close(XnConn* conn) {
+  /* the ctx may exist without an ssl object (early handshake-setup failure) */
+  const XnTlsApi* t = (conn->ssl || conn->ctx) ? xn_tls_api() : NULL;
+  if (t && conn->ssl) {
+    t->SSL_shutdown(conn->ssl);
+    t->SSL_free(conn->ssl);
+  }
+  if (t && conn->ctx) t->SSL_CTX_free(conn->ctx);
+  if (conn->fd >= 0) close(conn->fd);
+  conn->fd = -1;
+  conn->ssl = conn->ctx = NULL;
+}
+
+/* TLS handshake on an already-connected fd: pinned roots, hostname/IP
+ * binding, optional client identity. Returns 0 or -1 (conn closed). */
+static int xn_tls_open(XnConn* conn, const XnHttpClient* c) {
+  const XnTlsApi* t = xn_tls_api();
+  if (!t) return -1;
+  conn->ctx = t->SSL_CTX_new(t->TLS_client_method());
+  if (!conn->ctx) return -1;
+  /* pinning: the provided CA file is the entire trust store — the system
+   * default roots are deliberately NOT loaded (reqwest_client.rs:58-63) */
+  if (t->SSL_CTX_load_verify_locations(conn->ctx, c->ca_path, NULL) != 1) goto fail;
+  t->SSL_CTX_set_verify(conn->ctx, XN_SSL_VERIFY_PEER, NULL);
+  if (c->cert_path[0]) { /* in-process client identity (mutual TLS) */
+    if (t->SSL_CTX_use_certificate_chain_file(conn->ctx, c->cert_path) != 1 ||
+        t->SSL_CTX_use_PrivateKey_file(conn->ctx, c->key_path, XN_SSL_FILETYPE_PEM) != 1 ||
+        t->SSL_CTX_check_private_key(conn->ctx) != 1)
+      goto fail;
+  }
+  conn->ssl = t->SSL_new(conn->ctx);
+  if (!conn->ssl || t->SSL_set_fd(conn->ssl, conn->fd) != 1) goto fail;
+  /* bind the peer certificate to the host we dialed */
+  {
+    void* param = t->SSL_get0_param(conn->ssl);
+    struct in_addr a4;
+    struct in6_addr a6;
+    if (inet_pton(AF_INET, c->host, &a4) == 1 || inet_pton(AF_INET6, c->host, &a6) == 1) {
+      if (t->X509_VERIFY_PARAM_set1_ip_asc(param, c->host) != 1) goto fail;
+    } else {
+      if (t->X509_VERIFY_PARAM_set1_host(param, c->host, 0) != 1) goto fail;
+      t->SSL_ctrl(conn->ssl, XN_SSL_CTRL_SET_TLSEXT_HOSTNAME, XN_TLSEXT_NAMETYPE_host_name,
+                  (void*)c->host); /* SNI */
+    }
+  }
+  if (t->SSL_connect(conn->ssl) != 1) goto fail; /* verify failure fails here */
+  return 0;
+fail:
+  xn_conn_close(conn);
+  return -1;
+}
 
 static int xn_connect(const XnHttpClient* c) {
   struct addrinfo hints, *res = NULL, *ai;
@@ -62,13 +231,21 @@ static int xn_connect(const XnHttpClient* c) {
   return fd;
 }
 
-static int xn_write_all(int fd, const void* buf, size_t len) {
+static int xn_write_all(XnConn* conn, const void* buf, size_t len) {
   const uint8_t* p = (const uint8_t*)buf;
+  const XnTlsApi* t = conn->ssl ? xn_tls_api() : NULL;
   while (len) {
-    ssize_t n = write(fd, p, len);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      return -1;
+    ssize_t n;
+    if (conn->ssl) {
+      int chunk = len > (1u << 30) ? (int)(1u << 30) : (int)len;
+      n = t->SSL_write(conn->ssl, p, chunk);
+      if (n <= 0) return -1;
+    } else {
+      n = write(conn->fd, p, len);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return -1;
+      }
     }
     p += n;
     len -= (size_t)n;
@@ -77,9 +254,12 @@ static int xn_write_all(int fd, const void* buf, size_t len) {
 }
 
 /* Read the whole response (Connection: close => until EOF); the buffer is
- * NUL-terminated one past `*out_len` so bounded string scans are safe. */
-static int xn_read_all(int fd, uint8_t** out, size_t* out_len) {
+ * NUL-terminated one past `*out_len` so bounded string scans are safe.
+ * Under TLS, any SSL_read <= 0 counts as EOF — a truncated body is still
+ * caught by the Content-Length framing check in the caller. */
+static int xn_read_all(XnConn* conn, uint8_t** out, size_t* out_len) {
   size_t cap = 8192, len = 0;
+  const XnTlsApi* t = conn->ssl ? xn_tls_api() : NULL;
   uint8_t* buf = (uint8_t*)malloc(cap + 1);
   if (!buf) return -1;
   for (;;) {
@@ -92,13 +272,20 @@ static int xn_read_all(int fd, uint8_t** out, size_t* out_len) {
       }
       buf = next;
     }
-    ssize_t n = read(fd, buf + len, cap - len);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      free(buf);
-      return -1;
+    ssize_t n;
+    if (conn->ssl) {
+      size_t want = cap - len;
+      n = t->SSL_read(conn->ssl, buf + len, want > (1u << 30) ? (int)(1u << 30) : (int)want);
+      if (n <= 0) break;
+    } else {
+      n = read(conn->fd, buf + len, cap - len);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        free(buf);
+        return -1;
+      }
+      if (n == 0) break;
     }
-    if (n == 0) break;
     len += (size_t)n;
   }
   buf[len] = 0;
@@ -176,8 +363,9 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
   size_t method_len = (size_t)(space - request);
   const char* path = space + 1;
 
-  int fd = xn_connect(c);
-  if (fd < 0) return -2;
+  XnConn conn = {xn_connect(c), NULL, NULL};
+  if (conn.fd < 0) return -2;
+  if (c->use_tls && xn_tls_open(&conn, c) != 0) return -4; /* handshake/verify failed */
 
   char header[1024];
   int hn = snprintf(header, sizeof(header),
@@ -188,16 +376,16 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
                     "\r\n",
                     (int)method_len, request, path, c->host, c->port,
                     (unsigned long long)body_len);
-  if (hn <= 0 || (size_t)hn >= sizeof(header) || xn_write_all(fd, header, (size_t)hn) != 0 ||
-      (body_len && xn_write_all(fd, body, body_len) != 0)) {
-    close(fd);
+  if (hn <= 0 || (size_t)hn >= sizeof(header) || xn_write_all(&conn, header, (size_t)hn) != 0 ||
+      (body_len && xn_write_all(&conn, body, body_len) != 0)) {
+    xn_conn_close(&conn);
     return -2;
   }
 
   uint8_t* resp = NULL;
   size_t resp_len = 0;
-  int rr = xn_read_all(fd, &resp, &resp_len);
-  close(fd);
+  int rr = xn_read_all(&conn, &resp, &resp_len);
+  xn_conn_close(&conn);
   if (rr != 0) return -2;
 
   /* status line: "HTTP/1.1 NNN ..." (xn_read_all NUL-terminates) */

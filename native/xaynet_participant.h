@@ -75,6 +75,15 @@ int xaynet_ffi_is_eligible(const uint8_t sig[64], double threshold);
 /* --- bundled HTTP/1.1 transport (libxaynet_http_transport.so) ----------- */
 typedef struct XnHttpClient XnHttpClient;
 XnHttpClient* xn_http_client_new(const char* host, uint16_t port);
+/* TLS client with root-cert PINNING: `ca_pem_path` becomes the entire
+ * trust store (system roots are NOT consulted), and the peer cert is bound
+ * to `host` (hostname or IP SAN). Pass both `client_cert_pem_path` and
+ * `client_key_pem_path` for in-process client identity (mutual TLS), or
+ * both NULL. Parity: rust/xaynet-mobile/src/reqwest_client.rs:58-71.
+ * Returns NULL if no usable libssl is present at runtime (dlopen). */
+XnHttpClient* xn_http_client_new_tls(const char* host, uint16_t port, const char* ca_pem_path,
+                                     const char* client_cert_pem_path,
+                                     const char* client_key_pem_path);
 void xn_http_client_free(XnHttpClient* c);
 int xn_http_transport(void* user, const char* request, const uint8_t* body, uint64_t body_len,
                       XnBuffer* out);

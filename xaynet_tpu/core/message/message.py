@@ -77,7 +77,10 @@ class Message:
         # reserved bytes stay zero
         buf[HEADER_LENGTH:] = self.payload.to_bytes()
         if secret_signing_key is not None:
-            sig = crypto_sign.sign_detached(secret_signing_key, bytes(buf[SIGNATURE_LENGTH:total]))
+            # memoryview: signing a 150 MB update must not copy the payload
+            sig = crypto_sign.sign_detached(
+                secret_signing_key, memoryview(buf)[SIGNATURE_LENGTH:total]
+            )
             buf[:SIGNATURE_LENGTH] = sig
         elif self.signature is not None:
             buf[:SIGNATURE_LENGTH] = self.signature
@@ -102,7 +105,7 @@ class Message:
             raise DecodeError(f"invalid tag {tag_raw}") from e
         is_multipart = bool(flags_raw & Flags.MULTIPART)
         if verify and not crypto_sign.verify_detached(
-            participant_pk, signature, data[SIGNATURE_LENGTH:length]
+            participant_pk, signature, memoryview(data)[SIGNATURE_LENGTH:length]
         ):
             raise DecodeError("invalid message signature")
         payload = parse_payload(tag, is_multipart, data[HEADER_LENGTH:length])
