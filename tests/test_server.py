@@ -295,6 +295,28 @@ def test_sdk_sum2_device_path_matches_host(monkeypatch):
     dev_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
     assert host_obj == dev_obj
 
+    # device_sum2=None is "auto": the device path turns on exactly when the
+    # default JAX backend is an accelerator (VERDICT r03 item 8)
+    import xaynet_tpu.sdk.state_machine as smod
+    from xaynet_tpu.ops import masking_jax
+
+    calls = []
+    real = masking_jax.sum_masks
+
+    def spy(s, n, c):
+        calls.append(n)
+        return real(s, n, c)
+
+    monkeypatch.setattr(masking_jax, "sum_masks", spy)
+    sm.device_sum2 = None
+    monkeypatch.setattr(smod, "_ACCEL_DEFAULT", False)  # CPU-only edge
+    StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
+    assert not calls
+    monkeypatch.setattr(smod, "_ACCEL_DEFAULT", True)  # device-equipped
+    auto_obj = StateMachine._aggregate_masks(sm, seeds, 64, cfg.pair())
+    assert calls == [64]
+    assert auto_obj == host_obj
+
 
 def test_sdk_sum2_batched_fold_keeps_count_cap():
     """The batched host fold enforces max_nb_models with the incremental

@@ -281,3 +281,185 @@ def test_256mb_multipart_streaming_reassembly_bounded_rss():
     # wire copy — a concat-then-parse allocates the full joined payload
     # (1x wire) plus a full-size conversion buffer on top
     assert peak - current < wire, (peak, current, wire)
+
+
+def test_1000_update_participants_one_round():
+    """Protocol scale (BASELINE config #3 shape): ONE round with 1,000
+    update participants + 2 sum participants through the real coordinator
+    pipeline, asserting the seed-dict fan-out (#sum x #update entries),
+    the window counters, the exact aggregate, and wall-clock.
+
+    Reference behavior: the coordinator accepts exactly count.max update
+    messages and every accepted update inserts its local seed dict
+    atomically (phases/update.rs:119-152); each sum participant must then
+    see one encrypted seed per accepted update (GET /seeds)."""
+    import asyncio
+    import logging
+    import time
+    from fractions import Fraction
+
+    from xaynet_tpu.sdk.client import InProcessClient
+    from xaynet_tpu.sdk.simulation import keys_for_task
+    from xaynet_tpu.sdk.state_machine import PetSettings as SdkPet, StateMachine as P
+    from xaynet_tpu.sdk.traits import ModelStore
+    from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+    from xaynet_tpu.server.settings import (
+        CountSettings,
+        PhaseSettings,
+        PetSettings,
+        Settings,
+        Sum2Settings,
+        TimeSettings,
+    )
+    from xaynet_tpu.server.state_machine import StateMachineInitializer
+    from xaynet_tpu.storage.memory import (
+        InMemoryCoordinatorStorage,
+        InMemoryModelStorage,
+        NoOpTrustAnchor,
+    )
+    from xaynet_tpu.storage.traits import Store
+
+    N_SUM, N_UPDATE, MLEN = 2, 1000, 8
+    SUM_PROB, UPDATE_PROB = 0.3, 0.9
+
+    class MS(ModelStore):
+        def __init__(self, m):
+            self.m = m
+
+        async def load_model(self):
+            return self.m
+
+    counter_lines: list[str] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if "accepted" in msg:
+                counter_lines.append(msg)
+
+    async def run():
+        st = Settings(
+            pet=PetSettings(
+                sum=PhaseSettings(
+                    prob=SUM_PROB,
+                    count=CountSettings(N_SUM, N_SUM),
+                    time=TimeSettings(0, 600),
+                ),
+                update=PhaseSettings(
+                    prob=UPDATE_PROB,
+                    count=CountSettings(N_UPDATE, N_UPDATE),
+                    time=TimeSettings(0, 600),
+                ),
+                sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 600)),
+            )
+        )
+        st.model.length = MLEN
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, tx, events = await StateMachineInitializer(st, store).init()
+        handler = PetMessageHandler(events, tx)
+        fetcher = Fetcher(events)
+        cap = _Capture()
+        coord_logger = logging.getLogger("xaynet.coordinator")
+        prev_level = coord_logger.level
+        coord_logger.setLevel(logging.INFO)  # counter lines log at INFO
+        coord_logger.addHandler(cap)
+        mt = asyncio.create_task(machine.run())
+        try:
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.01)
+            seed = fetcher.round_params().seed.as_bytes()
+
+            sum_parts = []
+            for i in range(N_SUM):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 10_000)
+                sum_parts.append(P(SdkPet(keys=keys), InProcessClient(fetcher, handler), MS(None)))
+            upd_parts = []
+            expected = np.zeros(MLEN)
+            rng = np.random.default_rng(7)
+            t_keys = time.time()
+            for i in range(N_UPDATE):
+                keys = keys_for_task(
+                    seed, SUM_PROB, UPDATE_PROB, "update", start=1_000_000 + i * 10_000
+                )
+                local = np.full(MLEN, rng.uniform(-1, 1), dtype=np.float32)
+                expected += local.astype(np.float64) / N_UPDATE
+                upd_parts.append(
+                    P(
+                        SdkPet(keys=keys, scalar=Fraction(1, N_UPDATE)),
+                        InProcessClient(fetcher, handler),
+                        MS(local),
+                    )
+                )
+            print(f"[1k] built {N_UPDATE} participants in {time.time() - t_keys:.1f}s")
+
+            t0 = time.time()
+
+            async def drive(sm):
+                consecutive_errors = 0
+                for _ in range(3000):
+                    try:
+                        await sm.transition()
+                        consecutive_errors = 0
+                    except Exception:
+                        # transient races are expected at this concurrency,
+                        # but a persistent failure must surface, not become
+                        # an opaque 600s timeout
+                        consecutive_errors += 1
+                        if consecutive_errors >= 50:
+                            raise
+                    if fetcher.model() is not None and sm.phase.value == "awaiting":
+                        return
+                    await asyncio.sleep(0.005)
+
+            captured = {}
+
+            async def capture_seed_dict():
+                # the broadcast happens at the update->sum2 transition and is
+                # superseded when the next round starts; grab it in-flight
+                for _ in range(120_000):
+                    sd = fetcher.seed_dict()
+                    if sd:
+                        captured["sd"] = sd
+                        return
+                    await asyncio.sleep(0.005)
+
+            await asyncio.gather(
+                capture_seed_dict(), *(drive(p) for p in sum_parts + upd_parts)
+            )
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            wall = time.time() - t0
+            print(f"[1k] round wall-clock: {wall:.1f}s ({N_UPDATE} updates, {N_SUM} sum)")
+
+            # seed-dict fan-out: one encrypted seed per accepted update for
+            # EVERY sum participant
+            seed_dict = captured.get("sd")
+            assert seed_dict is not None and len(seed_dict) == N_SUM
+            for sp in sum_parts:
+                mine = seed_dict.get(sp.keys.public)
+                assert mine is not None and len(mine) == N_UPDATE
+
+            # window counters: the coordinator accepted exactly the window
+            assert any(
+                f"update: {N_UPDATE} accepted (min {N_UPDATE}, max {N_UPDATE})" in ln
+                for ln in counter_lines
+            ), counter_lines[-5:]
+            assert any(
+                f"sum: {N_SUM} accepted (min {N_SUM}, max {N_SUM})" in ln
+                for ln in counter_lines
+            ), counter_lines[:5]
+
+            model = np.asarray(fetcher.model())
+            np.testing.assert_allclose(model, expected, atol=1e-6)
+            return wall
+        finally:
+            coord_logger.removeHandler(cap)
+            coord_logger.setLevel(prev_level)
+            mt.cancel()
+            try:
+                await mt
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    wall = asyncio.run(asyncio.wait_for(run(), 600))
+    assert wall < 300, f"1k-participant round took {wall:.0f}s"

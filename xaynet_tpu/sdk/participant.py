@@ -74,7 +74,9 @@ class Participant:
         state: Optional[bytes] = None,
         keys: Optional[SigningKeyPair] = None,
         max_message_size: Optional[int] = 4096,
-        device_sum2: bool = False,
+        # None = auto: the Sum2 device path turns on when JAX's default
+        # backend is an accelerator (see PetSettings.device_sum2)
+        device_sum2: Optional[bool] = None,
     ):
         if isinstance(client, str):
             client = HttpClient(client)
