@@ -739,69 +739,95 @@ def _native_http_round(tls_dir):
             rest = RestServer(Fetcher(events), PetMessageHandler(events, tx))
             host, port = await rest.start("127.0.0.1", 0, tls=server_tls)
             info["host"], info["port"] = host, port
+            machine_task = asyncio.create_task(machine.run())
+            info["loop"] = asyncio.get_running_loop()
+            info["machine_task"] = machine_task
             started.set()
-            await machine.run()
+            try:
+                await machine_task
+            except asyncio.CancelledError:
+                pass
+            await rest.stop()
 
         asyncio.run(amain())
 
-    threading.Thread(target=run_server, daemon=True).start()
+    server_thread = threading.Thread(target=run_server, daemon=True)
+    server_thread.start()
     assert started.wait(15)
     host, port = info["host"], info["port"]
 
-    if tls_dir is None:
-        params = asyncio.run(HttpClient(f"http://{host}:{port}").get_round_params())
-    else:
-        ctx = ssl_mod.create_default_context(cafile=demo_env["XN_TLS_CA"])
-        ctx.load_cert_chain(demo_env["XN_TLS_CERT"], demo_env["XN_TLS_KEY"])
-        params = asyncio.run(
-            HttpClient(f"https://{host}:{port}", tls_context=ctx).get_round_params()
-        )
-    seed = params.seed.as_bytes()
-
-    demo = os.path.join(_NATIVE_DIR, "http_demo")
-
-    if tls_dir is not None:
-        # pinning must REJECT a coordinator whose cert chains to another root
-        bad_env = dict(demo_env)
-        bad_env["XN_TLS_CA"] = wrong_ca
-        bad_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=90_000)
-        bad = subprocess.run(
-            [demo, host, str(port), bad_keys.secret.hex(), str(MODEL_LEN), "0.1"],
-            env=bad_env,
-            capture_output=True,
-            text=True,
-            timeout=30,
-        )
-        assert bad.returncode != 0, "wrong pinned root must fail the handshake"
-
     procs = []
-    sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum")
-    procs.append(
-        subprocess.Popen(
-            [demo, host, str(port), sum_keys.secret.hex(), str(MODEL_LEN)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=demo_env,
-        )
-    )
-    for i, v in enumerate(values):
-        keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(30 + i) * 1000)
+    try:
+        if tls_dir is None:
+            params = asyncio.run(HttpClient(f"http://{host}:{port}").get_round_params())
+        else:
+            ctx = ssl_mod.create_default_context(cafile=demo_env["XN_TLS_CA"])
+            ctx.load_cert_chain(demo_env["XN_TLS_CERT"], demo_env["XN_TLS_KEY"])
+            params = asyncio.run(
+                HttpClient(f"https://{host}:{port}", tls_context=ctx).get_round_params()
+            )
+        seed = params.seed.as_bytes()
+
+        demo = os.path.join(_NATIVE_DIR, "http_demo")
+
+        if tls_dir is not None:
+            # pinning must REJECT a coordinator whose cert chains to another root
+            bad_env = dict(demo_env)
+            bad_env["XN_TLS_CA"] = wrong_ca
+            bad_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=90_000)
+            bad = subprocess.run(
+                [demo, host, str(port), bad_keys.secret.hex(), str(MODEL_LEN), "0.1"],
+                env=bad_env,
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+            assert bad.returncode != 0, "wrong pinned root must fail the handshake"
+
+        sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum")
         procs.append(
             subprocess.Popen(
-                [demo, host, str(port), keys.secret.hex(), str(MODEL_LEN), str(v)],
+                [demo, host, str(port), sum_keys.secret.hex(), str(MODEL_LEN)],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
                 text=True,
                 env=demo_env,
             )
         )
+        for i, v in enumerate(values):
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(30 + i) * 1000)
+            procs.append(
+                subprocess.Popen(
+                    [demo, host, str(port), keys.secret.hex(), str(MODEL_LEN), str(v)],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=demo_env,
+                )
+            )
 
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=90)
-        outs.append(out)
-        assert p.returncode == 0, f"native participant failed:\nstdout:{out}\nstderr:{err}"
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=90)
+            outs.append(out)
+            assert p.returncode == 0, f"native participant failed:\nstdout:{out}\nstderr:{err}"
+    finally:
+        # cleanup must not mask the real failure: kill stragglers first,
+        # then drain the coordinator (a live daemon machine would keep
+        # logging phase failures after the pytest summary)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:
+                    pass
+        try:
+            if not info["loop"].is_closed():
+                info["loop"].call_soon_threadsafe(info["machine_task"].cancel)
+        except RuntimeError:
+            pass  # loop already closed between the check and the call
+        server_thread.join(timeout=10)
 
     expected = float(np.mean(values))
     for out in outs:
