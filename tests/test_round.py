@@ -167,9 +167,14 @@ def test_round_with_chunked_updates_and_device_aggregation(kernel, monkeypatch):
     kernel is continuously exercised, with a spy proving it folded.
     """
     import xaynet_tpu.ops.fold_pallas as fold_pallas
+    import xaynet_tpu.parallel.aggregator as agg_mod
 
     pallas_calls = []
     if kernel == "pallas-interpret":
+        # the process-wide fold-fn cache only re-reads the (spied) module
+        # attribute on a retrace; start from a clean cache so the spy is
+        # guaranteed to observe the fold
+        agg_mod._FOLD_FN_CACHE.clear()
         real = fold_pallas.fold_planar_batch_pallas
 
         def spy(acc, stack, order, interpret=False, tile_size=None):

@@ -66,6 +66,11 @@ max = 20.0
 [model]
 length = {model_len}
 
+[aggregation]
+device = {agg_device}
+batch_size = {agg_batch}
+kernel = "{agg_kernel}"
+
 [storage]
 backend = "filesystem"
 model_dir = "{model_dir}"
@@ -131,6 +136,14 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=200)
     ap.add_argument("--model-len", type=int, default=2000)
     ap.add_argument("--port", type=int, default=18439)
+    ap.add_argument(
+        "--device-kernel",
+        default=None,
+        # no bare "pallas": the soak pins the coordinator to the CPU backend,
+        # where explicit Mosaic compilation cannot succeed (auto falls back)
+        choices=["auto", "xla", "pallas-interpret"],
+        help="run the coordinator with device aggregation on the virtual mesh using this fold kernel",
+    )
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -138,10 +151,22 @@ def main() -> None:
         with open(cfg_path, "w") as f:
             f.write(
                 CONFIG.format(
-                    port=args.port, model_len=args.model_len, model_dir=os.path.join(tmp, "models")
+                    port=args.port,
+                    model_len=args.model_len,
+                    model_dir=os.path.join(tmp, "models"),
+                    agg_device="true" if args.device_kernel else "false",
+                    # keep the host-path default (64) so plain-soak numbers
+                    # stay comparable across rounds; small batches only for
+                    # the device path so every round actually flushes
+                    agg_batch=2 if args.device_kernel else 64,
+                    agg_kernel=args.device_kernel or "auto",
                 )
             )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if args.device_kernel:
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
         proc = subprocess.Popen(
             [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", cfg_path],
             env=env,

@@ -66,13 +66,13 @@ class MultiHostAggregator:
     slice of the model axis.
     """
 
-    def __init__(self, config: MaskConfig, model_length: int, mesh=None):
+    def __init__(self, config: MaskConfig, model_length: int, mesh=None, kernel: str = "xla"):
         self.mesh = mesh if mesh is not None else global_mesh()
         n_proc = jax.process_count()
         n_local = len([d for d in self.mesh.devices.flat if d.process_index == jax.process_index()])
         if n_local * n_proc != self.mesh.devices.size:
             raise ValueError("every process must contribute the same number of devices")
-        self.agg = ShardedAggregator(config, model_length, mesh=self.mesh)
+        self.agg = ShardedAggregator(config, model_length, mesh=self.mesh, kernel=kernel)
         per = self.agg.padded_length // n_proc
         self._lo_padded = per * jax.process_index()
         self._hi_padded = self._lo_padded + per
