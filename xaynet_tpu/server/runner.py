@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 from typing import Optional
 
@@ -158,12 +159,34 @@ def _pin_jax_platform() -> None:
         jax.config.update("jax_platforms", want)
 
 
+def _enable_jax_compile_cache(settings: Settings) -> None:
+    """Persist XLA/Mosaic compiles across coordinator restarts.
+
+    A restarted coordinator (rolling deploy, crash recovery) should not pay
+    the 20-40 s first-compile of the fold kernels again; the cache also
+    lets short accelerator sessions reuse earlier builds. Only active when
+    device aggregation is on — the host path never compiles.
+    """
+    if not settings.aggregation.device:
+        return
+    import jax
+
+    cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # a bad cache dir must never stop the coordinator
+        logger.warning("jax compile cache unavailable at %s: %s", cache_dir, e)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="xaynet-tpu coordinator")
     parser.add_argument("-c", "--config", help="TOML configuration file", default=None)
     args = parser.parse_args()
     settings = Settings.load(args.config)
     _pin_jax_platform()
+    _enable_jax_compile_cache(settings)
     asyncio.run(serve(settings))
 
 
