@@ -234,16 +234,22 @@ def main() -> None:
         acc = fold(acc, stack)
     _sync(acc)
 
-    t0 = time.perf_counter()
-    for _ in range(n_batches):
-        acc = fold(acc, stack)
-    _sync(acc)
-    dt = time.perf_counter() - t0
-
-    updates = k * n_batches
-    ups = updates / dt
+    # median of >=3 repetitions with min/max spread (VERDICT r04 weak 1):
+    # the r4 headline (26.4) sat 17% under a same-code mid-round draw (30.8)
+    # purely from shared-container noise — one draw is not defensible
+    reps = 3
+    rep_ups = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            acc = fold(acc, stack)
+        _sync(acc)
+        dt = time.perf_counter() - t0
+        rep_ups.append(k * n_batches / dt)
+    ups = float(np.median(rep_ups))
     # scale CPU smoke runs to the 25M-param metric so the number is comparable
-    scaled_ups = ups * (model_len / 25_000_000)
+    scale = model_len / 25_000_000
+    scaled_ups = ups * scale
     baseline = 10_000 / 60.0  # north-star floor: 10k updates in 60s
     if on_tpu:
         metric = "masked-update aggregation throughput @25M params (PET update phase)"
@@ -267,6 +273,11 @@ def main() -> None:
                 "platform": platform,
                 "kernel": best,
                 "model_len": model_len,
+                "spread": {
+                    "median_of": reps,
+                    "min": round(min(rep_ups) * scale, 2),
+                    "max": round(max(rep_ups) * scale, 2),
+                },
             }
         )
     )

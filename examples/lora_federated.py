@@ -90,7 +90,25 @@ class LoraTrainer(ParticipantABC):
         return None if global_model is None else np.asarray(global_model)
 
 
+def _eval_mse(adapters, shards) -> float:
+    """Mean squared error over the union of the updaters' shards; adapters
+    ``None`` evaluates the frozen base model alone."""
+    tot, n = 0.0, 0
+    for x, y in shards:
+        base = x @ BASE_W
+        pred = (
+            base
+            if adapters is None
+            else lora.apply_adapter(base, x, adapters["probe"], SPEC.alpha, SPEC.rank)
+        )
+        tot += float(np.mean((np.asarray(pred) - y) ** 2)) * len(x)
+        n += len(x)
+    return tot / n
+
+
 def main() -> None:
+    import argparse
+
     from xaynet_tpu.server.settings import (
         CountSettings,
         PetSettings,
@@ -99,6 +117,17 @@ def main() -> None:
         Sum2Settings,
         TimeSettings,
     )
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=ROUNDS)
+    ap.add_argument(
+        "--check-loss",
+        action="store_true",
+        help="exit nonzero unless the federated adapters beat the frozen "
+        "base model on the union of the updaters' shards (the same "
+        "acceptance-gate contract as cifar_lenet / shakespeare_lstm)",
+    )
+    args = ap.parse_args()
 
     cfg = MaskConfig(GroupType.INTEGER, DataType.I64, BoundType.B6, ModelType.M3)
     settings = Settings(
@@ -118,9 +147,11 @@ def main() -> None:
 
     trainers = [LoraTrainer(seed=i) for i in range(1 + N_UPDATE)]
     print(f"federating {adapter_len()} int64 adapter deltas (rank {RANK}, scale {Q_SCALE})")
+    final_delta = None
     try:
-        for result in fed.rounds(trainers, n_rounds=ROUNDS):
+        for result in fed.rounds(trainers, n_rounds=args.rounds):
             losses = [t.last_loss for t in trainers[1:] if t.last_loss is not None]
+            final_delta = result.global_model
             print(
                 f"round {result.round_id}: global adapter delta ready in "
                 f"{result.wall_seconds:.1f}s; local losses: "
@@ -128,6 +159,19 @@ def main() -> None:
             )
     finally:
         fed.stop()
+
+    if args.check_loss:
+        # acceptance gate (VERDICT r04 item 8): the federated global adapters
+        # must beat the frozen base model on the union of the updaters' data
+        if final_delta is None:
+            raise SystemExit("--check-loss needs at least one completed round")
+        template = lora.init_adapters(jax.random.PRNGKey(0), SPEC)
+        fed_adapters = lora.dequantize_deltas(np.asarray(final_delta), template, Q_SCALE)
+        shards = [(t.x, t.y) for t in trainers[1:]]
+        before, after = _eval_mse(None, shards), _eval_mse(fed_adapters, shards)
+        print(f"eval loss: frozen base {before:.5f} -> base+federated adapters {after:.5f}")
+        if not after < before:
+            raise SystemExit("federated adapters did not improve on the frozen base model")
     print("done")
 
 

@@ -74,6 +74,9 @@ typedef struct {
   int (*SSL_connect)(void*);
   int (*SSL_read)(void*, void*, int);
   int (*SSL_write)(void*, const void*, int);
+  int (*SSL_get_error)(const void*, int);
+  unsigned long (*ERR_peek_error)(void);
+  void (*ERR_clear_error)(void);
   int (*SSL_shutdown)(void*);
   void* (*SSL_get0_param)(void*);
   long (*SSL_ctrl)(void*, int, long, void*);
@@ -83,6 +86,14 @@ typedef struct {
 
 #define XN_SSL_VERIFY_PEER 0x01
 #define XN_SSL_FILETYPE_PEM 1
+#define XN_SSL_ERROR_SSL 1
+#define XN_SSL_ERROR_SYSCALL 5
+#define XN_SSL_ERROR_ZERO_RETURN 6
+/* OpenSSL 3.x reports a peer closing without close_notify as
+ * SSL_ERROR_SSL with reason SSL_R_UNEXPECTED_EOF_WHILE_READING (294)
+ * rather than 1.1.1's SSL_ERROR_SYSCALL with ret==0. Reason masks differ
+ * across the two era layouts (3.x: low 23 bits; 1.1.1: low 12 bits). */
+#define XN_SSL_R_UNEXPECTED_EOF 294
 #define XN_SSL_CTRL_SET_TLSEXT_HOSTNAME 55
 #define XN_TLSEXT_NAMETYPE_host_name 0
 
@@ -115,6 +126,9 @@ static const XnTlsApi* xn_tls_api(void) {
   *(void**)&api.SSL_connect = xn_dl(api.libssl, "SSL_connect");
   *(void**)&api.SSL_read = xn_dl(api.libssl, "SSL_read");
   *(void**)&api.SSL_write = xn_dl(api.libssl, "SSL_write");
+  *(void**)&api.SSL_get_error = xn_dl(api.libssl, "SSL_get_error");
+  *(void**)&api.ERR_peek_error = xn_dl(api.libssl, "ERR_peek_error");
+  *(void**)&api.ERR_clear_error = xn_dl(api.libssl, "ERR_clear_error");
   *(void**)&api.SSL_shutdown = xn_dl(api.libssl, "SSL_shutdown");
   *(void**)&api.SSL_get0_param = xn_dl(api.libssl, "SSL_get0_param");
   *(void**)&api.SSL_ctrl = xn_dl(api.libssl, "SSL_ctrl");
@@ -124,6 +138,7 @@ static const XnTlsApi* xn_tls_api(void) {
   int ok = api.TLS_client_method && api.SSL_CTX_new && api.SSL_CTX_free &&
            api.SSL_CTX_load_verify_locations && api.SSL_CTX_set_verify && api.SSL_new &&
            api.SSL_free && api.SSL_set_fd && api.SSL_connect && api.SSL_read && api.SSL_write &&
+           api.SSL_get_error && api.ERR_peek_error && api.ERR_clear_error &&
            api.SSL_shutdown && api.SSL_get0_param && api.SSL_ctrl &&
            api.X509_VERIFY_PARAM_set1_host && api.X509_VERIFY_PARAM_set1_ip_asc &&
            api.SSL_CTX_use_certificate_chain_file && api.SSL_CTX_use_PrivateKey_file &&
@@ -255,13 +270,19 @@ static int xn_write_all(XnConn* conn, const void* buf, size_t len) {
 
 /* Read the whole response (Connection: close => until EOF); the buffer is
  * NUL-terminated one past `*out_len` so bounded string scans are safe.
- * Under TLS, any SSL_read <= 0 counts as EOF — a truncated body is still
- * caught by the Content-Length framing check in the caller. */
-static int xn_read_all(XnConn* conn, uint8_t** out, size_t* out_len) {
+ * Under TLS only a close_notify (SSL_ERROR_ZERO_RETURN) is a *clean* EOF.
+ * A peer that closes the TCP socket without close_notify (common: the
+ * Python test server, many proxies) shows up as SSL_ERROR_SYSCALL with
+ * ret==0 — that is reported as an *unclean* EOF via `*clean_eof` so the
+ * caller can accept it only when the body is explicitly framed
+ * (Content-Length / chunked); a mid-stream TLS error is a hard failure,
+ * matching the plaintext read-error path. */
+static int xn_read_all(XnConn* conn, uint8_t** out, size_t* out_len, int* clean_eof) {
   size_t cap = 8192, len = 0;
   const XnTlsApi* t = conn->ssl ? xn_tls_api() : NULL;
   uint8_t* buf = (uint8_t*)malloc(cap + 1);
   if (!buf) return -1;
+  *clean_eof = 1;
   for (;;) {
     if (len == cap) {
       cap *= 2;
@@ -275,8 +296,28 @@ static int xn_read_all(XnConn* conn, uint8_t** out, size_t* out_len) {
     ssize_t n;
     if (conn->ssl) {
       size_t want = cap - len;
+      /* SSL_get_error consults the thread's error queue; stale entries
+       * from earlier calls would misclassify this read's result */
+      t->ERR_clear_error();
       n = t->SSL_read(conn->ssl, buf + len, want > (1u << 30) ? (int)(1u << 30) : (int)want);
-      if (n <= 0) break;
+      if (n <= 0) {
+        int err = t->SSL_get_error(conn->ssl, (int)n);
+        if (err == XN_SSL_ERROR_ZERO_RETURN) break; /* close_notify: clean */
+        if (err == XN_SSL_ERROR_SYSCALL && n == 0) {
+          *clean_eof = 0; /* 1.1.1: TCP close without close_notify */
+          break;
+        }
+        if (err == XN_SSL_ERROR_SSL) {
+          unsigned long reason = t->ERR_peek_error();
+          if ((reason & 0x7FFFFF) == XN_SSL_R_UNEXPECTED_EOF ||
+              (reason & 0xFFF) == XN_SSL_R_UNEXPECTED_EOF) {
+            *clean_eof = 0; /* 3.x: TCP close without close_notify */
+            break;
+          }
+        }
+        free(buf); /* mid-stream TLS failure */
+        return -1;
+      }
     } else {
       n = read(conn->fd, buf + len, cap - len);
       if (n < 0) {
@@ -384,7 +425,8 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
 
   uint8_t* resp = NULL;
   size_t resp_len = 0;
-  int rr = xn_read_all(&conn, &resp, &resp_len);
+  int clean_eof = 1;
+  int rr = xn_read_all(&conn, &resp, &resp_len, &clean_eof);
   xn_conn_close(&conn);
   if (rr != 0) return -2;
 
@@ -429,6 +471,13 @@ int xn_http_transport(void* user, const char* request, const uint8_t* body,
     }
   } else {
     const char* cl = xn_find_header(headers, headers_end, "Content-Length");
+    if (!cl && !clean_eof) {
+      /* body framed only by connection close, but the close was not a TLS
+       * close_notify: a truncation would be indistinguishable from the
+       * real end, so reject rather than accept a possibly short body */
+      free(resp);
+      return -3;
+    }
     content_len = cl ? (size_t)strtoull(cl, NULL, 10) : raw_len;
     if (content_len > raw_len) { /* truncated response */
       free(resp);

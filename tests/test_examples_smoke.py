@@ -43,6 +43,14 @@ def test_cifar_lenet_example_smoke():
     assert "eval loss" in r.stdout
 
 
+def test_lora_federated_example_smoke():
+    """Baseline config #5 (stretch): int-masked LoRA adapter federation with
+    the loss-improvement gate (VERDICT r04 item 8)."""
+    r = _run_example(["examples/lora_federated.py", "--rounds", "2", "--check-loss"])
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    assert "eval loss" in r.stdout
+
+
 def test_shakespeare_lstm_example_smoke():
     r = _run_example(
         [

@@ -283,16 +283,20 @@ def test_256mb_multipart_streaming_reassembly_bounded_rss():
     assert peak - current < wire, (peak, current, wire)
 
 
-def test_1000_update_participants_one_round():
-    """Protocol scale (BASELINE config #3 shape): ONE round with 1,000
-    update participants + 2 sum participants through the real coordinator
-    pipeline, asserting the seed-dict fan-out (#sum x #update entries),
-    the window counters, the exact aggregate, and wall-clock.
+def _protocol_scale_round(n_sum, n_update, mlen, model_for, timeout=600):
+    """ONE round with ``n_update`` update + ``n_sum`` sum participants through
+    the real coordinator pipeline (state machine + services + in-process
+    transport), asserting the seed-dict fan-out (#sum x #update entries),
+    the window counters, and the exact aggregate. Returns the wall-clock.
 
     Reference behavior: the coordinator accepts exactly count.max update
     messages and every accepted update inserts its local seed dict
     atomically (phases/update.rs:119-152); each sum participant must then
-    see one encrypted seed per accepted update (GET /seeds)."""
+    see one encrypted seed per accepted update (GET /seeds).
+
+    ``model_for(i, rng)`` supplies participant i's local model (float32,
+    length ``mlen``).
+    """
     import asyncio
     import logging
     import time
@@ -319,7 +323,7 @@ def test_1000_update_participants_one_round():
     )
     from xaynet_tpu.storage.traits import Store
 
-    N_SUM, N_UPDATE, MLEN = 2, 1000, 8
+    N_SUM, N_UPDATE, MLEN = n_sum, n_update, mlen
     SUM_PROB, UPDATE_PROB = 0.3, 0.9
 
     class MS(ModelStore):
@@ -381,7 +385,7 @@ def test_1000_update_participants_one_round():
                 keys = keys_for_task(
                     seed, SUM_PROB, UPDATE_PROB, "update", start=1_000_000 + i * 10_000
                 )
-                local = np.full(MLEN, rng.uniform(-1, 1), dtype=np.float32)
+                local = model_for(i, rng)
                 expected += local.astype(np.float64) / N_UPDATE
                 upd_parts.append(
                     P(
@@ -390,7 +394,10 @@ def test_1000_update_participants_one_round():
                         MS(local),
                     )
                 )
-            print(f"[1k] built {N_UPDATE} participants in {time.time() - t_keys:.1f}s")
+            print(
+                f"[scale {N_UPDATE}x{MLEN}] built {N_UPDATE} participants "
+                f"in {time.time() - t_keys:.1f}s"
+            )
 
             t0 = time.time()
 
@@ -429,7 +436,10 @@ def test_1000_update_participants_one_round():
             while fetcher.model() is None:
                 await asyncio.sleep(0.01)
             wall = time.time() - t0
-            print(f"[1k] round wall-clock: {wall:.1f}s ({N_UPDATE} updates, {N_SUM} sum)")
+            print(
+                f"[scale {N_UPDATE}x{MLEN}] round wall-clock: {wall:.1f}s "
+                f"({N_UPDATE} updates, {N_SUM} sum)"
+            )
 
             # seed-dict fan-out: one encrypted seed per accepted update for
             # EVERY sum participant
@@ -461,5 +471,31 @@ def test_1000_update_participants_one_round():
             except (asyncio.CancelledError, Exception):
                 pass
 
-    wall = asyncio.run(asyncio.wait_for(run(), 600))
+    return asyncio.run(asyncio.wait_for(run(), timeout))
+
+
+def test_1000_update_participants_one_round():
+    """Protocol scale (BASELINE config #3 shape): 1,000 update + 2 sum
+    participants, tiny model."""
+    wall = _protocol_scale_round(
+        n_sum=2,
+        n_update=1000,
+        mlen=8,
+        model_for=lambda i, rng: np.full(8, rng.uniform(-1, 1), dtype=np.float32),
+    )
     assert wall < 300, f"1k-participant round took {wall:.0f}s"
+
+
+def test_100_update_participants_1m_params_one_round():
+    """Protocol scale COUPLED to data scale (VERDICT r04 item 6): 100 update
+    + 3 sum participants at 1M params through the same real pipeline, where
+    seed-dict fan-out (3 x 100 entries) and staging pressure interact —
+    bridging the 1,000 x 8 and 3 x 25M extremes."""
+    wall = _protocol_scale_round(
+        n_sum=3,
+        n_update=100,
+        mlen=1_000_000,
+        model_for=lambda i, rng: rng.uniform(-1, 1, size=1_000_000).astype(np.float32),
+        timeout=1200,
+    )
+    assert wall < 900, f"100x1M round took {wall:.0f}s"

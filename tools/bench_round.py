@@ -43,6 +43,19 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=16, help="updates per staged batch")
     ap.add_argument("--sum2-seeds", type=int, default=None, help="seeds for the sum2 participant leg")
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument(
+        "--assert-flat-rss-mb",
+        type=float,
+        default=None,
+        help="fail (exit 2) if RSS grows more than this many MB across the "
+        "update phase — sustained-ingest proof for the north-star count "
+        "(the per-update loop is unbounded by design, update.rs:119-152)",
+    )
+    ap.add_argument(
+        "--history",
+        action="store_true",
+        help="append the JSON result line to BENCH_HISTORY.jsonl",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -129,9 +142,18 @@ def main() -> None:
 
     asyncio.run(_seed_store())
 
+    def _rss_mb() -> float:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        return 0.0
+
     stage_label = "stage + fold (device)" if on_tpu else "stage + fold (host)"
     t_parse = t_validate = t_seed = t_stage = 0.0
     pool = ThreadPoolExecutor(max_workers=max(2, (os.cpu_count() or 2)))
+    rss_start = _rss_mb()
+    rss_peak = rss_start
     t_total0 = time.perf_counter()
 
     if n_updates < k_batch:
@@ -175,9 +197,20 @@ def main() -> None:
         stack = np.stack([v.data for v in parsed])
         agg.add_batch(stack)
         t_stage += time.perf_counter() - t0
+        if b == 2:
+            # steady-state baseline: the first batches pay one-time costs
+            # (thread-pool arenas, parse buffers, kernel warmup) that are
+            # not per-update growth
+            rss_warm = _rss_mb()
+        if b % 50 == 0 or b == n_batches - 1:
+            rss_peak = max(rss_peak, _rss_mb())
 
     jax.block_until_ready(agg.acc)
     t_update_phase = time.perf_counter() - t_total0
+    rss_end = _rss_mb()
+    if n_batches <= 2:
+        rss_warm = rss_end
+    rss_peak = max(rss_peak, rss_end)
 
     # 5. sum2 participant leg: derive + sum k_sum2 masks. On the
     # accelerator this is the device ChaCha kernel; on CPU it is the path a
@@ -250,20 +283,44 @@ def main() -> None:
     for name, t in rows:
         print(f"  {name:<38} {t:8.2f}s", file=sys.stderr)
     print(f"  update-phase throughput: {ups:.1f} updates/s", file=sys.stderr)
-
+    rss_growth = rss_end - rss_warm
     print(
-        json.dumps(
-            {
-                "metric": "e2e update-phase throughput",
-                "value": round(ups, 2),
-                "unit": "updates/s",
-                "platform": platform,
-                "model_len": model_len,
-                "updates": n_batches * k_batch,
-                "breakdown_s": {name: round(t, 3) for name, t in rows},
-            }
-        )
+        f"  RSS start/warm/peak/end: {rss_start:.1f}/{rss_warm:.1f}/{rss_peak:.1f}/"
+        f"{rss_end:.1f} MB (steady-state growth {rss_growth:+.1f} MB over "
+        f"{n_batches * k_batch} updates, seed dict {n_batches * k_batch} entries)",
+        file=sys.stderr,
     )
+
+    result = {
+        "metric": "e2e update-phase throughput",
+        "value": round(ups, 2),
+        "unit": "updates/s",
+        "platform": platform,
+        "model_len": model_len,
+        "updates": n_batches * k_batch,
+        "breakdown_s": {name: round(t, 3) for name, t in rows},
+        "rss_mb": {
+            "start": round(rss_start, 1),
+            "warm": round(rss_warm, 1),
+            "peak": round(rss_peak, 1),
+            "end": round(rss_end, 1),
+        },
+    }
+    print(json.dumps(result))
+    if args.history:
+        hist = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_HISTORY.jsonl"
+        )
+        with open(hist, "a") as f:
+            f.write(
+                json.dumps({"ts": round(time.time(), 3), "source": "bench_round", **result}) + "\n"
+            )
+    if args.assert_flat_rss_mb is not None and rss_growth > args.assert_flat_rss_mb:
+        print(
+            f"RSS NOT FLAT: grew {rss_growth:.1f} MB > allowed {args.assert_flat_rss_mb} MB",
+            file=sys.stderr,
+        )
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -76,7 +76,8 @@ backend = "filesystem"
 model_dir = "{model_dir}"
 
 [log]
-filter = "warning"
+# info: the soak artifact reads the aggregator's "kernel resolved" line
+filter = "info"
 """
 
 
@@ -167,11 +168,13 @@ def main() -> None:
             flags = env.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
                 env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        coord_log_path = os.path.join(tmp, "coordinator.log")
+        coord_log = open(coord_log_path, "w")
         proc = subprocess.Popen(
             [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", cfg_path],
             env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            stdout=coord_log,
+            stderr=subprocess.STDOUT,
         )
         try:
             # wait until the coordinator actually listens (loaded CI hosts
@@ -190,14 +193,37 @@ def main() -> None:
             else:
                 raise RuntimeError("coordinator did not start listening in 60s")
             rss_start = _rss_kb(proc.pid)
+            # warmup block first: the first rounds pay one-time costs (JIT
+            # compiles, XLA buffer pools, import side-effects) that are not
+            # per-round growth; the steady-state rate is what a leak looks
+            # like (same split the bench_round RSS gate uses)
+            warmup_rounds = min(20, max(1, args.rounds // 10))
+            run_soak_sync(args.port, warmup_rounds, args.model_len)
+            rss_warm = _rss_kb(proc.pid)
             result = run_soak_sync(args.port, args.rounds, args.model_len)
             rss_end = _rss_kb(proc.pid)
+            resolved = None
+            if args.device_kernel:
+                # the aggregator logs its per-round kernel resolution; the
+                # LAST line is the steady-state answer (VERDICT r05 item 7:
+                # the soak artifact must name the resolved kernel)
+                coord_log.flush()
+                with open(coord_log_path) as lf:
+                    for line in lf:
+                        if "aggregation kernel resolved:" in line:
+                            resolved = line.rsplit("resolved:", 1)[1].strip()
             result.update(
                 {
                     "rounds_per_s": round(result["rounds"] / result["wall_s"], 2),
+                    "warmup_rounds": warmup_rounds,
                     "rss_start_kb": rss_start,
+                    "rss_warm_kb": rss_warm,
                     "rss_end_kb": rss_end,
-                    "rss_kb_per_round": round((rss_end - rss_start) / max(result["rounds"], 1), 1),
+                    "rss_steady_kb_per_round": round(
+                        (rss_end - rss_warm) / max(result["rounds"], 1), 1
+                    ),
+                    "kernel_requested": args.device_kernel,
+                    "kernel_resolved": resolved,
                 }
             )
             print(json.dumps(result))
@@ -208,6 +234,7 @@ def main() -> None:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5)
+            coord_log.close()
 
 
 if __name__ == "__main__":

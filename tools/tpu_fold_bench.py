@@ -52,6 +52,13 @@ def main() -> int:
         default=None,
         help="pin the jax platform (e.g. cpu for a local smoke); default: let the accelerator plugin claim the backend",
     )
+    ap.add_argument(
+        "--auto-stage",
+        action="store_true",
+        help="also drive ShardedAggregator(kernel='auto') on the staged batch so the "
+        "calibration branch (parallel/aggregator._resolve_kernel) runs on this backend "
+        "and the resolved winner is captured",
+    )
     args = ap.parse_args()
 
     if args.platform:
@@ -165,6 +172,41 @@ def main() -> int:
                 )
         except Exception as e:
             emit({"stage": "pallas-import", "error": f"{type(e).__name__}: {e}"[:300]})
+
+    if args.auto_stage:
+        # the production selection path: ShardedAggregator(kernel="auto")
+        # compiles+times both kernels on the real staged batch and keeps the
+        # winner (falling back to XLA on a Mosaic failure). On an accelerator
+        # this is the first time the calibration branch meets real hardware,
+        # so isolate it and report the resolved kernel either way.
+        try:
+            from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+            agg = ShardedAggregator(config, model_len, kernel="auto")
+            t0 = time.perf_counter()
+            agg.add_planar_batch(stack)
+            jax.block_until_ready(agg.acc)
+            calib_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            agg.add_planar_batch(stack)
+            jax.block_until_ready(agg.acc)
+            steady_s = time.perf_counter() - t0
+            ups = k / steady_s
+            results[f"auto->{agg.kernel_used}"] = ups
+            emit(
+                {
+                    "stage": "fold:auto",
+                    "platform": platform,
+                    "model_len": model_len,
+                    "k": k,
+                    "kernel_used": agg.kernel_used,
+                    "calibration_seconds": round(calib_s, 2),
+                    "updates_per_s": round(ups, 2),
+                    "vs_baseline": round(ups / (10_000 / 60.0), 3),
+                }
+            )
+        except Exception as e:
+            emit({"stage": "fold:auto", "platform": platform, "error": f"{type(e).__name__}: {e}"[:500]})
 
     if results:
         best = max(results, key=results.get)

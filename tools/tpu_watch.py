@@ -34,7 +34,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_WATCH.log")
 HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
-EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r04.md")
+EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE_r05.md")
 
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
@@ -131,9 +131,18 @@ def main() -> int:
             # expensive full-scale runs (each fold_bench stage appends its
             # own history line the moment it has a number)
             specs = [
+                # seconds-scale first stage (VERDICT r04 item 1): ~200k params
+                # is a ~5 MB/update transfer and a sub-second fold, so even a
+                # 3-minute window banks the program's first platform:"tpu"
+                # line; --auto-stage makes the first Mosaic compile and the
+                # kernel=auto calibration branch happen under this cheapest
+                # capture rather than a big one
+                ("fold_micro",
+                 [sys.executable, "tools/tpu_fold_bench.py",
+                  "--model-len", "200000", "--k", "8", "--auto-stage"], 300),
                 ("fold_2.5m",
                  [sys.executable, "tools/tpu_fold_bench.py",
-                  "--model-len", "2500000", "--k", "8"], 600),
+                  "--model-len", "2500000", "--k", "8", "--auto-stage"], 600),
                 ("fold_25m",
                  [sys.executable, "tools/tpu_fold_bench.py",
                   "--model-len", "25000000", "--k", "8"], 1200),
@@ -164,7 +173,7 @@ def main() -> int:
                 # TPU_WATCH.log + BENCH_HISTORY.jsonl)
                 with open(EVIDENCE, "a") as f:
                     if f.tell() == 0:
-                        f.write("# TPU evidence — round 4 (captured by tools/tpu_watch.py)\n\n")
+                        f.write("# TPU evidence — round 5 (captured by tools/tpu_watch.py)\n\n")
                     f.write(f"## window at {_now()} (probe attempt {attempt})\n\n")
                     for rec in good:
                         f.write(f"### {rec['source']} (rc={rec['rc']}, {rec['seconds']}s)\n\n")

@@ -190,23 +190,43 @@ class ShardedAggregator:
             return
         backend = jax.default_backend()
         # K is part of the key: a verdict timed on a small remainder flush
-        # must not bind the steady-state batch size (and vice versa)
-        key = (backend, self.n_limbs, self.padded_length, self.order, staged.shape[0])
+        # must not bind the steady-state batch size (and vice versa); the
+        # mesh size too — same padded_length on different meshes means a
+        # different per-device shard (ADVICE r04)
+        key = (
+            backend,
+            self.mesh.devices.size,
+            self.n_limbs,
+            self.padded_length,
+            self.order,
+            staged.shape[0],
+        )
         cached = _AUTO_KERNEL_CACHE.get(key)
         if cached is not None:
             self.kernel_used = cached
+            logger.info("aggregation kernel resolved: %s (auto, cached verdict)", cached)
             return
         if backend == "cpu":
             # interpret-mode Pallas is an oracle, not a production kernel
             self.kernel_used = "xla"
         else:
             timings, fns = {}, {}
+            # one scratch accumulator shared across candidates and calls: the
+            # folds donate their input and return the new buffer, so chaining
+            # the return keeps the transient footprint at one extra
+            # accumulator instead of two fresh zeros per candidate while
+            # self.acc and the batch are live (ADVICE r04). XLA runs first;
+            # if the Pallas leg dies mid-run its possibly-donated scratch is
+            # never reused (no candidates follow it).
+            scratch = self._zero_acc()
             for name in ("xla", "pallas"):
                 try:
                     fold = self._make_fold_fn(name)
-                    fold(self._zero_acc(), staged).block_until_ready()  # compile
+                    scratch = fold(scratch, staged)
+                    scratch.block_until_ready()  # compile
                     t0 = time.perf_counter()
-                    fold(self._zero_acc(), staged).block_until_ready()
+                    scratch = fold(scratch, staged)
+                    scratch.block_until_ready()
                     timings[name] = time.perf_counter() - t0
                     fns[name] = fold
                 except Exception as e:  # Mosaic compile/run failure -> keep XLA
@@ -218,6 +238,9 @@ class ShardedAggregator:
             self._fold_fn = fns.get(self.kernel_used)
             logger.info("aggregation kernel auto-calibration: %s -> %s", timings, self.kernel_used)
         _AUTO_KERNEL_CACHE[key] = self.kernel_used
+        logger.info(
+            "aggregation kernel resolved: %s (auto on %s backend)", self.kernel_used, backend
+        )
 
     def unmask_limbs(self, mask_vect) -> np.ndarray:
         """Subtract the aggregated mask; returns host wire ``uint32[model_len, L]``."""
