@@ -149,6 +149,38 @@ def test_sum_masks_device():
     assert np.array_equal(np.asarray(got_vect), agg.object.vect.data)
 
 
+def test_sum_masks_device_multi_group():
+    """More seeds than one seed_batch: the group-accumulate path (sum2 at
+    protocol scale runs #updates/seed_batch of these)."""
+    seeds = [bytes([i, i ^ 0x5A]) * 16 for i in range(1, 20)]
+    n = 33
+    got_unit, got_vect = masking_jax.sum_masks(seeds, n, CFG.pair(), seed_batch=4)
+
+    agg = Aggregation(CFG.pair(), n)
+    for s in seeds:
+        agg.aggregate(MaskSeed(s).derive_mask(n, CFG.pair()))
+    assert np.array_equal(got_unit, agg.object.unit.data)
+    assert np.array_equal(np.asarray(got_vect), agg.object.vect.data)
+
+
+def test_derive_uniform_limbs_batch_matches_single():
+    """Each row of the batched derivation is bit-identical to the single-seed
+    kernel at the same byte offset, including the multi-chunk case."""
+    order = CFG.order
+    seeds = [bytes([7 + i]) * 32 for i in range(5)]
+    offsets = [0, 10, 64, 130, 7]
+    n = 700
+    # small chunks force several chunk rounds with per-seed cursors
+    got = np.asarray(
+        chacha_jax.derive_uniform_limbs_batch(
+            seeds, n, order, byte_offsets=offsets, chunk_candidates=256
+        )
+    )
+    for i, (s, off) in enumerate(zip(seeds, offsets)):
+        want = np.asarray(chacha_jax.derive_uniform_limbs(s, n, order, byte_offset=off))
+        assert np.array_equal(got[i], want), f"seed {i} diverges from single-seed derive"
+
+
 @pytest.mark.parametrize(
     "cfg",
     [

@@ -70,7 +70,7 @@ def main() -> None:
     from xaynet_tpu.core.mask.encode import decode_vect_fast
     from xaynet_tpu.core.mask.object import MaskVect
     from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
-    from xaynet_tpu.ops import chacha_jax, limbs as host_limbs, limbs_jax
+    from xaynet_tpu.ops import limbs as host_limbs
     from xaynet_tpu.parallel.aggregator import ShardedAggregator
     from xaynet_tpu.storage.memory import InMemoryCoordinatorStorage
 
@@ -218,11 +218,12 @@ def main() -> None:
     # host fold), not the device kernel emulated on the host.
     t0 = time.perf_counter()
     if on_tpu:
-        mask_acc = None
-        for i in range(k_sum2):
-            seed = bytes([i & 0xFF, i >> 8]) + b"\x33" * 30
-            vect = chacha_jax.derive_uniform_limbs(seed, model_len, order)
-            mask_acc = vect if mask_acc is None else limbs_jax.mod_add(mask_acc, vect, ol)
+        # the production SDK device path (state_machine.py device_sum2):
+        # seeds derive in vmapped groups and fold per group
+        from xaynet_tpu.ops import masking_jax
+
+        seeds = [bytes([i & 0xFF, i >> 8]) + b"\x33" * 30 for i in range(k_sum2)]
+        _, mask_acc = masking_jax.sum_masks(seeds, model_len, config.pair())
         jax.block_until_ready(mask_acc)
     else:
         from xaynet_tpu.core.crypto.prng import StreamSampler

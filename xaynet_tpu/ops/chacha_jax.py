@@ -105,12 +105,7 @@ def provision_candidates(count: int, order: int) -> int:
 _CHUNK_BYTES_CAP = 32 * 1024 * 1024
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_cand", "bpn", "out_limbs", "order_tuple"),
-    donate_argnums=(0,),
-)
-def _derive_chunk(
+def _derive_chunk_impl(
     out: jax.Array,
     base: jax.Array,
     key_words: jax.Array,
@@ -163,6 +158,61 @@ def _derive_chunk(
     return out, n_accepted
 
 
+_derive_chunk = partial(
+    jax.jit,
+    static_argnames=("n_cand", "bpn", "out_limbs", "order_tuple"),
+    donate_argnums=(0,),
+)(_derive_chunk_impl)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_cand", "bpn", "out_limbs", "order_tuple"),
+    donate_argnums=(0,),
+)
+def _derive_chunk_batch(
+    out: jax.Array,
+    base: jax.Array,
+    key_words: jax.Array,
+    block_start: jax.Array,
+    intra: jax.Array,
+    n_cand: int,
+    bpn: int,
+    out_limbs: int,
+    order_tuple: tuple[int, ...],
+) -> tuple[jax.Array, jax.Array]:
+    """``_derive_chunk_impl`` vmapped over a leading seed axis.
+
+    One launch derives a chunk for every seed in the batch (the sum2
+    participant loop is ``#updates`` independent seeds — VPU work that would
+    otherwise dispatch per seed); per-seed cursors/bases ride in as vectors.
+    """
+
+    def one(o, b, kw, bs, it):
+        return _derive_chunk_impl(o, b, kw, bs, it, n_cand, bpn, out_limbs, order_tuple)
+
+    return jax.vmap(one)(out, base, key_words, block_start, intra)
+
+
+def _derive_params(
+    count: int, order: int, chunk_candidates: int | None, n_seeds: int = 1
+) -> tuple[int, int, tuple[int, ...], int]:
+    """Shared derivation setup: (bpn, out_limbs, order candidate limbs,
+    per-seed chunk size). The chunk cap divides by ``n_seeds`` so a batched
+    launch stays inside the same ``_CHUNK_BYTES_CAP`` device-memory budget
+    the single-seed path was designed around."""
+    from . import limbs as host_limbs
+
+    bpn = (order.bit_length() + 7) // 8
+    cand_limbs = max(1, (bpn + 3) // 4)
+    out_limbs = host_limbs.n_limbs_for_order(order)
+    order_cl = tuple(int(x) for x in host_limbs.int_to_limbs(order, cand_limbs))
+    if chunk_candidates is None:
+        chunk_candidates = provision_candidates(count, order)
+    chunk_candidates = max(64, min(chunk_candidates, _CHUNK_BYTES_CAP // bpn // max(1, n_seeds)))
+    return bpn, out_limbs, order_cl, chunk_candidates
+
+
 def derive_uniform_limbs(
     seed: bytes,
     count: int,
@@ -179,15 +229,7 @@ def derive_uniform_limbs(
     loop simply continues on the next chunk otherwise, so the result is
     unconditionally exact with no host fallback.
     """
-    from . import limbs as host_limbs
-
-    bpn = (order.bit_length() + 7) // 8
-    cand_limbs = max(1, (bpn + 3) // 4)
-    out_limbs = host_limbs.n_limbs_for_order(order)
-    order_cl = tuple(int(x) for x in host_limbs.int_to_limbs(order, cand_limbs))
-    if chunk_candidates is None:
-        chunk_candidates = provision_candidates(count, order)
-    chunk_candidates = max(64, min(chunk_candidates, _CHUNK_BYTES_CAP // bpn))
+    bpn, out_limbs, order_cl, chunk_candidates = _derive_params(count, order, chunk_candidates)
 
     key_words = jnp.asarray(np.frombuffer(seed, dtype="<u4"))
     out = jnp.zeros((count, out_limbs), dtype=_U32)
@@ -209,4 +251,52 @@ def derive_uniform_limbs(
         )
         base += int(n_acc)
         offset += chunk_candidates * bpn
+    return out
+
+
+def derive_uniform_limbs_batch(
+    seeds: list[bytes],
+    count: int,
+    order: int,
+    byte_offsets: list[int] | None = None,
+    chunk_candidates: int | None = None,
+) -> jax.Array:
+    """``derive_uniform_limbs`` for many seeds in one kernel series.
+
+    Returns ``uint32[len(seeds), count, out_limbs]``; each row is
+    bit-identical to the single-seed derivation with that seed/offset (same
+    keystream, same rejection rule, same acceptance order). Chunk rounds run
+    until the slowest seed completes; seeds already done keep scattering
+    into dropped slots (their ``base`` is clamped at ``count``), which costs
+    keystream FLOPs but never correctness — with the 2^-60 provisioning all
+    seeds complete in the first round except vanishingly rarely.
+    """
+    if not seeds:
+        raise ValueError("no seeds")
+    bpn, out_limbs, order_cl, chunk_candidates = _derive_params(
+        count, order, chunk_candidates, n_seeds=len(seeds)
+    )
+
+    b = len(seeds)
+    key_words = jnp.asarray(np.stack([np.frombuffer(s, dtype="<u4") for s in seeds]))
+    out = jnp.zeros((b, count, out_limbs), dtype=_U32)
+    base = np.zeros(b, dtype=np.int64)
+    offsets = np.asarray(byte_offsets if byte_offsets is not None else [0] * b, dtype=np.int64)
+    while (base < count).any():
+        block_start, intra = np.divmod(offsets, 64)
+        if int(block_start.max()) + chunk_candidates * bpn // 64 + 2 > 0xFFFFFFFF:
+            raise ValueError("keystream longer than 2^32 blocks is not supported on device")
+        out, n_acc = _derive_chunk_batch(
+            out,
+            jnp.asarray(base, dtype=jnp.int32),
+            key_words,
+            jnp.asarray(block_start, dtype=_U32),
+            jnp.asarray(intra, dtype=jnp.int32),
+            chunk_candidates,
+            bpn,
+            out_limbs,
+            order_cl,
+        )
+        base = np.minimum(base + np.asarray(n_acc, dtype=np.int64), count)
+        offsets += chunk_candidates * bpn
     return out

@@ -47,11 +47,18 @@ def unmask_vect_limbs(
 
 
 def sum_masks(
-    seeds: list[bytes], length: int, config: MaskConfigPair
+    seeds: list[bytes], length: int, config: MaskConfigPair, seed_batch: int = 8
 ) -> tuple[np.ndarray, jax.Array]:
     """Derive and modularly sum the masks of many seeds (Sum2 hot loop).
 
     Returns (unit limbs, vector limbs) of the aggregated mask.
+
+    Seeds derive in groups of ``seed_batch`` through one vmapped keystream
+    kernel per chunk round (``chacha_jax.derive_uniform_limbs_batch``), then
+    each group folds with one ``batch_mod_sum`` pass — at the reference's
+    10k-updates scale that is #updates/seed_batch kernel series instead of
+    #updates (sum2.rs:170-193 is the per-seed loop this replaces). Device
+    memory is bounded by ``seed_batch * length`` mask elements.
     """
     if not seeds:
         raise ValueError("no seeds to aggregate")
@@ -60,12 +67,34 @@ def sum_masks(
 
     unit_acc: np.ndarray | None = None
     vect_acc: jax.Array | None = None
-    for seed in seeds:
-        unit, vect = derive_mask_limbs(seed, length, config)
+    for g0 in range(0, len(seeds), max(1, seed_batch)):
+        group = seeds[g0 : g0 + max(1, seed_batch)]
+        units, offsets = [], []
+        for seed in group:
+            # host unit draw first, exactly as MaskSeed.derive_mask orders
+            # the keystream; the vector draw continues at the handed-off
+            # byte cursor
+            sampler = StreamSampler(seed)
+            units.append(sampler.draw_limbs(1, config.unit.order)[0])
+            offsets.append(sampler.consumed_bytes)
+        vects = chacha_jax.derive_uniform_limbs_batch(
+            group, length, config.vect.order, byte_offsets=offsets
+        )
+        group_unit = units[0]
+        for u in units[1:]:
+            group_unit = host_limbs.mod_add(group_unit[None, :], u[None, :], order_limbs_u)[0]
         if vect_acc is None:
-            unit_acc, vect_acc = unit, vect
+            vect_acc = (
+                limbs_jax.batch_mod_sum(vects, order_limbs_v) if len(group) > 1 else vects[0]
+            )
+            unit_acc = group_unit
         else:
-            unit_acc = host_limbs.mod_add(unit_acc[None, :], unit[None, :], order_limbs_u)[0]
-            vect_acc = limbs_jax.mod_add(vect_acc, vect, order_limbs_v)
+            # one jitted kernel: tree-sum the group and fold it into the
+            # donated accumulator (aggregate_batch), instead of eager
+            # batch_mod_sum + mod_add dispatches per group
+            vect_acc = limbs_jax.aggregate_batch(vect_acc, vects, order_limbs_v)
+            unit_acc = host_limbs.mod_add(
+                unit_acc[None, :], group_unit[None, :], order_limbs_u
+            )[0]
     assert unit_acc is not None and vect_acc is not None
     return unit_acc, vect_acc
