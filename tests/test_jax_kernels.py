@@ -235,6 +235,93 @@ def test_fold_pallas_matches_oracle(k):
     assert got == want
 
 
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6),  # 2 limbs, bpn 6
+        MaskConfig(GroupType.POWER2, DataType.I32, BoundType.BMAX, ModelType.M9),  # 2^96 boundary
+        MaskConfig(GroupType.PRIME, DataType.F64, BoundType.B6, ModelType.M3),  # multi-limb
+    ],
+)
+def test_wire_bytes_to_planar_matches_host_parse(cfg):
+    """Device wire unpack == host parser limb-for-limb (raw element block)."""
+    import random as pyrandom
+
+    import jax.numpy as jnp
+
+    from xaynet_tpu.core.mask.object import MaskVect
+    from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
+    from xaynet_tpu.ops.fold_jax import wire_to_planar
+
+    order = cfg.order
+    n_limb = host_limbs.n_limbs_for_order(order)
+    bpn = cfg.bytes_per_number
+    rng = pyrandom.Random(3)
+    n = 57
+    rows = [rng.randrange(order) for _ in range(n)]
+    wire = serialize_mask_vect(MaskVect(cfg, host_limbs.ints_to_limbs(rows, n_limb)))
+    raw = np.frombuffer(wire, dtype=np.uint8)[8:]  # strip config(4) + count(4)
+    assert raw.shape[0] == n * bpn
+
+    got = np.asarray(limbs_jax.wire_bytes_to_planar(jnp.asarray(raw), n, bpn))
+    want_limbs, _ = parse_mask_vect(wire)
+    assert np.array_equal(got[: n_limb], wire_to_planar(want_limbs.data)), (
+        "device unpack diverges from host parse"
+    )
+    # validity kernel agrees with the host rule (the 2^(32L) boundary case
+    # is owned inside the kernel, like limbs.elements_lt_order)
+    assert bool(limbs_jax.planar_all_lt_const(got[:n_limb], order))
+
+
+def test_sharded_aggregator_wire_ingest():
+    """add_wire_batch (device unpack+validity+fold) == host parse + host agg."""
+    from xaynet_tpu.core.mask.object import MaskVect
+    from xaynet_tpu.core.mask.serialization import serialize_mask_vect
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+    n, k = 103, 5  # not divisible by the 8-device mesh
+    rng = np.random.default_rng(5)
+    cfg = CFG
+    bpn = cfg.bytes_per_number
+    agg_host = Aggregation(cfg.pair(), n)
+    raws = []
+    for _ in range(k):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
+        agg_host.aggregate(masked)
+        wire = serialize_mask_vect(masked.vect)
+        raws.append(np.frombuffer(wire, dtype=np.uint8)[8:])
+
+    dev = ShardedAggregator(cfg, n)
+    ok = dev.add_wire_batch(np.stack(raws[:2]))
+    assert ok.tolist() == [True, True]
+    ok = dev.add_wire_batch(np.stack(raws[2:]))
+    assert ok.tolist() == [True, True, True]
+    assert dev.nb_models == k
+    assert np.array_equal(dev.snapshot(), agg_host.object.vect.data)
+
+    # per-update rejection: an update with an element >= order is excluded
+    # from the fold and the count, and the others in the batch still land —
+    # the aggregate must equal the host aggregate of only the valid ones
+    dev2 = ShardedAggregator(cfg, n)
+    bad = np.stack([raws[0], raws[1].copy(), raws[2]])
+    bad[1, :bpn] = 0xFF  # max fixed-width value >= every non-boundary order
+    ok = dev2.add_wire_batch(bad)
+    assert ok.tolist() == [True, False, True]
+    assert dev2.nb_models == 2
+    # the aggregate equals the host aggregate of only the two valid updates
+    from xaynet_tpu.core.mask.serialization import parse_mask_vect
+
+    host2 = Aggregation(cfg.pair(), n)
+    valid_limbs = []
+    for r in (raws[0], raws[2]):
+        wire = cfg.to_bytes() + (len(r) // bpn).to_bytes(4, "big") + r.tobytes()
+        valid_limbs.append(parse_mask_vect(wire)[0].data)
+    unit_l = host_limbs.n_limbs_for_order(cfg.pair().unit.order)
+    host2.aggregate_batch(np.stack(valid_limbs), np.zeros((2, unit_l), dtype=np.uint32))
+    assert np.array_equal(dev2.snapshot(), host2.object.vect.data)
+
+
 def test_multihost_initialize_noop_and_mesh():
     """Single-process: initialize is a no-op and the global mesh spans all
     devices (the 2-process path is covered by tests/test_multihost.py)."""

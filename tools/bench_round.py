@@ -167,36 +167,68 @@ def main() -> None:
         )
     seed_entry = {pk: b"\x07" * 80 for pk in sum_pks}
     for b in range(n_batches):
-        # 1. wire parse on the thread pool
-        t0 = time.perf_counter()
-        parsed = list(pool.map(lambda w: parse_mask_vect(w)[0], wire_msgs))
-        t_parse += time.perf_counter() - t0
+        if on_tpu:
+            # device ingest: the coordinator ships the RAW wire element
+            # blocks (smaller than the limb tensors) and the device does
+            # unpack + element validity + fold — the host parse leg
+            # reduces to header checks (zero-copy views)
+            t0 = time.perf_counter()
+            raw_blocks = [np.frombuffer(w, dtype=np.uint8)[8:] for w in wire_msgs]
+            t_parse += time.perf_counter() - t0
 
-        # 2. validate (is_valid is part of parse; re-assert config + length,
-        # the validate_aggregation ordering of update.rs:119-152)
-        t0 = time.perf_counter()
-        for v in parsed:
-            assert v.config == config and len(v) == model_len
-        t_validate += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            from xaynet_tpu.core.mask.config import MaskConfig as _MC
 
-        # 3. seed-dict conditional insert per update
-        t0 = time.perf_counter()
+            for w in wire_msgs:
+                assert _MC.from_bytes(w[:4]) == config
+                assert int.from_bytes(w[4:8], "big") == model_len
+            t_validate += time.perf_counter() - t0
+            parsed = None
+        else:
+            # 1. wire parse on the thread pool
+            t0 = time.perf_counter()
+            parsed = list(pool.map(lambda w: parse_mask_vect(w)[0], wire_msgs))
+            t_parse += time.perf_counter() - t0
 
-        async def _inserts(base):
+            # 2. validate (is_valid is part of parse; re-assert config +
+            # length, the validate_aggregation ordering of update.rs:119-152)
+            t0 = time.perf_counter()
+            for v in parsed:
+                assert v.config == config and len(v) == model_len
+            t_validate += time.perf_counter() - t0
+
+        async def _inserts(base, accepted):
             for i in range(k_batch):
+                if not accepted[i]:
+                    continue
                 pk = (b"%16d" % (base + i)).ljust(32, b"u")
                 err = await store.add_local_seed_dict(pk, dict(seed_entry))
                 assert err is None, err
 
-        asyncio.run(_inserts(b * k_batch))
-        t_seed += time.perf_counter() - t0
+        if on_tpu:
+            # device ingest resolves element validity, so the reference's
+            # validate -> seed-dict -> aggregate ordering (update.rs:119-152)
+            # becomes unpack+validate+fold on device, THEN seed inserts for
+            # the accepted updates only
+            t0 = time.perf_counter()
+            ok = agg.add_wire_batch(np.stack(raw_blocks))
+            t_stage += time.perf_counter() - t0
 
-        # 4. stage + fold (device dispatch is async: the fold of batch b
-        # overlaps the parse of batch b+1)
-        t0 = time.perf_counter()
-        stack = np.stack([v.data for v in parsed])
-        agg.add_batch(stack)
-        t_stage += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            asyncio.run(_inserts(b * k_batch, ok))
+            t_seed += time.perf_counter() - t0
+        else:
+            # 3. seed-dict conditional insert per update
+            t0 = time.perf_counter()
+            asyncio.run(_inserts(b * k_batch, [True] * k_batch))
+            t_seed += time.perf_counter() - t0
+
+            # 4. stage + fold (device dispatch is async: the fold of batch b
+            # overlaps the parse of batch b+1)
+            t0 = time.perf_counter()
+            stack = np.stack([v.data for v in parsed])
+            agg.add_batch(stack)
+            t_stage += time.perf_counter() - t0
         if b == 2:
             # steady-state baseline: the first batches pay one-time costs
             # (thread-pool arenas, parse buffers, kernel warmup) that are

@@ -123,3 +123,42 @@ def _aggregate_batch_kernel(acc: jax.Array, stack: jax.Array, order_tuple: tuple
 def aggregate_batch(acc: jax.Array, stack: jax.Array, order_limbs: np.ndarray) -> jax.Array:
     """Fold ``uint32[K, n, L]`` updates into the running accumulator (jitted)."""
     return _aggregate_batch_kernel(acc, stack, tuple(int(x) for x in _as_order(order_limbs)))
+
+
+def wire_bytes_to_planar(data: jax.Array, count: int, bpn: int) -> jax.Array:
+    """Wire element block ``uint8[..., count*bpn]`` -> planar ``uint32[..., L, count]``.
+
+    The wire layout is ``count`` fixed-width little-endian integers of
+    ``bpn`` bytes each (serialization.py / reference vect.rs:24-80). Pure
+    byte shuffling — reshape + shifts — so the coordinator can ship RAW
+    wire bytes to the device (``bpn/(4L)`` of the limb-tensor size, e.g.
+    6/8 for the f32/B0 configs) and never pay a host-side parse. Designed
+    to run inside a jitted caller.
+    """
+    out_limbs = (bpn + 3) // 4
+    b = data.reshape(*data.shape[:-1], count, bpn).astype(_U32)
+    limbs = []
+    for j in range(out_limbs):
+        w = b[..., 4 * j]
+        for i in range(1, min(4, bpn - 4 * j)):
+            w = w | (b[..., 4 * j + i] << _U32(8 * i))
+        limbs.append(w)
+    return jnp.stack(limbs, axis=-2)
+
+
+def planar_all_lt_const(planar: jax.Array, order: int) -> jax.Array:
+    """``all(element < order)`` per leading index over planar ``[..., L, n]``.
+
+    The device version of the wire parser's element-validity check, one
+    bool per leading index (per update for a ``[K, L, n]`` batch; a scalar
+    for a single ``[L, n]`` tensor). Owns the ``order == 2^(32 L)``
+    boundary case (every bit pattern valid) exactly like the host
+    ``limbs.elements_lt_order`` — callers never special-case it.
+    """
+    from . import limbs as host_limbs
+
+    n_limb = planar.shape[-2]
+    if order == 1 << (32 * n_limb):
+        return jnp.ones(planar.shape[:-2], dtype=bool)
+    order_limbs = host_limbs.int_to_limbs(order, n_limb)
+    return jnp.all(lt_const(jnp.moveaxis(planar, -2, -1), order_limbs), axis=-1)

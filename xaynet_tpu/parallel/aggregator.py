@@ -78,6 +78,10 @@ class ShardedAggregator:
         # planar shardings: model axis is the innermost (lane) dimension
         self._acc_sharding = NamedSharding(self.mesh, P(None, MODEL_AXIS))
         self._batch_sharding = NamedSharding(self.mesh, P(None, None, MODEL_AXIS))
+        # raw wire bytes shard over the same model axis: padded_length is a
+        # multiple of the mesh size, so every device's byte slice is
+        # element-aligned (count/n elements x bpn bytes)
+        self._batch_bytes_sharding = NamedSharding(self.mesh, P(None, MODEL_AXIS))
         self.acc = jax.device_put(
             jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding
         )
@@ -111,6 +115,40 @@ class ShardedAggregator:
         """Fold an already device-resident planar ``[K, L, padded_len]`` batch."""
         self.acc = self._fold(self.acc, stack_planar)
         self.nb_models += stack_planar.shape[0]
+
+    def add_wire_batch(self, raw: np.ndarray) -> np.ndarray:
+        """Fold RAW wire element blocks ``uint8[K, model_len * bpn]``.
+
+        The device-ingest fast path: ships the serialized little-endian
+        element block as-is (``bpn/(4 L)`` of the limb-tensor size — e.g.
+        75% of the bytes for the 2-limb f32 configs), then unpacks,
+        validity-checks, and folds entirely on device — the coordinator
+        never runs a host-side element parse (the second hot loop after
+        the fold; reference parses per element, vect.rs:24-80).
+
+        Validity is per update: an update with any element >= the group
+        order is EXCLUDED from the fold (zeroed — the additive identity)
+        and not counted in ``nb_models``, mirroring the reference's
+        per-message rejection (the coordinator must reject it before its
+        seed-dict insert). Returns the ``bool[K]`` acceptance vector.
+        """
+        bpn = self.config.bytes_per_number
+        raw = np.asarray(raw)
+        if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != self.model_length * bpn:
+            raise ValueError("expected uint8[K, model_len * bytes_per_number]")
+        if raw.shape[0] > MAX_LAZY_BATCH:
+            raise ValueError("batch too large for lazy-carry fold")
+        if self.padded_length != self.model_length:
+            # zero bytes decode to zero elements — valid and fold-neutral
+            raw = np.pad(raw, ((0, 0), (0, (self.padded_length - self.model_length) * bpn)))
+        staged = jax.device_put(raw, self._batch_bytes_sharding)
+        planar, ok = self._make_unpack_fn()(staged)
+        # dispatch the fold BEFORE syncing the acceptance vector: the fold
+        # then overlaps the host-side ok fetch instead of serializing on it
+        self.acc = self._fold(self.acc, planar)
+        ok_host = np.asarray(ok)
+        self.nb_models += int(ok_host.sum())
+        return ok_host
 
     # -- kernel selection ---------------------------------------------------
 
@@ -165,6 +203,53 @@ class ShardedAggregator:
         if fn is None:
             order = self.order
             fn = _FOLD_FN_CACHE[key] = lambda a, s: fold_planar_batch(a, s, order)
+        return fn
+
+    def _make_unpack_fn(self):
+        """Device wire-unpack + validity callable, memoized process-wide
+        (same identity-caching rationale as the fold fns)."""
+        bpn = self.config.bytes_per_number
+        key = ("unpack", self.mesh, bpn, self.order)
+        fn = _FOLD_FN_CACHE.get(key)
+        if fn is not None:
+            return fn
+        from ..ops import limbs_jax
+
+        order = self.order
+
+        def unpack(raw):
+            count = raw.shape[-1] // bpn
+            planar = limbs_jax.wire_bytes_to_planar(raw, count, bpn)
+            return planar, limbs_jax.planar_all_lt_const(planar, order)  # per update
+
+        if self.mesh.devices.size > 1:
+
+            def wrapped(raw):
+                planar, ok_local = unpack(raw)
+                # an update invalid on ANY shard is excluded on every shard
+                bad = jax.lax.psum((~ok_local).astype(jnp.uint32), MODEL_AXIS)
+                ok = bad == jnp.uint32(0)
+                planar = jnp.where(ok[:, None, None], planar, jnp.uint32(0))
+                return planar, ok
+
+            fn = jax.jit(
+                jax.shard_map(
+                    wrapped,
+                    mesh=self.mesh,
+                    in_specs=(P(None, MODEL_AXIS),),
+                    out_specs=(P(None, None, MODEL_AXIS), P()),
+                    check_vma=False,
+                )
+            )
+        else:
+
+            def single(raw):
+                planar, ok = unpack(raw)
+                planar = jnp.where(ok[:, None, None], planar, jnp.uint32(0))
+                return planar, ok
+
+            fn = jax.jit(single)
+        _FOLD_FN_CACHE[key] = fn
         return fn
 
     def _fold(self, acc, staged):
