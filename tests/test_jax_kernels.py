@@ -250,7 +250,11 @@ def test_wire_bytes_to_planar_matches_host_parse(cfg):
     import jax.numpy as jnp
 
     from xaynet_tpu.core.mask.object import MaskVect
-    from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
+    from xaynet_tpu.core.mask.serialization import (
+        parse_mask_vect,
+        serialize_mask_vect,
+        vect_element_block,
+    )
     from xaynet_tpu.ops.fold_jax import wire_to_planar
 
     order = cfg.order
@@ -260,7 +264,7 @@ def test_wire_bytes_to_planar_matches_host_parse(cfg):
     n = 57
     rows = [rng.randrange(order) for _ in range(n)]
     wire = serialize_mask_vect(MaskVect(cfg, host_limbs.ints_to_limbs(rows, n_limb)))
-    raw = np.frombuffer(wire, dtype=np.uint8)[8:]  # strip config(4) + count(4)
+    raw = vect_element_block(wire)
     assert raw.shape[0] == n * bpn
 
     got = np.asarray(limbs_jax.wire_bytes_to_planar(jnp.asarray(raw), n, bpn))
@@ -276,7 +280,7 @@ def test_wire_bytes_to_planar_matches_host_parse(cfg):
 def test_sharded_aggregator_wire_ingest():
     """add_wire_batch (device unpack+validity+fold) == host parse + host agg."""
     from xaynet_tpu.core.mask.object import MaskVect
-    from xaynet_tpu.core.mask.serialization import serialize_mask_vect
+    from xaynet_tpu.core.mask.serialization import serialize_mask_vect, vect_element_block
     from xaynet_tpu.parallel.aggregator import ShardedAggregator
 
     n, k = 103, 5  # not divisible by the 8-device mesh
@@ -290,7 +294,7 @@ def test_sharded_aggregator_wire_ingest():
         _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
         agg_host.aggregate(masked)
         wire = serialize_mask_vect(masked.vect)
-        raws.append(np.frombuffer(wire, dtype=np.uint8)[8:])
+        raws.append(vect_element_block(wire))
 
     dev = ShardedAggregator(cfg, n)
     ok = dev.add_wire_batch(np.stack(raws[:2]))

@@ -69,7 +69,11 @@ def main() -> None:
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
     from xaynet_tpu.core.mask.encode import decode_vect_fast
     from xaynet_tpu.core.mask.object import MaskVect
-    from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
+    from xaynet_tpu.core.mask.serialization import (
+        parse_mask_vect,
+        serialize_mask_vect,
+        vect_element_block,
+    )
     from xaynet_tpu.ops import limbs as host_limbs
     from xaynet_tpu.parallel.aggregator import ShardedAggregator
     from xaynet_tpu.storage.memory import InMemoryCoordinatorStorage
@@ -173,7 +177,7 @@ def main() -> None:
             # unpack + element validity + fold — the host parse leg
             # reduces to header checks (zero-copy views)
             t0 = time.perf_counter()
-            raw_blocks = [np.frombuffer(w, dtype=np.uint8)[8:] for w in wire_msgs]
+            raw_blocks = [vect_element_block(w) for w in wire_msgs]
             t_parse += time.perf_counter() - t0
 
             t0 = time.perf_counter()

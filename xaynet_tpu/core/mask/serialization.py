@@ -27,8 +27,19 @@ class DecodeError(ValueError):
     """Malformed wire bytes."""
 
 
+# config(4) + count(u32 BE): everything before the element block
+VECT_HEADER_LENGTH = MASK_CONFIG_LENGTH + 4
+
+
 def serialized_vect_length(config: MaskConfig, count: int) -> int:
-    return MASK_CONFIG_LENGTH + 4 + count * config.bytes_per_number
+    return VECT_HEADER_LENGTH + count * config.bytes_per_number
+
+
+def vect_element_block(wire: bytes) -> np.ndarray:
+    """The raw fixed-width element block of a serialized MaskVect as a
+    zero-copy uint8 view — the device-ingest input
+    (``ShardedAggregator.add_wire_batch``)."""
+    return np.frombuffer(wire, dtype=np.uint8)[VECT_HEADER_LENGTH:]
 
 
 def serialize_mask_vect(vect: MaskVect) -> bytes:
