@@ -277,6 +277,31 @@ def test_wire_bytes_to_planar_matches_host_parse(cfg):
     assert bool(limbs_jax.planar_all_lt_const(got[:n_limb], order))
 
 
+def test_vect_element_block_rejects_malformed_wire():
+    """The device-ingest entry point validates at the parse boundary, like
+    parse_mask_vect (truncated buffers and over-long MaskObject wires fail
+    with DecodeError, not as shape errors downstream)."""
+    from xaynet_tpu.core.mask.object import MaskVect
+    from xaynet_tpu.core.mask.serialization import (
+        DecodeError,
+        serialize_mask_vect,
+        vect_element_block,
+    )
+
+    wire = serialize_mask_vect(
+        MaskVect(CFG, host_limbs.ints_to_limbs([1, 2, 3], host_limbs.n_limbs_for_order(CFG.order)))
+    )
+    assert vect_element_block(wire).shape == (3 * CFG.bytes_per_number,)
+    with pytest.raises(DecodeError, match="too short"):
+        vect_element_block(wire[:5])
+    with pytest.raises(DecodeError, match="framed element count"):
+        vect_element_block(wire[:-1])  # truncated element block
+    with pytest.raises(DecodeError, match="framed element count"):
+        vect_element_block(wire + b"\x00\x00")  # trailing bytes (e.g. unit part)
+    with pytest.raises(DecodeError, match="invalid mask config"):
+        vect_element_block(b"\xff\xff\xff\xff" + wire[4:])
+
+
 def test_sharded_aggregator_wire_ingest():
     """add_wire_batch (device unpack+validity+fold) == host parse + host agg."""
     from xaynet_tpu.core.mask.object import MaskVect

@@ -181,10 +181,8 @@ def main() -> None:
             t_parse += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            from xaynet_tpu.core.mask.config import MaskConfig as _MC
-
             for w in wire_msgs:
-                assert _MC.from_bytes(w[:4]) == config
+                assert MaskConfig.from_bytes(w[:4]) == config
                 assert int.from_bytes(w[4:8], "big") == model_len
             t_validate += time.perf_counter() - t0
             parsed = None

@@ -173,6 +173,51 @@ def main() -> int:
         except Exception as e:
             emit({"stage": "pallas-import", "error": f"{type(e).__name__}: {e}"[:300]})
 
+    # device wire ingest: raw serialized element blocks (bpn/(4L) the bytes
+    # of the limb layout) -> unpack + per-update validity + fold on device.
+    # Measures the whole coordinator ingest as it would run on TPU, incl.
+    # the smaller host->device transfer.
+    try:
+        from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+        bpn = config.bytes_per_number
+        rng2 = np.random.default_rng(1)
+        raw = rng2.integers(0, 256, size=(k, model_len * bpn), dtype=np.uint8)
+        # keep every element's top byte below the order's top byte -> valid
+        top_byte = (order >> (8 * (bpn - 1))) & 0xFF
+        raw[:, bpn - 1 :: bpn] = rng2.integers(0, max(1, top_byte), size=(k, model_len), dtype=np.uint8)
+        w_agg = ShardedAggregator(config, model_len, kernel="xla")
+        # per-update ingest calls: each device_put stays at one update's
+        # wire bytes (~175 MB at 25M/bpn=7) — this file's own rule after a
+        # 3.2 GB single transfer killed the round-3 tunnel window
+        t0 = time.perf_counter()
+        ok = w_agg.add_wire_batch(raw[:1])  # includes device_put + unpack compile
+        jax.block_until_ready(w_agg.acc)
+        compile_s = time.perf_counter() - t0
+        assert ok.all()
+        t0 = time.perf_counter()
+        for _ in range(args.folds):
+            for i in range(k):
+                w_agg.add_wire_batch(raw[i : i + 1])
+        jax.block_until_ready(w_agg.acc)
+        dt = time.perf_counter() - t0
+        ups = args.folds * k / dt
+        emit(
+            {
+                "stage": "wire_ingest",
+                "platform": platform,
+                "model_len": model_len,
+                "k": k,
+                "wire_bytes_per_update": model_len * bpn,
+                "compile_seconds": round(compile_s, 2),
+                "updates_per_s": round(ups, 2),
+                "vs_baseline": round(ups / (10_000 / 60.0), 3),
+            }
+        )
+        del raw, w_agg
+    except Exception as e:
+        emit({"stage": "wire_ingest", "platform": platform, "error": f"{type(e).__name__}: {e}"[:500]})
+
     if args.auto_stage:
         # the production selection path: ShardedAggregator(kernel="auto")
         # compiles+times both kernels on the real staged batch and keeps the

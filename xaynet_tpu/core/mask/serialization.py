@@ -38,7 +38,21 @@ def serialized_vect_length(config: MaskConfig, count: int) -> int:
 def vect_element_block(wire: bytes) -> np.ndarray:
     """The raw fixed-width element block of a serialized MaskVect as a
     zero-copy uint8 view — the device-ingest input
-    (``ShardedAggregator.add_wire_batch``)."""
+    (``ShardedAggregator.add_wire_batch``).
+
+    Validates the header and the exact framed length like
+    ``parse_mask_vect`` does (a truncated buffer or a full MaskObject
+    wire — vect ‖ unit — raises ``DecodeError`` here, at the parse
+    boundary, not as an opaque shape error downstream)."""
+    if len(wire) < VECT_HEADER_LENGTH:
+        raise DecodeError("mask vector buffer too short")
+    try:
+        config = MaskConfig.from_bytes(wire[:MASK_CONFIG_LENGTH])
+    except ValueError as e:
+        raise DecodeError(f"invalid mask config: {e}") from e
+    (count,) = struct.unpack_from(">I", wire, MASK_CONFIG_LENGTH)
+    if len(wire) != VECT_HEADER_LENGTH + count * config.bytes_per_number:
+        raise DecodeError("wire length does not match the framed element count")
     return np.frombuffer(wire, dtype=np.uint8)[VECT_HEADER_LENGTH:]
 
 

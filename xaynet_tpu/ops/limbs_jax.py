@@ -132,8 +132,8 @@ def wire_bytes_to_planar(data: jax.Array, count: int, bpn: int) -> jax.Array:
     ``bpn`` bytes each (serialization.py / reference vect.rs:24-80). Pure
     byte shuffling — reshape + shifts — so the coordinator can ship RAW
     wire bytes to the device (``bpn/(4L)`` of the limb-tensor size, e.g.
-    6/8 for the f32/B0 configs) and never pay a host-side parse. Designed
-    to run inside a jitted caller.
+    6/8 for the f32/B0/M3 configs, 7/8 for M6) and never pay a host-side
+    parse. Designed to run inside a jitted caller.
     """
     out_limbs = (bpn + 3) // 4
     b = data.reshape(*data.shape[:-1], count, bpn).astype(_U32)

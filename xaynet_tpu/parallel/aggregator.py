@@ -120,8 +120,8 @@ class ShardedAggregator:
         """Fold RAW wire element blocks ``uint8[K, model_len * bpn]``.
 
         The device-ingest fast path: ships the serialized little-endian
-        element block as-is (``bpn/(4 L)`` of the limb-tensor size — e.g.
-        75% of the bytes for the 2-limb f32 configs), then unpacks,
+        element block as-is (``bpn/(4 L)`` of the limb-tensor size — 75%
+        for the 6-byte f32/M3 configs, 87.5% for 7-byte M6), then unpacks,
         validity-checks, and folds entirely on device — the coordinator
         never runs a host-side element parse (the second hot loop after
         the fold; reference parses per element, vect.rs:24-80).
