@@ -351,6 +351,43 @@ def test_sharded_aggregator_wire_ingest():
     assert np.array_equal(dev2.snapshot(), host2.object.vect.data)
 
 
+def test_sharded_aggregator_wire_ingest_fused(monkeypatch):
+    """The accelerator-only FUSED ingest jit (unpack+validity+fold in one
+    XLA program) — forced on via a monkeypatched backend, same stand-in
+    pattern as test_kernel_auto — matches the host aggregate and keeps the
+    per-update exclusion semantics."""
+    import jax
+
+    from xaynet_tpu.core.mask.serialization import serialize_mask_vect, vect_element_block
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+    n, k = 103, 4
+    rng = np.random.default_rng(9)
+    cfg = CFG
+    bpn = cfg.bytes_per_number
+    raws = []
+    for _ in range(k):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
+        raws.append((vect_element_block(serialize_mask_vect(masked.vect)), masked))
+
+    dev = ShardedAggregator(cfg, n)
+    dev.add_wire_batch(np.stack([r for r, _ in raws[:2]]))  # two-step (resolve)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    bad = np.stack([raws[2][0], raws[3][0].copy()])
+    bad[1, -bpn:] = 0xFF  # invalid in the fused batch
+    ok = dev.add_wire_batch(bad)  # fused path
+    assert ok.tolist() == [True, False]
+    assert dev.nb_models == 3
+
+    host = Aggregation(cfg.pair(), n)
+    unit_l = host_limbs.n_limbs_for_order(cfg.pair().unit.order)
+    host.aggregate_batch(
+        np.stack([m.vect.data for _, m in raws[:3]]), np.zeros((3, unit_l), dtype=np.uint32)
+    )
+    assert np.array_equal(dev.snapshot(), host.object.vect.data)
+
+
 def test_multihost_initialize_noop_and_mesh():
     """Single-process: initialize is a no-op and the global mesh spans all
     devices (the 2-process path is covered by tests/test_multihost.py)."""
