@@ -75,6 +75,27 @@ def main() -> None:
     expected = host_limbs.mod_sub(expected, mask, ol)
     assert np.array_equal(out_local, expected[lo:hi]), "unmasked slice mismatch"
 
+    # --- wire-ingest leg: each host ships only its RAW byte sub-block ----
+    # one extra update carries an invalid element in the LAST host's slice;
+    # the validity psum must exclude it on EVERY host identically
+    bpn = config.bytes_per_number
+    bad = wire[0].copy()
+    bad[model_len - 1] = np.iinfo(np.uint32).max  # element >= order
+    stack2 = np.concatenate([wire, bad[None]], axis=0)
+    raw_full = np.stack(
+        [
+            np.frombuffer(host_limbs.limbs_to_bytes_le(stack2[i], bpn), dtype=np.uint8)
+            for i in range(k + 1)
+        ]
+    )
+    agg2 = MultiHostAggregator(config, model_len)
+    ok = agg2.add_local_wire_batch(raw_full[:, lo * bpn : hi * bpn])
+    assert ok.tolist() == [True] * k + [False], f"acceptance diverged: {ok.tolist()}"
+    assert agg2.nb_models == k, agg2.nb_models
+    assert np.array_equal(
+        agg2.snapshot_local(), host_limbs.batch_mod_sum(wire, ol)[lo:hi]
+    ), "wire-ingest slice mismatch"
+
     print(f"WORKER {process_id} OK slice=[{lo},{hi})", flush=True)
 
 

@@ -165,6 +165,12 @@ class ShardedAggregator:
             # zero bytes decode to zero elements — valid and fold-neutral
             raw = np.pad(raw, ((0, 0), (0, (self.padded_length - self.model_length) * bpn)))
         staged = jax.device_put(raw, self._batch_bytes_sharding)
+        return self._ingest_staged_bytes(staged)
+
+    def _ingest_staged_bytes(self, staged) -> np.ndarray:
+        """Unpack + validity + fold an already device/mesh-resident raw-byte
+        batch (``add_wire_batch`` after device_put; the multihost path after
+        ``make_array_from_process_local_data``)."""
         if (
             self._fold_fn is not None
             and self.kernel_used == "xla"

@@ -138,6 +138,36 @@ class MultiHostAggregator:
         )
         self.agg.add_planar_batch(staged)
 
+    def add_local_wire_batch(self, local_raw: np.ndarray) -> np.ndarray:
+        """Fold RAW wire bytes given only this host's element slice:
+        ``uint8[K, (hi-lo)*bpn]`` — the device-ingest path multihost.
+
+        Each host ships the byte sub-block of the serialized element block
+        covering its model slice (element-aligned by construction: the
+        per-host slice is ``padded/num_processes`` whole elements), the
+        global byte array assembles with zero cross-host transfers, and
+        unpack + per-update validity + fold run SPMD. Every process must
+        call this collectively with the same K. Returns the ``bool[K]``
+        acceptance vector (identical on every process — validity reduces
+        with a psum over the model axis)."""
+        from ..ops.fold_jax import MAX_LAZY_BATCH
+
+        bpn = self.agg.config.bytes_per_number
+        raw = np.asarray(local_raw)
+        lo, hi = self.local_slice
+        if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != (hi - lo) * bpn:
+            raise ValueError(f"expected uint8[K, {(hi - lo) * bpn}] (this host's wire slice)")
+        if raw.shape[0] > MAX_LAZY_BATCH:
+            raise ValueError("batch too large for lazy-carry fold")
+        want = (self._hi_padded - self._lo_padded) * bpn
+        if raw.shape[1] != want:
+            raw = np.pad(raw, ((0, 0), (0, want - raw.shape[1])))
+        global_shape = (raw.shape[0], self.agg.padded_length * bpn)
+        staged = jax.make_array_from_process_local_data(
+            self.agg._batch_bytes_sharding, raw, global_shape
+        )
+        return self.agg._ingest_staged_bytes(staged)
+
     def _assemble_local(self, arr: jax.Array) -> np.ndarray:
         """This process's addressable columns of a planar sharded array,
         cut to the real (unpadded) slice and returned in wire layout."""
