@@ -250,6 +250,96 @@ def test_round_with_chunked_updates_and_device_aggregation(kernel, monkeypatch):
         assert pallas_calls and all(pallas_calls), "round did not fold through the Pallas kernel"
 
 
+def test_round_with_wire_ingest(monkeypatch):
+    """Full round with ``aggregation.wire_ingest = true``: Update masked
+    models parse LAZILY (raw element block kept through the multipart
+    stream parse), element unpack + validity run on the device BEFORE the
+    seed-dict insert, and the fold consumes device-resident planars — the
+    coordinator never executes the host element parse. A spy proves every
+    accepted update went through the device validation; the global model
+    is still the exact mean."""
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+
+    validated = []
+    real_validate = ShardedAggregator.validate_wire_update
+
+    def spy(self, raw):
+        out = real_validate(self, raw)
+        validated.append(out is not None)
+        return out
+
+    monkeypatch.setattr(ShardedAggregator, "validate_wire_update", spy)
+
+    async def run():
+        settings = _settings()
+        settings.model.length = 600  # update payload >> max_message_size
+        settings.aggregation.device = True
+        settings.aggregation.batch_size = 2
+        settings.aggregation.kernel = "xla"
+        settings.aggregation.wire_ingest = True
+        settings.validate()
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, request_tx, wire_ingest=True)
+        fetcher = Fetcher(events)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            while fetcher.phase().value != "sum":
+                await asyncio.sleep(0.01)
+            params = fetcher.round_params()
+            seed = params.seed.as_bytes()
+            rng = np.random.default_rng(11)
+            expected = np.zeros(600)
+            participants = []
+            for i in range(N_SUM):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+                participants.append(
+                    ParticipantSM(
+                        PetSettings(keys=keys, max_message_size=1024),
+                        InProcessClient(fetcher, handler),
+                        ArrayModelStore(None),
+                    )
+                )
+            for i in range(N_UPDATE):
+                keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(10 + i) * 1000)
+                local = rng.uniform(-1, 1, 600).astype(np.float32)
+                expected += local.astype(np.float64) / N_UPDATE
+                participants.append(
+                    ParticipantSM(
+                        PetSettings(keys=keys, scalar=Fraction(1, N_UPDATE), max_message_size=1024),
+                        InProcessClient(fetcher, handler),
+                        ArrayModelStore(local),
+                    )
+                )
+
+            async def drive(sm):
+                for _ in range(500):
+                    try:
+                        await sm.transition()
+                    except Exception:
+                        pass
+                    if fetcher.model() is not None and sm.phase.value == "awaiting":
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(*(drive(p) for p in participants))
+            while fetcher.model() is None:
+                await asyncio.sleep(0.01)
+            return np.asarray(fetcher.model()), expected
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    got, expected = asyncio.run(asyncio.wait_for(run(), timeout=180))
+    np.testing.assert_allclose(got, expected, atol=1e-9)
+    assert len(validated) >= N_UPDATE and all(validated), (
+        f"device wire validation did not run for every update: {validated}"
+    )
+
+
 def test_sum_participant_save_restore_mid_round():
     """A sum participant suspended after Sum resumes and completes Sum2
     (the ephemeral decryption key must survive serialization)."""

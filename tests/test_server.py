@@ -268,6 +268,69 @@ def test_staged_aggregator_device_matches_host(kernel):
     assert dev.kernel_used == kernel
 
 
+def test_staged_aggregator_lazy_wire_vect_device_validate_and_reject():
+    """Lazy wire vects (aggregation.wire_ingest): validate_aggregation runs
+    the device unpack+validity and caches the planar; an invalid element is
+    rejected BEFORE the caller's seed-dict insert (AggregationError, like
+    the eager parse's DecodeError one stage earlier); the staged fold
+    matches the eager-parse host path exactly."""
+    import numpy as np
+    import pytest as _pytest
+
+    from xaynet_tpu.core.mask import (
+        BoundType,
+        DataType,
+        GroupType,
+        Masker,
+        MaskConfig,
+        ModelType,
+        Scalar,
+    )
+    from xaynet_tpu.core.mask.masking import AggregationError
+    from xaynet_tpu.core.mask.object import LazyWireMaskVect, MaskObject
+    from xaynet_tpu.core.mask.serialization import serialize_mask_vect, vect_element_block
+    from xaynet_tpu.server.aggregation import StagedAggregator
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+    bpn = cfg.bytes_per_number
+    n, k = 57, 5
+    rng = np.random.default_rng(4)
+    host = StagedAggregator(cfg.pair(), n, device=False, batch_size=3)
+    dev = StagedAggregator(cfg.pair(), n, device=True, batch_size=3, kernel="xla")
+    for _ in range(k):
+        w = rng.uniform(-1, 1, n).astype(np.float32)
+        _, masked = Masker(cfg.pair()).mask(Scalar(1, k), w)
+        host.validate_aggregation(masked)
+        host.aggregate(masked)
+        raw = vect_element_block(serialize_mask_vect(masked.vect))
+        lazy = MaskObject(LazyWireMaskVect(cfg, raw, n), masked.unit)
+        dev.validate_aggregation(lazy)
+        assert lazy.vect._staged_planar is not None  # device validated + cached
+        assert not lazy.vect.materialized  # host parse never ran
+        dev.aggregate(lazy)
+    a, b = host.finalize(), dev.finalize()
+    assert a.nb_models == b.nb_models == k
+    assert a.object == b.object
+
+    # an invalid element must be rejected at validate time (before any
+    # seed-dict insert), not silently folded
+    _, masked = Masker(cfg.pair()).mask(Scalar(1, k), np.zeros(n, dtype=np.float32))
+    raw = np.array(vect_element_block(serialize_mask_vect(masked.vect)))
+    raw[:bpn] = 0xFF  # element >= order
+    bad = MaskObject(LazyWireMaskVect(cfg, raw, n), masked.unit)
+    dev2 = StagedAggregator(cfg.pair(), n, device=True, batch_size=3, kernel="xla")
+    with _pytest.raises(AggregationError):
+        dev2.validate_aggregation(bad)
+    assert dev2.pending == 0 and dev2.nb_models == 0
+
+    # host access to a lazy vect materializes identically to the eager parse
+    lazy2 = LazyWireMaskVect(
+        cfg, vect_element_block(serialize_mask_vect(masked.vect)), n
+    )
+    assert np.array_equal(lazy2.data, masked.vect.data)
+    assert lazy2.materialized and lazy2.is_valid() and len(lazy2) == n
+
+
 def test_sdk_sum2_device_path_matches_host(monkeypatch):
     """SDK mask aggregation: device kernels == host path."""
     import numpy as np

@@ -70,6 +70,49 @@ class MaskVect:
         )
 
 
+class LazyWireMaskVect(MaskVect):
+    """A ``MaskVect`` parsed from wire with limb materialization DEFERRED.
+
+    Carries the raw fixed-width element block (``wire_block``, a zero-copy
+    uint8 view) so a device-ingest coordinator can unpack + validity-check
+    + fold on the accelerator without ever running the host element parse
+    (the second hot loop after the fold). Any host access to ``data``
+    materializes the limbs exactly like the eager parse would have;
+    ``is_valid()`` then applies the same element rule. The eager parse
+    rejects invalid elements with ``DecodeError`` at parse time; the lazy
+    path defers that rejection to ``validate_aggregation`` (device) or the
+    first host materialization — same update rejected, one stage later.
+    """
+
+    def __init__(self, config: MaskConfig, wire_block: np.ndarray, count: int):
+        self.config = config
+        self.wire_block = wire_block  # uint8[count * bytes_per_number]
+        self._count = count
+        self._data: np.ndarray | None = None
+        # device planar cached by StagedAggregator.validate_aggregation so
+        # stage() never re-uploads
+        self._staged_planar = None
+
+    @property
+    def materialized(self) -> bool:
+        return self._data is not None
+
+    @property  # type: ignore[override]
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            self._data = limb_ops.bytes_le_to_limbs(
+                np.asarray(self.wire_block), self._count, self.config.bytes_per_number
+            )
+        return self._data
+
+    @data.setter
+    def data(self, value) -> None:  # dataclass-compat (never used in practice)
+        self._data = value
+
+    def __len__(self) -> int:
+        return self._count
+
+
 @dataclass
 class MaskUnit:
     """A single finite-group element (the masked scalar) with its config."""

@@ -65,8 +65,16 @@ def serialize_mask_vect(vect: MaskVect) -> bytes:
     )
 
 
-def parse_mask_vect(data: bytes, offset: int = 0) -> tuple[MaskVect, int]:
-    """Parse a MaskVect at ``offset``; returns (vect, bytes consumed)."""
+def parse_mask_vect(data: bytes, offset: int = 0, lazy: bool = False) -> tuple[MaskVect, int]:
+    """Parse a MaskVect at ``offset``; returns (vect, bytes consumed).
+
+    ``lazy=True`` (device-ingest coordinators) skips the host limb
+    materialization AND the host element-validity check, returning a
+    ``LazyWireMaskVect`` that carries the raw element block; element
+    validity then happens on device in ``validate_aggregation`` (or on
+    first host materialization), one stage later than the eager parse's
+    ``DecodeError``.
+    """
     if len(data) - offset < MASK_CONFIG_LENGTH + 4:
         raise DecodeError("mask vector buffer too short")
     try:
@@ -79,9 +87,12 @@ def parse_mask_vect(data: bytes, offset: int = 0) -> tuple[MaskVect, int]:
     end = start + count * bpn
     if len(data) < end:
         raise DecodeError("mask vector data truncated")
-    limbs = limb_ops.bytes_le_to_limbs(
-        np.frombuffer(data, dtype=np.uint8, count=count * bpn, offset=start), count, bpn
-    )
+    raw = np.frombuffer(data, dtype=np.uint8, count=count * bpn, offset=start)
+    if lazy:
+        from .object import LazyWireMaskVect
+
+        return LazyWireMaskVect(config, raw, count), end - offset
+    limbs = limb_ops.bytes_le_to_limbs(raw, count, bpn)
     vect = MaskVect(config, limbs)
     if not vect.is_valid():
         raise DecodeError("mask vector element >= group order")
@@ -113,7 +124,7 @@ def parse_mask_unit(data: bytes, offset: int = 0) -> tuple[MaskUnit, int]:
     return unit, MASK_CONFIG_LENGTH + bpn
 
 
-def parse_mask_vect_stream(reader) -> MaskVect:
+def parse_mask_vect_stream(reader, lazy: bool = False) -> MaskVect:
     """Streaming MaskVect parse from a ``ChunkReader``.
 
     The element block is copied chunk-by-chunk into one staging array
@@ -121,6 +132,11 @@ def parse_mask_vect_stream(reader) -> MaskVect:
     memory is ~1x the element block instead of the 2x of a concatenate-
     then-parse (reference streaming parse:
     rust/xaynet-core/src/mask/object/serialization/vect.rs + traits.rs).
+
+    ``lazy=True``: the element bytes are gathered with ONE bounded-memory
+    byte copy (no limb conversion, no host validity — a plain memcpy
+    instead of the parse hot loop) into a ``LazyWireMaskVect`` for the
+    device-ingest coordinator; see ``parse_mask_vect``.
     """
     head = reader.read(MASK_CONFIG_LENGTH + 4)
     try:
@@ -132,6 +148,12 @@ def parse_mask_vect_stream(reader) -> MaskVect:
     nbytes = count * bpn
     if nbytes > reader.remaining:
         raise DecodeError("mask vector data truncated")
+    if lazy:
+        from .object import LazyWireMaskVect
+
+        raw = np.empty(nbytes, dtype=np.uint8)
+        reader.read_into(raw)
+        return LazyWireMaskVect(config, raw, count)
     # segmented convert: fixed-size wire segments go straight into the limb
     # tensor, so the transient staging is bounded (never O(payload))
     n_limb = max(1, (bpn + 3) // 4)
@@ -170,8 +192,10 @@ def serialize_mask_object(obj: MaskObject) -> bytes:
     return serialize_mask_vect(obj.vect) + serialize_mask_unit(obj.unit)
 
 
-def parse_mask_object(data: bytes, offset: int = 0) -> tuple[MaskObject, int]:
-    vect, n1 = parse_mask_vect(data, offset)
+def parse_mask_object(
+    data: bytes, offset: int = 0, lazy_vect: bool = False
+) -> tuple[MaskObject, int]:
+    vect, n1 = parse_mask_vect(data, offset, lazy=lazy_vect)
     unit, n2 = parse_mask_unit(data, offset + n1)
     return MaskObject(vect, unit), n1 + n2
 

@@ -87,8 +87,12 @@ class Message:
         return bytes(buf)
 
     @classmethod
-    def from_bytes(cls, data: bytes, verify: bool = True) -> "Message":
-        """Parse and (by default) verify the signature."""
+    def from_bytes(cls, data: bytes, verify: bool = True, lazy_update_vect: bool = False) -> "Message":
+        """Parse and (by default) verify the signature.
+
+        ``lazy_update_vect``: device-ingest coordinators defer the Update
+        payload's element parse/validity to the accelerator (see
+        ``parse_mask_vect``); all other payloads parse eagerly."""
         if len(data) < HEADER_LENGTH:
             raise DecodeError("message shorter than header")
         signature = data[:SIGNATURE_LENGTH]
@@ -108,7 +112,9 @@ class Message:
             participant_pk, signature, memoryview(data)[SIGNATURE_LENGTH:length]
         ):
             raise DecodeError("invalid message signature")
-        payload = parse_payload(tag, is_multipart, data[HEADER_LENGTH:length])
+        payload = parse_payload(
+            tag, is_multipart, data[HEADER_LENGTH:length], lazy_update_vect=lazy_update_vect
+        )
         return cls(
             participant_pk=participant_pk,
             coordinator_pk=coordinator_pk,

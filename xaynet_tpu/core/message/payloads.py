@@ -145,10 +145,10 @@ class Update:
         )
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Update":
+    def from_bytes(cls, data: bytes, lazy_vect: bool = False) -> "Update":
         if len(data) < 2 * SIGNATURE_LENGTH:
             raise DecodeError("update payload too short")
-        masked, consumed = parse_mask_object(data, 2 * SIGNATURE_LENGTH)
+        masked, consumed = parse_mask_object(data, 2 * SIGNATURE_LENGTH, lazy_vect=lazy_vect)
         seed_dict, _ = parse_local_seed_dict(data, 2 * SIGNATURE_LENGTH + consumed)
         return cls(
             sum_signature=data[:SIGNATURE_LENGTH],
@@ -158,9 +158,9 @@ class Update:
         )
 
     @classmethod
-    def from_stream(cls, reader) -> "Update":
+    def from_stream(cls, reader, lazy_vect: bool = False) -> "Update":
         sigs = reader.read(2 * SIGNATURE_LENGTH)
-        vect = parse_mask_vect_stream(reader)
+        vect = parse_mask_vect_stream(reader, lazy=lazy_vect)
         unit = parse_mask_unit_stream(reader)
         seed_dict = parse_local_seed_dict_stream(reader)
         return cls(
@@ -235,7 +235,9 @@ class Chunk:
 Payload = Union[Sum, Update, Sum2, Chunk]
 
 
-def parse_payload(tag, is_multipart: bool, data: bytes) -> Payload:
+def parse_payload(
+    tag, is_multipart: bool, data: bytes, lazy_update_vect: bool = False
+) -> Payload:
     if is_multipart:
         return Chunk.from_bytes(data, tag=tag)
     from .message import Tag  # local import to avoid cycle
@@ -243,13 +245,13 @@ def parse_payload(tag, is_multipart: bool, data: bytes) -> Payload:
     if tag == Tag.SUM:
         return Sum.from_bytes(data)
     if tag == Tag.UPDATE:
-        return Update.from_bytes(data)
+        return Update.from_bytes(data, lazy_vect=lazy_update_vect)
     if tag == Tag.SUM2:
         return Sum2.from_bytes(data)
     raise DecodeError(f"unknown tag {tag}")
 
 
-def parse_payload_stream(tag, reader) -> Payload:
+def parse_payload_stream(tag, reader, lazy_update_vect: bool = False) -> Payload:
     """Streaming payload parse from a ``ChunkReader`` (multipart reassembly).
 
     Reference analogue: the stream variants of ``FromBytes``
@@ -261,7 +263,7 @@ def parse_payload_stream(tag, reader) -> Payload:
         if tag == Tag.SUM:
             return Sum.from_bytes(reader.read(reader.remaining))
         if tag == Tag.UPDATE:
-            return Update.from_stream(reader)
+            return Update.from_stream(reader, lazy_vect=lazy_update_vect)
         if tag == Tag.SUM2:
             return Sum2.from_stream(reader)
     except ValueError as e:

@@ -139,6 +139,22 @@ class ShardedAggregator:
         self.acc = self._fold(self.acc, stack_planar)
         self.nb_models += stack_planar.shape[0]
 
+    def _stage_raw_bytes(self, raw: np.ndarray):
+        """Shared guard + pad + upload for raw wire element blocks: validate
+        dtype/shape, zero-pad to the padded length (zero bytes decode to
+        zero elements — valid and fold-neutral), and device_put with the
+        element-aligned byte-axis sharding. Used by the batch ingest AND
+        the per-update validate path so the two can never diverge."""
+        bpn = self.config.bytes_per_number
+        raw = np.asarray(raw)
+        if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != self.model_length * bpn:
+            raise ValueError("expected uint8[K, model_len * bytes_per_number]")
+        if raw.shape[0] > MAX_LAZY_BATCH:
+            raise ValueError("batch too large for lazy-carry fold")
+        if self.padded_length != self.model_length:
+            raw = np.pad(raw, ((0, 0), (0, (self.padded_length - self.model_length) * bpn)))
+        return jax.device_put(raw, self._batch_bytes_sharding)
+
     def add_wire_batch(self, raw: np.ndarray) -> np.ndarray:
         """Fold RAW wire element blocks ``uint8[K, model_len * bpn]``.
 
@@ -155,17 +171,25 @@ class ShardedAggregator:
         per-message rejection (the coordinator must reject it before its
         seed-dict insert). Returns the ``bool[K]`` acceptance vector.
         """
-        bpn = self.config.bytes_per_number
+        return self._ingest_staged_bytes(self._stage_raw_bytes(raw))
+
+    def validate_wire_update(self, raw: np.ndarray):
+        """Unpack + validity-check ONE raw wire update on device.
+
+        The coordinator's per-update validation step when wire ingest is on
+        (reference ordering: validate BEFORE the seed-dict insert,
+        update.rs:119-152). Returns the device-resident planar
+        ``[L, padded_len]`` (already validity-masked) for later staging, or
+        ``None`` if any element is >= the group order.
+        """
         raw = np.asarray(raw)
-        if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != self.model_length * bpn:
-            raise ValueError("expected uint8[K, model_len * bytes_per_number]")
-        if raw.shape[0] > MAX_LAZY_BATCH:
-            raise ValueError("batch too large for lazy-carry fold")
-        if self.padded_length != self.model_length:
-            # zero bytes decode to zero elements — valid and fold-neutral
-            raw = np.pad(raw, ((0, 0), (0, (self.padded_length - self.model_length) * bpn)))
-        staged = jax.device_put(raw, self._batch_bytes_sharding)
-        return self._ingest_staged_bytes(staged)
+        if raw.ndim != 1:
+            raise ValueError("expected uint8[model_len * bytes_per_number]")
+        staged = self._stage_raw_bytes(raw[None])
+        planar, ok = self._make_unpack_fn()(staged)
+        if not bool(np.asarray(ok)[0]):
+            return None
+        return planar[0]
 
     def _ingest_staged_bytes(self, staged) -> np.ndarray:
         """Unpack + validity + fold an already device/mesh-resident raw-byte

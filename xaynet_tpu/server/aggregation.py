@@ -89,7 +89,21 @@ class StagedAggregator:
             raise AggregationError("TooManyModels")
         if self.nb_models >= self.config.unit.max_nb_models:
             raise AggregationError("TooManyScalars")
-        if not obj.is_valid():
+        vect = obj.vect
+        if (
+            self._device is not None
+            and getattr(vect, "wire_block", None) is not None
+            and not getattr(vect, "materialized", True)
+        ):
+            # device wire ingest: unpack + element validity run on the
+            # accelerator, and the resulting planar is cached on the object
+            # so stage() never re-uploads. Ordering is preserved — this runs
+            # before the caller's seed-dict insert (update.rs:119-152).
+            planar = self._device.validate_wire_update(np.asarray(vect.wire_block))
+            if planar is None or not obj.unit.is_valid():
+                raise AggregationError("InvalidObject")
+            vect._staged_planar = planar
+        elif not obj.is_valid():
             raise AggregationError("InvalidObject")
 
     @property
@@ -100,17 +114,23 @@ class StagedAggregator:
     def stage(self, obj: MaskObject) -> None:
         """Stage an update without folding (caller controls flush timing)."""
         if self._ingest_pool is not None:
-            from ..ops.fold_jax import wire_to_planar
+            planar_dev = getattr(obj.vect, "_staged_planar", None)
+            if planar_dev is not None:
+                # wire ingest: validate_aggregation already unpacked this
+                # update on device — stage the device-resident planar
+                self._staged_vect.append(planar_dev)
+            else:
+                from ..ops.fold_jax import wire_to_planar
 
-            padded = self._device.padded_length
+                padded = self._device.padded_length
 
-            def to_planar(data=obj.vect.data):
-                planar = wire_to_planar(data)
-                if planar.shape[1] != padded:
-                    planar = np.pad(planar, ((0, 0), (0, padded - planar.shape[1])))
-                return planar
+                def to_planar(data=obj.vect.data):
+                    planar = wire_to_planar(data)
+                    if planar.shape[1] != padded:
+                        planar = np.pad(planar, ((0, 0), (0, padded - planar.shape[1])))
+                    return planar
 
-            self._staged_vect.append(self._ingest_pool.submit(to_planar))
+                self._staged_vect.append(self._ingest_pool.submit(to_planar))
         else:
             self._staged_vect.append(obj.vect.data)
         self._staged_unit.append(obj.unit.data)
@@ -128,11 +148,31 @@ class StagedAggregator:
         units = np.stack(self._staged_unit)
         if self._device is not None:
             import jax
+            import jax.numpy as jnp
 
             from ..ops import limbs as limb_ops
 
-            planar = np.stack([f.result() for f in self._staged_vect])
-            self._device.add_planar_batch(jax.device_put(planar, self._device._batch_sharding))
+            parts = [p.result() if hasattr(p, "result") else p for p in self._staged_vect]
+            self._staged_vect.clear()  # consume destructively: free as we fold
+            if all(isinstance(p, jax.Array) for p in parts):
+                # wire ingest: every planar is already device-resident and
+                # validity-checked. Stack + fold in CHUNKS, dropping each
+                # consumed reference, so peak HBM stays at the staged
+                # planars + one chunk-sized copy instead of + a full second
+                # batch (at 25M/batch 64 that difference is ~13 GB)
+                chunk = 8
+                while parts:
+                    piece, parts = parts[:chunk], parts[chunk:]
+                    staged_batch = jax.device_put(
+                        jnp.stack(piece), self._device._batch_sharding
+                    )
+                    del piece
+                    self._device.add_planar_batch(staged_batch)
+            else:
+                staged_batch = jax.device_put(
+                    np.stack([np.asarray(p) for p in parts]), self._device._batch_sharding
+                )
+                self._device.add_planar_batch(staged_batch)
             order_limbs = limb_ops.order_limbs_for(self.config.unit.order)
             batch_unit = limb_ops.batch_mod_sum(units[:, None, :], order_limbs)[0]
             self._unit_acc = limb_ops.mod_add(

@@ -59,7 +59,14 @@ class UpdatePhase(PhaseState):
         if not isinstance(req, UpdateRequest):
             raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "not an update message")
         try:
-            self.aggregator.validate_aggregation(req.masked_model)
+            # off the event loop: host validation scans the full element
+            # vector, and wire-ingest validation does a device transfer +
+            # kernel + sync — neither may stall the loop serving the API
+            # (ordering is preserved: the await completes before the
+            # seed-dict insert below)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.aggregator.validate_aggregation, req.masked_model
+            )
         except AggregationError as err:
             raise RequestError(RequestError.Kind.MESSAGE_REJECTED, err.kind) from err
         store_err = await self.shared.store.coordinator.add_local_seed_dict(

@@ -103,7 +103,9 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()
 
-    handler = PetMessageHandler(events, request_tx)
+    handler = PetMessageHandler(
+        events, request_tx, wire_ingest=settings.aggregation.wire_ingest
+    )
     fetcher = Fetcher(events)
     rest = RestServer(fetcher, handler)
     host, _, port = settings.api.bind_address.partition(":")

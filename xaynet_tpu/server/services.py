@@ -53,9 +53,14 @@ class PetMessageHandler:
         events: EventSubscriber,
         request_tx: RequestSender,
         max_workers: int = 4,
+        wire_ingest: bool = False,
     ):
         self.events = events
         self.request_tx = request_tx
+        # device-ingest coordinators parse Update masked models LAZILY (raw
+        # element block kept; unpack + validity run on the accelerator in
+        # validate_aggregation, before the seed-dict insert)
+        self.wire_ingest = wire_ingest
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="pet-msg")
         # multipart reassembly buffers keyed by (participant_pk, message_id);
         # bounded: abandoned reassemblies are evicted oldest-first so a
@@ -102,7 +107,7 @@ class PetMessageHandler:
                 raise ServiceError("phase-filter", f"{tag.name} message during {phase.value}")
             # signature verification + full parse
             try:
-                return Message.from_bytes(raw, verify=True)
+                return Message.from_bytes(raw, verify=True, lazy_update_vect=self.wire_ingest)
             except DecodeError as e:
                 raise ServiceError("parse", str(e)) from e
 
@@ -130,7 +135,9 @@ class PetMessageHandler:
         from ..core.message.payloads import parse_payload_stream
 
         try:
-            payload = parse_payload_stream(message.tag, builder.take_reader())
+            payload = parse_payload_stream(
+                message.tag, builder.take_reader(), lazy_update_vect=self.wire_ingest
+            )
         except DecodeError as e:
             raise ServiceError("multipart", str(e)) from e
         return Message(

@@ -147,6 +147,14 @@ class AggregationSettings:
     # fold kernel when device=True: auto (calibrate XLA vs Pallas on the
     # first flush), xla, pallas, or pallas-interpret (CI oracle path)
     kernel: str = "auto"
+    # device wire ingest (requires device=true): Update masked models are
+    # parsed LAZILY (raw element block kept), and unpack + per-update
+    # element validity + fold all run on the accelerator — the coordinator
+    # never executes the host element parse. Rejection semantics: an
+    # invalid element fails validate_aggregation (message rejected before
+    # its seed-dict insert) instead of the eager parse's DecodeError — the
+    # same update rejected, one pipeline stage later.
+    wire_ingest: bool = False
 
 
 @dataclass
@@ -172,6 +180,8 @@ class Settings:
             raise SettingsError(
                 "aggregation.kernel must be one of: " + " | ".join(FOLD_KERNELS)
             )
+        if self.aggregation.wire_ingest and not self.aggregation.device:
+            raise SettingsError("aggregation.wire_ingest requires aggregation.device = true")
 
     @classmethod
     def default(cls) -> "Settings":
@@ -291,6 +301,7 @@ class Settings:
                 device=bool(agg_raw.get("device", False)),
                 batch_size=int(agg_raw.get("batch_size", base.aggregation.batch_size)),
                 kernel=str(agg_raw.get("kernel", base.aggregation.kernel)),
+                wire_ingest=bool(agg_raw.get("wire_ingest", base.aggregation.wire_ingest)),
             ),
         )
 
