@@ -70,6 +70,7 @@ length = {model_len}
 device = {agg_device}
 batch_size = {agg_batch}
 kernel = "{agg_kernel}"
+wire_ingest = {agg_wire_ingest}
 
 [storage]
 backend = "filesystem"
@@ -145,7 +146,16 @@ def main() -> None:
         choices=["auto", "xla", "pallas-interpret"],
         help="run the coordinator with device aggregation on the virtual mesh using this fold kernel",
     )
+    ap.add_argument(
+        "--wire-ingest",
+        action="store_true",
+        help="with --device-kernel: lazy Update parse + device unpack/validity "
+        "(aggregation.wire_ingest=true) — leak-checks the production "
+        "device-ingest mode over many rounds",
+    )
     args = ap.parse_args()
+    if args.wire_ingest and not args.device_kernel:
+        ap.error("--wire-ingest requires --device-kernel")
 
     with tempfile.TemporaryDirectory() as tmp:
         cfg_path = os.path.join(tmp, "config.toml")
@@ -156,6 +166,7 @@ def main() -> None:
                     model_len=args.model_len,
                     model_dir=os.path.join(tmp, "models"),
                     agg_device="true" if args.device_kernel else "false",
+                    agg_wire_ingest="true" if args.wire_ingest else "false",
                     # keep the host-path default (64) so plain-soak numbers
                     # stay comparable across rounds; small batches only for
                     # the device path so every round actually flushes

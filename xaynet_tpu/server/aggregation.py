@@ -21,7 +21,7 @@ import numpy as np
 
 from ..core.mask.config import MaskConfigPair
 from ..core.mask.masking import Aggregation, AggregationError
-from ..core.mask.object import MaskObject, MaskUnit, MaskVect
+from ..core.mask.object import LazyWireMaskVect, MaskObject, MaskUnit, MaskVect
 
 
 class StagedAggregator:
@@ -92,8 +92,8 @@ class StagedAggregator:
         vect = obj.vect
         if (
             self._device is not None
-            and getattr(vect, "wire_block", None) is not None
-            and not getattr(vect, "materialized", True)
+            and isinstance(vect, LazyWireMaskVect)
+            and not vect.materialized
         ):
             # device wire ingest: unpack + element validity run on the
             # accelerator, and the resulting planar is cached on the object
@@ -114,7 +114,9 @@ class StagedAggregator:
     def stage(self, obj: MaskObject) -> None:
         """Stage an update without folding (caller controls flush timing)."""
         if self._ingest_pool is not None:
-            planar_dev = getattr(obj.vect, "_staged_planar", None)
+            planar_dev = (
+                obj.vect._staged_planar if isinstance(obj.vect, LazyWireMaskVect) else None
+            )
             if planar_dev is not None:
                 # wire ingest: validate_aggregation already unpacked this
                 # update on device — stage the device-resident planar
