@@ -283,7 +283,7 @@ def test_256mb_multipart_streaming_reassembly_bounded_rss():
     assert peak - current < wire, (peak, current, wire)
 
 
-def _protocol_scale_round(n_sum, n_update, mlen, model_for, timeout=600):
+def _protocol_scale_round(n_sum, n_update, mlen, model_for, timeout=600, wire_ingest=False):
     """ONE round with ``n_update`` update + ``n_sum`` sum participants through
     the real coordinator pipeline (state machine + services + in-process
     transport), asserting the seed-dict fan-out (#sum x #update entries),
@@ -358,9 +358,14 @@ def _protocol_scale_round(n_sum, n_update, mlen, model_for, timeout=600):
             )
         )
         st.model.length = MLEN
+        if wire_ingest:
+            st.aggregation.device = True
+            st.aggregation.wire_ingest = True
+            st.aggregation.kernel = "xla"
+            st.aggregation.batch_size = 16
         store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
         machine, tx, events = await StateMachineInitializer(st, store).init()
-        handler = PetMessageHandler(events, tx)
+        handler = PetMessageHandler(events, tx, wire_ingest=wire_ingest)
         fetcher = Fetcher(events)
         cap = _Capture()
         coord_logger = logging.getLogger("xaynet.coordinator")
@@ -499,3 +504,19 @@ def test_100_update_participants_1m_params_one_round():
         timeout=1200,
     )
     assert wall < 900, f"100x1M round took {wall:.0f}s"
+
+
+def test_100_update_participants_1m_params_wire_ingest_round():
+    """The SAME coupled-scale round through the coordinator-integrated
+    device wire ingest (lazy multipart parse -> per-update device validity
+    before seed insert -> device-resident flush on the 8-device mesh):
+    sustained production-path evidence at protocol x data scale."""
+    wall = _protocol_scale_round(
+        n_sum=3,
+        n_update=100,
+        mlen=1_000_000,
+        model_for=lambda i, rng: rng.uniform(-1, 1, size=1_000_000).astype(np.float32),
+        timeout=1200,
+        wire_ingest=True,
+    )
+    assert wall < 900, f"100x1M wire-ingest round took {wall:.0f}s"
