@@ -99,10 +99,10 @@ def _masked_model(seed: int, n: int = 6) -> MaskObject:
     return MaskObject.new(CFG.pair(), ints[1:], ints[0])
 
 
-async def _drive_to_update(settings, store, metrics, n_summers=2):
+async def _drive_to_update(settings, store, metrics, n_summers=2, wire_ingest=False):
     """Start a coordinator, fill the sum phase, land in update phase."""
     machine, tx, events = await StateMachineInitializer(settings, store, metrics).init()
-    handler = PetMessageHandler(events, tx)
+    handler = PetMessageHandler(events, tx, wire_ingest=wire_ingest)
     machine_task = asyncio.create_task(machine.run())
     await _until_phase(events, "sum")
     params = events.params.get_latest().event
@@ -136,12 +136,12 @@ def _updater(params, start=0):
     return keys_for_task(seed, params.sum, params.update, "update", start=start)
 
 
-def _update_payload(params, keys, seed_dict):
+def _update_payload(params, keys, seed_dict, masked_model=None):
     seed = params.seed.as_bytes()
     return Update(
         sum_signature=keys.sign(seed + b"sum").as_bytes(),
         update_signature=keys.sign(seed + b"update").as_bytes(),
-        masked_model=_masked_model(3),
+        masked_model=masked_model if masked_model is not None else _masked_model(3),
         local_seed_dict=seed_dict,
     )
 
@@ -188,6 +188,70 @@ def test_seed_dict_targeting_subset_rejected():
             await _stop(machine_task)
 
     asyncio.run(asyncio.wait_for(run(), 30))
+
+
+@pytest.mark.parametrize("wire_ingest", [True, False])
+def test_invalid_element_update_rejected(wire_ingest):
+    """A masked model with an element >= the group order. Under the
+    device-ingest pipeline (aggregation.wire_ingest) the lazy parse
+    accepts the bytes, but the DEVICE validity check rejects the message
+    at validate_aggregation — BEFORE its seed-dict insert — and the
+    attacker's seeds never reach any sum participant. Eager mode drops the
+    same message one stage earlier (parse DecodeError -> pipeline drop);
+    both end with the update not counted."""
+    import numpy as np
+
+    from xaynet_tpu.core.mask.object import MaskVect
+    from xaynet_tpu.server.services import ServiceError
+
+    def _poisoned_model():
+        obj = _masked_model(3)
+        bad = obj.vect.data.copy()
+        bad[2, :] = np.uint32(0xFFFFFFFF)  # element >= every M3 order
+        return MaskObject(MaskVect(CFG, bad), obj.unit)
+
+    async def run(wire_ingest):
+        settings = _settings()
+        settings.pet.sum.count = CountSettings(2, 2)
+        settings.pet.update.count = CountSettings(3, 3)
+        if wire_ingest:
+            settings.aggregation.device = True
+            settings.aggregation.wire_ingest = True
+            settings.aggregation.kernel = "xla"
+        metrics = _CountingMetrics()
+        store = _store()
+        machine, machine_task, handler, events, params, summers = await _drive_to_update(
+            settings, store, metrics, wire_ingest=wire_ingest
+        )
+        try:
+            attacker = _updater(params)
+            full = {s.public: b"\x07" * 80 for s in summers}
+            poisoned = _update_payload(params, attacker, full, masked_model=_poisoned_model())
+            if wire_ingest:
+                with pytest.raises(RequestError) as e:
+                    await handler.handle_message(_encrypt_for(params, poisoned, attacker))
+                assert e.value.kind is RequestError.Kind.MESSAGE_REJECTED
+                assert metrics.counts.get(("rejected", "update")) == 1
+            else:
+                # eager parse: the same bytes die at the parse stage
+                with pytest.raises(ServiceError):
+                    await handler.handle_message(_encrypt_for(params, poisoned, attacker))
+            # the attacker's seeds were never inserted
+            sd = await store.coordinator.seed_dict()
+            assert not any(attacker.public in inner for inner in (sd or {}).values())
+            # an honest update through the same pipeline still lands
+            honest = _updater(params, start=500_000)
+            await handler.handle_message(
+                _encrypt_for(params, _update_payload(params, honest, full), honest)
+            )
+            assert metrics.counts.get(("accepted", "update")) == 1
+            sd = await store.coordinator.seed_dict()
+            assert all(honest.public in inner for inner in sd.values())
+            assert not any(attacker.public in inner for inner in sd.values())
+        finally:
+            await _stop(machine_task)
+
+    asyncio.run(asyncio.wait_for(run(wire_ingest), 60))
 
 
 def test_multipart_buffer_exhaustion_evicts_oldest():
