@@ -68,22 +68,23 @@ def main() -> None:
 
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
     from xaynet_tpu.core.mask.encode import decode_vect_fast
-    from xaynet_tpu.core.mask.object import MaskVect
-    from xaynet_tpu.core.mask.serialization import (
-        parse_mask_vect,
-        serialize_mask_vect,
-        vect_element_block,
-    )
+    from xaynet_tpu.core.mask.object import MaskObject, MaskUnit, MaskVect
+    from xaynet_tpu.core.mask.serialization import parse_mask_vect, serialize_mask_vect
     from xaynet_tpu.ops import limbs as host_limbs
-    from xaynet_tpu.parallel.aggregator import ShardedAggregator
     from xaynet_tpu.storage.memory import InMemoryCoordinatorStorage
 
     platform = jax.devices()[0].platform
-    on_tpu = platform != "cpu"
-    model_len = args.model_len or (25_000_000 if on_tpu else 1_000_000)
-    n_updates = args.updates or (10_000 if on_tpu else 96)
+    # XAYNET_BENCH_FORCE_DEVICE_PATH=1 drives the accelerator CODE PATH on
+    # the virtual CPU mesh — the smoke that keeps the rare-TPU-window branch
+    # continuously tested. It must not also flip the workload defaults to
+    # TPU scale (that would make the "smoke" a multi-hour 25M run).
+    real_tpu = platform != "cpu"
+    device_forced = bool(os.environ.get("XAYNET_BENCH_FORCE_DEVICE_PATH"))
+    on_tpu = real_tpu or device_forced
+    model_len = args.model_len or (25_000_000 if real_tpu else 1_000_000)
+    n_updates = args.updates or (10_000 if real_tpu else 96)
     k_batch = args.batch
-    k_sum2 = args.sum2_seeds or (1_000 if on_tpu else 8)
+    k_sum2 = args.sum2_seeds or (1_000 if real_tpu else 8)
 
     config = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
     order = config.order
@@ -104,7 +105,37 @@ def main() -> None:
     del batch_limbs
 
     if on_tpu:
-        agg = ShardedAggregator(config, model_len)
+        # the PRODUCTION integrated wire-ingest path (aggregation.wire_ingest):
+        # per-update device validation (one <=~175 MB transfer each — never a
+        # multi-GB batch put, the round-3 tunnel killer) + chunked device
+        # flush, via the same StagedAggregator the coordinator runs
+        from xaynet_tpu.server.aggregation import StagedAggregator
+
+        staged = StagedAggregator(
+            config.pair(), model_len, device=True, batch_size=k_batch, kernel="auto"
+        )
+        agg_validate = staged.validate_aggregation
+        agg_stage = staged.aggregate
+        zero_unit_obj = MaskUnit.from_int(config, 0)
+
+        class _WireAggregator:
+            """Adapter keeping this script's acc/nb_models/unmask surface."""
+
+            @property
+            def acc(self):
+                return staged._device.acc
+
+            @property
+            def nb_models(self):
+                return staged.nb_models
+
+            def unmask_limbs(self, mask_vect):
+                return staged._device.unmask_limbs(mask_vect)
+
+            def flush(self):
+                staged.flush()
+
+        agg = _WireAggregator()
     else:
         # CPU smoke measures the path a CPU-only coordinator actually runs
         # ([aggregation] device=false default: Aggregation.aggregate_batch
@@ -172,18 +203,19 @@ def main() -> None:
     seed_entry = {pk: b"\x07" * 80 for pk in sum_pks}
     for b in range(n_batches):
         if on_tpu:
-            # device ingest: the coordinator ships the RAW wire element
-            # blocks (smaller than the limb tensors) and the device does
-            # unpack + element validity + fold — the host parse leg
-            # reduces to header checks (zero-copy views)
+            # device ingest, the integrated coordinator path: the LAZY parse
+            # keeps the raw element block (header checks + zero-copy view),
+            # then per-update device unpack + validity runs in the validate
+            # leg — exactly [aggregation] wire_ingest = true
             t0 = time.perf_counter()
-            raw_blocks = [vect_element_block(w) for w in wire_msgs]
+            lazy_objs = [
+                MaskObject(parse_mask_vect(w, lazy=True)[0], zero_unit_obj) for w in wire_msgs
+            ]
             t_parse += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            for w in wire_msgs:
-                assert MaskConfig.from_bytes(w[:4]) == config
-                assert int.from_bytes(w[4:8], "big") == model_len
+            for obj in lazy_objs:
+                agg_validate(obj)  # device transfer + unpack + validity
             t_validate += time.perf_counter() - t0
             parsed = None
         else:
@@ -208,17 +240,16 @@ def main() -> None:
                 assert err is None, err
 
         if on_tpu:
-            # device ingest resolves element validity, so the reference's
+            # validate (device) already ran above, preserving the reference's
             # validate -> seed-dict -> aggregate ordering (update.rs:119-152)
-            # becomes unpack+validate+fold on device, THEN seed inserts for
-            # the accepted updates only
             t0 = time.perf_counter()
-            ok = agg.add_wire_batch(np.stack(raw_blocks))
-            t_stage += time.perf_counter() - t0
+            asyncio.run(_inserts(b * k_batch, [True] * k_batch))
+            t_seed += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            asyncio.run(_inserts(b * k_batch, ok))
-            t_seed += time.perf_counter() - t0
+            for obj in lazy_objs:
+                agg_stage(obj)  # stages the cached device planar; flushes per batch
+            t_stage += time.perf_counter() - t0
         else:
             # 3. seed-dict conditional insert per update
             t0 = time.perf_counter()
@@ -239,6 +270,8 @@ def main() -> None:
         if b % 50 == 0 or b == n_batches - 1:
             rss_peak = max(rss_peak, _rss_mb())
 
+    if on_tpu:
+        agg.flush()  # remainder batch through the same chunked device path
     jax.block_until_ready(agg.acc)
     t_update_phase = time.perf_counter() - t_total0
     rss_end = _rss_mb()
@@ -331,6 +364,9 @@ def main() -> None:
         "value": round(ups, 2),
         "unit": "updates/s",
         "platform": platform,
+        # a forced smoke measured the DEVICE branch on cpu — never mix it
+        # with genuine cpu-coordinator baselines in history comparisons
+        **({"device_path_forced": True} if device_forced else {}),
         "model_len": model_len,
         "updates": n_batches * k_batch,
         "breakdown_s": {name: round(t, 3) for name, t in rows},
