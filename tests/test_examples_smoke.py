@@ -43,6 +43,32 @@ def test_cifar_lenet_example_smoke():
     assert "eval loss" in r.stdout
 
 
+def test_bench_round_device_path_smoke():
+    """The rare-TPU-window bench branch (production wire-ingest flow through
+    StagedAggregator) stays continuously tested: XAYNET_BENCH_FORCE_DEVICE_PATH
+    drives it on the virtual CPU mesh at smoke scale."""
+    import json
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        XAYNET_BENCH_FORCE_DEVICE_PATH="1",
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "tools/bench_round.py",
+            "--cpu", "--updates", "32", "--model-len", "50000", "--sum2-seeds", "4",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=280,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    tail = json.loads(r.stdout.strip().splitlines()[-1])
+    assert tail["device_path_forced"] is True
+    assert tail["updates"] == 32
+    assert tail["breakdown_s"]["stage + fold (device)"] >= 0
+
+
 def test_lora_federated_example_smoke():
     """Baseline config #5 (stretch): int-masked LoRA adapter federation with
     the loss-improvement gate (VERDICT r04 item 8)."""
