@@ -15,8 +15,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(args: list[str], timeout: int = 280) -> subprocess.CompletedProcess:
+def _run_example(
+    args: list[str], timeout: int = 280, extra_env: dict | None = None
+) -> subprocess.CompletedProcess:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if extra_env:
+        env.update(extra_env)
     return subprocess.run(
         [sys.executable, *args],
         cwd=REPO,
@@ -49,18 +53,15 @@ def test_bench_round_device_path_smoke():
     drives it on the virtual CPU mesh at smoke scale."""
     import json
 
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=8",
-        XAYNET_BENCH_FORCE_DEVICE_PATH="1",
-    )
-    r = subprocess.run(
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    r = _run_example(
         [
-            sys.executable, "tools/bench_round.py",
+            "tools/bench_round.py",
             "--cpu", "--updates", "32", "--model-len", "50000", "--sum2-seeds", "4",
         ],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=280,
+        extra_env={"XLA_FLAGS": flags, "XAYNET_BENCH_FORCE_DEVICE_PATH": "1"},
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
     tail = json.loads(r.stdout.strip().splitlines()[-1])
