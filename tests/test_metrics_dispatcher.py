@@ -179,6 +179,67 @@ def test_dispatcher_against_live_influxdb():
         query(f'DROP DATABASE "{db}"', use_db=False)
 
 
+def test_registry_bridge_emits_reference_measurements():
+    """The registry bridge forwards the same eight reference measurements to
+    the Influx sink byte-for-byte, while the registry records them too."""
+    from xaynet_tpu.telemetry.bridge import BridgedMetrics
+    from xaynet_tpu.telemetry.registry import MetricsRegistry
+
+    srv = _FakeInflux()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        reg = MetricsRegistry()
+        sink = InfluxHttpMetrics(
+            f"http://127.0.0.1:{srv.server_address[1]}", "metrics", flush_interval=0.05
+        )
+        m = BridgedMetrics(sink=sink, registry=reg)
+        m.phase(3, "sum")
+        m.round_total(3)
+        m.message_accepted(3, "sum")
+        m.message_rejected(3, "sum")
+        m.message_discarded(3, "sum")
+        m.masks_total(3, 7)
+        m.phase_duration(3, "sum", 1.25)
+        m.event(3, "phase_error", "boom")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with srv.lock:
+                if len(srv.lines) >= 8:
+                    break
+            time.sleep(0.05)
+        m.close()
+        with srv.lock:
+            lines = list(srv.lines)
+        measurements = {ln.split(",")[0] for ln in lines}
+        assert measurements == {
+            "xaynet_phase",
+            "xaynet_round_total_number",
+            "xaynet_message_accepted",
+            "xaynet_message_rejected",
+            "xaynet_message_discarded",
+            "xaynet_masks_total_number",
+            "xaynet_phase_duration_seconds",
+            "xaynet_event_phase_error",
+        }
+        assert any(ln.startswith("xaynet_phase,round_id=3,phase=sum ") for ln in lines)
+        # ... and the registry holds the same facts
+        assert reg.sample_value("xaynet_round_id") == 3
+        assert reg.sample_value("xaynet_masks_total") == 7
+        for outcome in ("accepted", "rejected", "discarded"):
+            assert (
+                reg.sample_value(
+                    "xaynet_messages_total", {"phase": "sum", "outcome": outcome}
+                )
+                == 1
+            )
+        hist = reg.get("xaynet_phase_duration_seconds").labels(phase="sum")
+        assert hist.count == 1 and abs(hist.sum - 1.25) < 1e-9
+        assert reg.sample_value("xaynet_events_total", {"kind": "phase_error"}) == 1
+    finally:
+        srv.shutdown()
+
+
 def test_dispatcher_close_flushes_tail():
     srv = _FakeInflux()
     t = threading.Thread(target=srv.serve_forever, daemon=True)

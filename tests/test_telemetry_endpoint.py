@@ -1,0 +1,220 @@
+"""Telemetry endpoint smoke test: run a full PET round over the real REST
+API, scrape ``GET /metrics`` and ``GET /healthz`` mid-round and after, and
+assert the exposition is well-formed with phase histograms and aggregation
+kernel stats; the per-round JSON report must be written and parseable."""
+
+import asyncio
+import json
+import re
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+# the PET message pipeline needs the sealed-box primitives; environments
+# without the cryptography package skip the end-to-end smoke (the registry,
+# bridge and profiling layers have crypto-free coverage elsewhere)
+pytest.importorskip("cryptography")
+
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+from xaynet_tpu.telemetry import BridgedMetrics, RoundReporter
+
+N_SUM, N_UPDATE, MODEL_LEN = 1, 3, 7
+SUM_PROB, UPDATE_PROB = 0.4, 0.5
+
+
+class ArrayModelStore(ModelStore):
+    def __init__(self, model):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+async def _http_get(host: str, port: int, path: str) -> tuple[int, dict, bytes]:
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except Exception:
+        pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    head_lines = head.decode().split("\r\n")
+    status = int(head_lines[0].split()[1])
+    headers = {}
+    for line in head_lines[1:]:
+        name, _, value = line.partition(":")
+        headers[name.strip().lower()] = value.strip()
+    return status, headers, body
+
+
+def _assert_exposition_well_formed(text: str) -> None:
+    assert text.endswith("\n")
+    sample_re = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$')
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert sample_re.match(line), f"malformed sample line: {line!r}"
+
+
+async def _run(report_path: str) -> None:
+    settings = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(
+                prob=SUM_PROB, count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 20)
+            ),
+            update=PhaseSettings(
+                prob=UPDATE_PROB,
+                count=CountSettings(N_UPDATE, N_UPDATE),
+                time=TimeSettings(0, 20),
+            ),
+            sum2=Sum2Settings(count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, 20)),
+        )
+    )
+    settings.model.length = MODEL_LEN
+    store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+    metrics = BridgedMetrics(reporter=RoundReporter(report_path))
+    machine, request_tx, events = await StateMachineInitializer(
+        settings, store, metrics
+    ).init()
+    handler = PetMessageHandler(events, request_tx)
+    fetcher = Fetcher(events)
+    rest = RestServer(fetcher, handler, registry=metrics.registry)
+    host, port = await rest.start("127.0.0.1", 0)
+    machine_task = asyncio.create_task(machine.run())
+
+    try:
+        url = f"http://{host}:{port}"
+        probe = HttpClient(url)
+        while fetcher.phase().value != "sum":
+            await asyncio.sleep(0.01)
+
+        # --- mid-round scrape --------------------------------------------
+        status, headers, body = await _http_get(host, port, "/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["phase"] == "sum"
+        assert health["round_id"] >= 1
+        assert health["uptime_seconds"] >= 0
+
+        status, headers, body = await _http_get(host, port, "/metrics")
+        assert status == 200
+        assert headers["content-type"].startswith("text/plain")
+        mid = body.decode()
+        _assert_exposition_well_formed(mid)
+        assert '# TYPE xaynet_phase_transitions_total counter' in mid
+        assert 'xaynet_phase_transitions_total{phase="sum"}' in mid
+        assert "# TYPE xaynet_request_queue_depth gauge" in mid
+
+        # --- drive one full round ----------------------------------------
+        params = await probe.get_round_params()
+        seed = params.seed.as_bytes()
+        rng = np.random.default_rng(5)
+        participants = []
+        for i in range(N_SUM):
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+            participants.append(
+                ParticipantSM(PetSettings(keys=keys), HttpClient(url), ArrayModelStore(None))
+            )
+        for i in range(N_UPDATE):
+            keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(20 + i) * 1000)
+            local = rng.uniform(-1, 1, MODEL_LEN).astype(np.float32)
+            participants.append(
+                ParticipantSM(
+                    PetSettings(keys=keys, scalar=Fraction(1, N_UPDATE)),
+                    HttpClient(url),
+                    ArrayModelStore(local),
+                )
+            )
+
+        async def drive(sm):
+            for _ in range(500):
+                try:
+                    await sm.transition()
+                except Exception:
+                    pass
+                model = await probe.get_model()
+                if model is not None and sm.phase.value == "awaiting":
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.gather(*(drive(p) for p in participants))
+        assert await probe.get_model() is not None
+
+        # round 2's Idle flushes round 1's report
+        deadline = asyncio.get_running_loop().time() + 20
+        while events.params.get_latest().round_id < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+
+        # --- post-round scrape -------------------------------------------
+        status, _, body = await _http_get(host, port, "/metrics")
+        assert status == 200
+        text = body.decode()
+        _assert_exposition_well_formed(text)
+        # per-phase duration histograms for the full round
+        assert "# TYPE xaynet_phase_duration_seconds histogram" in text
+        for phase in ("sum", "update", "sum2", "unmask"):
+            assert f'xaynet_phase_duration_seconds_bucket{{phase="{phase}",le=' in text
+        # message outcome counters
+        assert 'xaynet_messages_total{phase="update",outcome="accepted"}' in text
+        # aggregation kernel timings with derived throughput
+        assert 'xaynet_kernel_seconds_bucket{op="masked_add",le=' in text
+        assert 'xaynet_kernel_seconds_bucket{op="unmask",le=' in text
+        assert 'xaynet_kernel_elements_per_second{op="masked_add"}' in text
+        assert 'xaynet_kernel_elements_per_second{op="unmask"}' in text
+        # HTTP surface instruments itself too
+        assert 'xaynet_http_requests_total{method="GET",path="/metrics",status="200"}' in text
+    finally:
+        machine_task.cancel()
+        await rest.stop()
+        try:
+            await machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        metrics.close()
+
+
+def test_telemetry_endpoints_and_round_report(tmp_path):
+    report_path = str(tmp_path / "round_reports.jsonl")
+    asyncio.run(asyncio.wait_for(_run(report_path), timeout=60))
+
+    with open(report_path) as f:
+        reports = [json.loads(line) for line in f if line.strip()]
+    assert reports, "no round report written"
+    first = reports[0]
+    assert first["round_id"] == 1
+    assert "unmask" in first["phases"]
+    for phase in ("sum", "update", "sum2", "unmask"):
+        assert first["phase_durations"][phase] >= 0
+    assert first["messages"]["update"]["accepted"] == N_UPDATE
+    assert first["masks_total"] == 1
+    kernels = first["kernels"]
+    assert "masked_add" in kernels and "unmask" in kernels
+    assert kernels["masked_add"]["calls"] >= 1
+    assert kernels["masked_add"]["elements"] >= N_UPDATE * MODEL_LEN
+    assert kernels["masked_add"]["elements_per_sec"] >= 0

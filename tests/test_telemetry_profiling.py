@@ -1,0 +1,111 @@
+"""Kernel profiling hooks: device-synced timing records, the round-stat
+window, the enable toggle, and the reporter integration (crypto-free)."""
+
+import json
+
+import jax.numpy as jnp
+
+from xaynet_tpu.telemetry import BridgedMetrics, RoundReporter, get_registry
+from xaynet_tpu.telemetry import profiling
+
+
+def _kernel_calls(op: str) -> float:
+    return get_registry().sample_value("xaynet_kernel_calls_total", {"op": op}) or 0
+
+
+def _kernel_elements(op: str) -> float:
+    return get_registry().sample_value("xaynet_kernel_elements_total", {"op": op}) or 0
+
+
+def test_timed_kernel_records_and_syncs_device_work():
+    profiling.drain_round_stats()  # fresh window
+    calls0 = _kernel_calls("t_fold")
+    elements0 = _kernel_elements("t_fold")
+
+    out = profiling.timed_kernel("t_fold", 1024, lambda: jnp.arange(1024) * 2)
+    assert int(out[3]) == 6  # result passes through, already synced
+
+    assert _kernel_calls("t_fold") == calls0 + 1
+    assert _kernel_elements("t_fold") == elements0 + 1024
+    rate = get_registry().sample_value(
+        "xaynet_kernel_elements_per_second", {"op": "t_fold"}
+    )
+    assert rate is not None and rate > 0
+    hist = get_registry().get("xaynet_kernel_seconds").labels(op="t_fold")
+    assert hist.count >= 1
+
+    stats = profiling.drain_round_stats()
+    assert stats["t_fold"]["calls"] == 1
+    assert stats["t_fold"]["elements"] == 1024
+    assert stats["t_fold"]["seconds"] > 0
+    assert stats["t_fold"]["elements_per_sec"] > 0
+    # the window resets on drain
+    assert "t_fold" not in profiling.drain_round_stats()
+
+
+def test_profiling_disable_is_pass_through(monkeypatch):
+    monkeypatch.setenv("XAYNET_KERNEL_PROFILE", "0")
+    assert not profiling.enabled()
+    calls0 = _kernel_calls("t_off")
+    result = profiling.timed_kernel("t_off", 10, lambda: "unchanged")
+    assert result == "unchanged"
+    assert _kernel_calls("t_off") == calls0  # nothing recorded
+    monkeypatch.setenv("XAYNET_KERNEL_PROFILE", "1")
+    assert profiling.enabled()
+
+
+def test_measure_and_calibration_gauge():
+    out, seconds = profiling.measure(lambda: jnp.ones(16).sum())
+    assert float(out) == 16.0
+    assert seconds >= 0
+    profiling.record_calibration("xla", 0.025)
+    assert (
+        get_registry().sample_value("xaynet_kernel_calibration_seconds", {"kernel": "xla"})
+        == 0.025
+    )
+    assert 'xaynet_kernel_calibration_seconds{kernel="xla"} 0.025' in get_registry().render()
+
+
+def test_first_call_gauge_marks_compile_outlier():
+    calls_before = _kernel_calls("t_cold")
+    assert calls_before == 0  # op name unique to this test
+    profiling.record("t_cold", 2.5, 10)  # first call: slow (compile-like)
+    profiling.record("t_cold", 0.1, 10)
+    assert (
+        get_registry().sample_value("xaynet_kernel_first_call_seconds", {"op": "t_cold"})
+        == 2.5
+    )
+    assert _kernel_calls("t_cold") == 2  # both still count in the main series
+
+
+def test_bad_report_path_never_raises(tmp_path):
+    reporter = RoundReporter(str(tmp_path / "no_such_dir" / "rounds.jsonl"))
+    m = BridgedMetrics(reporter=reporter)
+    m.round_total(1)
+    m.phase_duration(1, "sum", 0.1)
+    m.close()  # flush must swallow the OSError, not take the caller down
+    assert reporter.last_report["round_id"] == 1
+
+
+def test_round_report_includes_kernel_stats(tmp_path):
+    profiling.drain_round_stats()  # isolate from other tests' windows
+    path = str(tmp_path / "rounds.jsonl")
+    m = BridgedMetrics(reporter=RoundReporter(path))
+    m.round_total(7)
+    m.phase(7, "update")
+    profiling.record("masked_add", 0.5, 1_000_000)
+    m.phase_duration(7, "update", 1.5)
+    m.message_accepted(7, "update")
+    m.close()  # flushes the in-flight round
+
+    with open(path) as f:
+        reports = [json.loads(line) for line in f if line.strip()]
+    assert len(reports) == 1
+    report = reports[0]
+    assert report["round_id"] == 7
+    assert report["phases"] == ["update"]
+    assert report["phase_durations"]["update"] == 1.5
+    assert report["messages"]["update"]["accepted"] == 1
+    assert report["kernels"]["masked_add"]["calls"] == 1
+    assert report["kernels"]["masked_add"]["elements"] == 1_000_000
+    assert report["kernels"]["masked_add"]["elements_per_sec"] == 2_000_000

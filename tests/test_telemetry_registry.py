@@ -1,0 +1,147 @@
+"""Telemetry registry semantics: counters/gauges/histograms, label escaping
+in the exposition output, and concurrent-increment thread safety."""
+
+import math
+import re
+import threading
+
+import pytest
+
+from xaynet_tpu.telemetry.registry import DEFAULT_BUCKETS, MetricError, MetricsRegistry
+
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(MetricError):
+        c.inc(-1)
+    # labeled children are independent
+    by_kind = reg.counter("by_kind_total", "k", ("kind",))
+    by_kind.labels(kind="a").inc()
+    by_kind.labels(kind="b").inc(2)
+    assert by_kind.labels(kind="a").value == 1
+    assert by_kind.labels(kind="b").value == 2
+    assert reg.sample_value("by_kind_total", {"kind": "b"}) == 2
+    # unlabeled access on a labeled family is an error
+    with pytest.raises(MetricError):
+        by_kind.inc()
+    # wrong label set is an error
+    with pytest.raises(MetricError):
+        by_kind.labels(nope="x")
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5
+    g.set(-2.5)
+    assert g.value == -2.5
+
+
+def test_histogram_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert abs(h.sum - 56.05) < 1e-9
+    cumulative = h.bucket_counts()
+    assert cumulative[0.1] == 1
+    assert cumulative[1.0] == 3
+    assert cumulative[10.0] == 4
+    assert cumulative[math.inf] == 5
+    # timer context manager records one observation
+    with h.time():
+        pass
+    assert h.count == 6
+
+
+def test_histogram_exposition_is_cumulative_with_inf():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "h", ("op",), buckets=(1.0,))
+    h.labels(op="fold").observe(0.5)
+    h.labels(op="fold").observe(2.0)
+    text = reg.render()
+    assert 'h_seconds_bucket{op="fold",le="1"} 1' in text
+    assert 'h_seconds_bucket{op="fold",le="+Inf"} 2' in text
+    assert 'h_seconds_sum{op="fold"} 2.5' in text
+    assert 'h_seconds_count{op="fold"} 2' in text
+
+
+def test_label_escaping_in_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("events_total", "events", ("detail",))
+    c.labels(detail='quote " backslash \\ newline \n end').inc()
+    text = reg.render()
+    assert '{detail="quote \\" backslash \\\\ newline \\n end"}' in text
+    # no raw newline may survive inside a sample line
+    for line in text.splitlines():
+        assert "\n" not in line
+
+
+def test_exposition_well_formed():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "with some help").inc()
+    reg.gauge("b", "gauge help", ("x",)).labels(x="1").set(2)
+    reg.histogram("c_seconds", "hist").observe(0.2)
+    text = reg.render()
+    assert text.endswith("\n")
+    sample_re = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+$')
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert sample_re.match(line), line
+    # HELP/TYPE precede each family's samples
+    assert text.index("# HELP a_total") < text.index("a_total 1")
+
+
+def test_family_idempotent_and_type_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("same_total", "h", ("k",))
+    b = reg.counter("same_total", "other help", ("k",))
+    assert a is b
+    a.labels(k="x").inc()
+    assert b.labels(k="x").value == 1
+    with pytest.raises(MetricError):
+        reg.gauge("same_total")
+    with pytest.raises(MetricError):
+        reg.counter("same_total", "h", ("different",))
+    # histograms: same name with different buckets is a conflict, not a
+    # silent wrong-buckets reuse
+    reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+    assert reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0)) is not None
+    with pytest.raises(MetricError):
+        reg.histogram("lat_seconds", "h", buckets=(0.5,))
+
+
+def test_default_buckets_cover_phase_windows():
+    assert DEFAULT_BUCKETS[0] <= 0.005
+    assert DEFAULT_BUCKETS[-1] >= 600.0
+
+
+def test_concurrent_increments_are_not_lost():
+    reg = MetricsRegistry()
+    c = reg.counter("hot_total", "contended", ("who",))
+    h = reg.histogram("hot_seconds", "contended", buckets=(0.5,))
+    n_threads, per_thread = 8, 10_000
+
+    def worker():
+        child = c.labels(who="all")
+        for _ in range(per_thread):
+            child.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels(who="all").value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert h.bucket_counts()[0.5] == n_threads * per_thread

@@ -17,6 +17,7 @@ Layer map (mirrors the reference architecture, reimplemented TPU-first):
 - ``xaynet_tpu.storage`` — coordinator/model storage backends
 - ``xaynet_tpu.sdk``     — participant state machine + client
 - ``xaynet_tpu.models``  — baseline model families with JAX local training
+- ``xaynet_tpu.telemetry`` — metrics registry, kernel profiling, round reports
 """
 
 __version__ = "0.1.0"

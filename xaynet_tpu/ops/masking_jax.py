@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.crypto.prng import StreamSampler
 from ..core.mask.config import MaskConfigPair
+from ..telemetry import profiling
 from . import chacha_jax, limbs as host_limbs, limbs_jax
 
 
@@ -59,9 +60,20 @@ def sum_masks(
     10k-updates scale that is #updates/seed_batch kernel series instead of
     #updates (sum2.rs:170-193 is the per-seed loop this replaces). Device
     memory is bounded by ``seed_batch * length`` mask elements.
+
+    Device-synced timing is recorded as the ``mask_expand`` kernel op
+    (#seeds x length elements expanded and folded per call).
     """
     if not seeds:
         raise ValueError("no seeds to aggregate")
+    return profiling.timed_kernel(
+        "mask_expand", len(seeds) * length, lambda: _sum_masks(seeds, length, config, seed_batch)
+    )
+
+
+def _sum_masks(
+    seeds: list[bytes], length: int, config: MaskConfigPair, seed_batch: int
+) -> tuple[np.ndarray, jax.Array]:
     order_limbs_u = host_limbs.order_limbs_for(config.unit.order)
     order_limbs_v = host_limbs.order_limbs_for(config.vect.order)
 

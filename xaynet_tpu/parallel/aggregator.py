@@ -13,7 +13,6 @@ tiny passes, no collectives (the length axis is embarrassingly parallel).
 from __future__ import annotations
 
 import logging
-import time
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.mask.config import MaskConfig
 from ..ops import limbs as host_limbs
 from ..ops.fold_jax import MAX_LAZY_BATCH, fold_planar_batch, p_mod_sub, wire_to_planar
+from ..telemetry import profiling
 from ..utils.kernels import FOLD_KERNELS
 from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple
 
@@ -186,7 +186,9 @@ class ShardedAggregator:
         if raw.ndim != 1:
             raise ValueError("expected uint8[model_len * bytes_per_number]")
         staged = self._stage_raw_bytes(raw[None])
-        planar, ok = self._make_unpack_fn()(staged)
+        planar, ok = profiling.timed_kernel(
+            "wire_unpack", self.padded_length, lambda: self._make_unpack_fn()(staged)
+        )
         if not bool(np.asarray(ok)[0]):
             return None
         return planar[0]
@@ -195,6 +197,7 @@ class ShardedAggregator:
         """Unpack + validity + fold an already device/mesh-resident raw-byte
         batch (``add_wire_batch`` after device_put; the multihost path after
         ``make_array_from_process_local_data``)."""
+        n_elements = staged.shape[0] * self.padded_length
         if (
             self._fold_fn is not None
             and self.kernel_used == "xla"
@@ -205,15 +208,23 @@ class ShardedAggregator:
             # planar tensor (K*L*padded*4 bytes, 8/bpn x the wire bytes)
             # never round-trips HBM. On CPU the two-step path measures ~8%
             # faster (no HBM economics), so fusion stays accelerator-only.
-            self.acc, ok = self._make_ingest_fn()(self.acc, staged)
+            self.acc, ok = profiling.timed_kernel(
+                "wire_ingest",
+                n_elements,
+                lambda: self._make_ingest_fn()(self.acc, staged),
+            )
         else:
             # first call (kernel not yet resolved — auto calibration needs a
             # planar staged batch), a Pallas fold (pallas_call reads its
             # operand from HBM, so fusion would not help), or a CPU backend:
             # two-step path
-            planar, ok = self._make_unpack_fn()(staged)
+            planar, ok = profiling.timed_kernel(
+                "wire_unpack", n_elements, lambda: self._make_unpack_fn()(staged)
+            )
             # dispatch the fold BEFORE syncing the acceptance vector: the
-            # fold then overlaps the host-side ok fetch
+            # fold then overlaps the host-side ok fetch (when kernel
+            # profiling is on, the sync points serialize this overlap —
+            # XAYNET_KERNEL_PROFILE=0 restores it exactly)
             self.acc = self._fold(self.acc, planar)
         ok_host = np.asarray(ok)
         self.nb_models += int(ok_host.sum())
@@ -337,7 +348,13 @@ class ShardedAggregator:
             self._resolve_kernel(staged)  # may already set _fold_fn (winner)
             if self._fold_fn is None:
                 self._fold_fn = self._make_fold_fn(self.kernel_used)
-        return self._fold_fn(acc, staged)
+        # device-synced timing of the masked modular add (the hot path);
+        # staged is planar [K, L, padded_len] -> K x padded group elements
+        return profiling.timed_kernel(
+            "masked_add",
+            staged.shape[0] * staged.shape[-1],
+            lambda: self._fold_fn(acc, staged),
+        )
 
     def _resolve_kernel(self, staged) -> None:
         """Fix ``kernel_used`` for the aggregator's lifetime.
@@ -382,17 +399,18 @@ class ShardedAggregator:
             # accumulator instead of two fresh zeros per candidate while
             # self.acc and the batch are live (ADVICE r04). XLA runs first;
             # if the Pallas leg dies mid-run its possibly-donated scratch is
-            # never reused (no candidates follow it).
+            # never reused (no candidates follow it). Steady-state times go
+            # through the telemetry registry
+            # (xaynet_kernel_calibration_seconds{kernel=...}).
             scratch = self._zero_acc()
             for name in ("xla", "pallas"):
                 try:
                     fold = self._make_fold_fn(name)
                     scratch = fold(scratch, staged)
                     scratch.block_until_ready()  # compile
-                    t0 = time.perf_counter()
-                    scratch = fold(scratch, staged)
-                    scratch.block_until_ready()
-                    timings[name] = time.perf_counter() - t0
+                    scratch, dt = profiling.measure(lambda: fold(scratch, staged))
+                    timings[name] = dt
+                    profiling.record_calibration(name, dt)
                     fns[name] = fold
                 except Exception as e:  # Mosaic compile/run failure -> keep XLA
                     logger.warning(
@@ -414,7 +432,11 @@ class ShardedAggregator:
         if planar.shape[1] != self.padded_length:
             planar = np.pad(planar, ((0, 0), (0, self.padded_length - planar.shape[1])))
         mask_dev = jax.device_put(jnp.asarray(planar), self._acc_sharding)
-        out = _unmask_kernel(self.acc, mask_dev, self.order)
+        out = profiling.timed_kernel(
+            "unmask",
+            self.padded_length,
+            lambda: _unmask_kernel(self.acc, mask_dev, self.order),
+        )
         return np.ascontiguousarray(np.asarray(out)[:, : self.model_length].T)
 
     def snapshot(self) -> np.ndarray:

@@ -22,6 +22,7 @@ import numpy as np
 from ..core.mask.config import MaskConfigPair
 from ..core.mask.masking import Aggregation, AggregationError
 from ..core.mask.object import LazyWireMaskVect, MaskObject, MaskUnit, MaskVect
+from ..telemetry import profiling
 
 
 class StagedAggregator:
@@ -181,7 +182,13 @@ class StagedAggregator:
                 self._unit_acc[None, :], batch_unit[None, :], order_limbs
             )[0]
         else:
-            self._host.aggregate_batch(stack, units)
+            # same op label as the device fold: one /metrics series answers
+            # "how fast is the masked add", whichever backend ran it
+            profiling.timed_kernel(
+                "masked_add",
+                stack.shape[0] * self.object_size,
+                lambda: self._host.aggregate_batch(stack, units),
+            )
         self._staged_vect.clear()
         self._staged_unit.clear()
         self._count = 0

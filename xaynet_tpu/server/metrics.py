@@ -15,7 +15,21 @@ import logging
 import threading
 import time
 
+from ..telemetry.registry import get_registry
+
 logger = logging.getLogger("xaynet.metrics")
+
+# the dispatcher's own health, visible on GET /metrics: lines lost to
+# backpressure, and backoff rounds against a down/slow sink
+_DISPATCH_DROPPED = get_registry().counter(
+    "xaynet_metrics_dispatcher_dropped_total",
+    "Metric lines dropped by the Influx HTTP dispatcher (queue overflow or "
+    "failed batches against a down sink).",
+)
+_DISPATCH_BACKOFF = get_registry().counter(
+    "xaynet_metrics_dispatcher_backoff_total",
+    "Backoff sleeps taken by the Influx HTTP dispatcher after a failed POST.",
+)
 
 
 class Metrics:
@@ -164,6 +178,8 @@ class InfluxHttpMetrics(Metrics):
                     return  # don't stall shutdown retrying a dead sink
                 # sink down: drop this batch (bounded memory beats blocking)
                 self.dropped += len(lines)
+                _DISPATCH_DROPPED.inc(len(lines))
+                _DISPATCH_BACKOFF.inc()
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
 
@@ -196,6 +212,7 @@ class InfluxHttpMetrics(Metrics):
         # actually lost (the evicted one, and the new one if a concurrent
         # producer refills the freed slot before we take it)
         self.dropped += 1
+        _DISPATCH_DROPPED.inc()
         try:
             self._queue.get_nowait()
         except queue_mod.Empty:
@@ -204,3 +221,4 @@ class InfluxHttpMetrics(Metrics):
             self._queue.put_nowait(line)
         except queue_mod.Full:
             self.dropped += 1  # the new line was lost as well
+            _DISPATCH_DROPPED.inc()

@@ -208,6 +208,7 @@ class PhaseState:
                 self.shared.metrics.message_discarded(self.shared.round_id, self.NAME.value)
             self._respond(env, RequestError(RequestError.Kind.MESSAGE_DISCARDED))
             return
+        t0 = time_mod.monotonic()
         try:
             with tracing.use_request_id(env.request_id), tracing.span(
                 "handle_request", phase=self.NAME.value
@@ -215,6 +216,7 @@ class PhaseState:
                 await self.handle_request(env.request)
         except RequestError as err:
             counter.rejected += 1
+            self._record_handled(t0)
             if self.shared.metrics is not None:
                 self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
             self._respond(env, err)
@@ -226,9 +228,19 @@ class PhaseState:
             self._respond(env, RequestError(RequestError.Kind.INTERNAL, str(err)))
             raise
         counter.accepted += 1
+        self._record_handled(t0)
         if self.shared.metrics is not None:
             self.shared.metrics.message_accepted(self.shared.round_id, self.NAME.value)
         self._respond(env, None)
+
+    def _record_handled(self, t0: float) -> None:
+        """Per-request handler latency; registry-only (the bridge implements
+        it, line-protocol sinks and test stubs need not)."""
+        metrics = self.shared.metrics
+        if metrics is not None and hasattr(metrics, "request_handled"):
+            metrics.request_handled(
+                self.shared.round_id, self.NAME.value, time_mod.monotonic() - t0
+            )
 
     @staticmethod
     def _respond(env, error: Optional[Exception]) -> None:

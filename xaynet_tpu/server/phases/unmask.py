@@ -18,6 +18,7 @@ import numpy as np
 
 from ...core.mask.masking import Aggregation, UnmaskingError
 from ...core.mask.object import MaskObject
+from ...telemetry import profiling
 from ..events import ModelUpdate, PhaseName
 from .base import PhaseError, PhaseState
 
@@ -42,7 +43,9 @@ class Unmask(PhaseState):
             self.model_agg.validate_unmasking(mask)
         except UnmaskingError as err:
             raise PhaseError("Unmasking", err.kind) from err
-        self.global_model = self.model_agg.unmask_array(mask)
+        self.global_model = profiling.timed_kernel(
+            "unmask", len(self.model_agg), lambda: self.model_agg.unmask_array(mask)
+        )
         await self._save_global_model()
         await self._publish_proof()
 

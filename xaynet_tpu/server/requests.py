@@ -19,6 +19,14 @@ from ..utils import tracing
 from ..core.common import LocalSeedDict
 from ..core.mask.object import MaskObject
 from ..core.message import Message, Sum, Sum2, Update
+from ..telemetry.registry import get_registry
+
+# depth of the services -> state-machine queue: the leading indicator of a
+# phase falling behind its ingest (scraped via GET /metrics)
+_QUEUE_DEPTH = get_registry().gauge(
+    "xaynet_request_queue_depth",
+    "State-machine requests enqueued and not yet handled by a phase.",
+)
 
 
 class RequestError(Exception):
@@ -89,6 +97,7 @@ class RequestReceiver:
 
     async def next_request(self) -> _Envelope:
         env = await self._queue.get()
+        _QUEUE_DEPTH.set(self._queue.qsize())
         if env is None:
             raise ChannelClosed()
         return env
@@ -99,6 +108,7 @@ class RequestReceiver:
             env = self._queue.get_nowait()
         except asyncio.QueueEmpty:
             return None
+        _QUEUE_DEPTH.set(self._queue.qsize())
         if env is None:
             raise ChannelClosed()
         return env
@@ -130,4 +140,5 @@ class RequestSender:
             raise RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._receiver._queue.put_nowait(_Envelope(req, fut, tracing.current_request_id()))
+        _QUEUE_DEPTH.set(self._receiver._queue.qsize())
         await fut

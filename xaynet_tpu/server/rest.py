@@ -8,6 +8,8 @@ rust/xaynet-server/src/rest.rs:40-315):
 - ``GET /sums``     — sum dictionary (204 while absent)
 - ``GET /seeds?pk=<hex>`` — a sum participant's seed slice (204 while absent)
 - ``GET /model``    — latest global model bytes (204 while absent)
+- ``GET /metrics``  — telemetry registry, Prometheus text exposition
+- ``GET /healthz``  — liveness JSON (status, phase, round id, uptime)
 
 Responses are JSON (parameters, dictionaries) or raw bytes (model) — a
 readable stand-in for the reference's bincode bodies; both ends of the wire
@@ -21,11 +23,13 @@ import asyncio
 import json
 import logging
 import ssl
+import time
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..telemetry.registry import MetricsRegistry, get_registry
 from .requests import RequestError
 from .services import Fetcher, PetMessageHandler, ServiceError
 
@@ -33,14 +37,37 @@ logger = logging.getLogger("xaynet.rest")
 
 MAX_BODY = 1 << 32  # u32 length field ceiling, as in the reference
 
+# known routes/methods keep the http counter's labels closed-cardinality —
+# both tokens are attacker-controlled, and every distinct label value is a
+# permanent registry child
+_KNOWN_PATHS = {"/message", "/params", "/sums", "/seeds", "/model",
+                "/health", "/healthz", "/metrics"}
+_KNOWN_METHODS = {"GET", "POST", "HEAD", "PUT", "DELETE", "OPTIONS", "PATCH"}
+
 
 class RestServer:
     def __init__(
-        self, fetcher: Fetcher, handler: PetMessageHandler, read_timeout: float = 120.0
+        self,
+        fetcher: Fetcher,
+        handler: PetMessageHandler,
+        read_timeout: float = 120.0,
+        registry: Optional[MetricsRegistry] = None,
     ):
+        # `registry` selects what GET /metrics renders. Hot-path modules
+        # (request queue, message pipeline, kernel profiling, dispatcher)
+        # record into the PROCESS registry at import time, so a custom
+        # registry exposes only the families created against it (unit
+        # tests); production keeps the default.
         self.fetcher = fetcher
         self.handler = handler
         self.read_timeout = read_timeout  # slow-client defense
+        self.registry = registry if registry is not None else get_registry()
+        self._started_at = time.monotonic()
+        self._http_requests = self.registry.counter(
+            "xaynet_http_requests_total",
+            "REST requests by method, route and status code.",
+            ("method", "path", "status"),
+        )
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(
@@ -101,6 +128,15 @@ class RestServer:
 
     async def _route(self, method: str, target: str, body: bytes) -> tuple[int, bytes, str]:
         url = urlparse(target)
+        status, payload, ctype = await self._dispatch(method, url, body)
+        self._http_requests.labels(
+            method=method if method in _KNOWN_METHODS else "other",
+            path=url.path if url.path in _KNOWN_PATHS else "other",
+            status=status,
+        ).inc()
+        return status, payload, ctype
+
+    async def _dispatch(self, method: str, url, body: bytes) -> tuple[int, bytes, str]:
         path = url.path
         try:
             if method == "POST" and path == "/message":
@@ -129,12 +165,20 @@ class RestServer:
                     json.dumps({k.hex(): v.as_bytes().hex() for k, v in seeds.items()}).encode(),
                     "application/json",
                 )
+            if method == "GET" and path == "/metrics":
+                return (
+                    200,
+                    self.registry.render().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            if method == "GET" and path == "/healthz":
+                # liveness + the coarse round position, cheap enough to poll
+                payload = self._health_payload()
+                payload["status"] = "ok"
+                payload["uptime_seconds"] = round(time.monotonic() - self._started_at, 3)
+                return 200, json.dumps(payload).encode(), "application/json"
             if method == "GET" and path == "/health":
-                body = json.dumps(
-                    {"phase": self.fetcher.phase().value,
-                     "round_id": self.fetcher.events.params.get_latest().round_id}
-                ).encode()
-                return 200, body, "application/json"
+                return 200, json.dumps(self._health_payload()).encode(), "application/json"
             if method == "GET" and path == "/model":
                 model = self.fetcher.model()
                 if model is None:
@@ -144,6 +188,13 @@ class RestServer:
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)
             return 500, str(err).encode(), "text/plain"
+
+    def _health_payload(self) -> dict:
+        """Shared by /health (legacy shape) and /healthz (superset)."""
+        return {
+            "phase": self.fetcher.phase().value,
+            "round_id": self.fetcher.events.params.get_latest().round_id,
+        }
 
     async def _post_message(self, body: bytes) -> tuple[int, bytes, str]:
         try:

@@ -25,6 +25,8 @@ from ..storage.memory import (
     NoOpTrustAnchor,
 )
 from ..storage.traits import Store
+from ..telemetry import BridgedMetrics, RoundReporter
+from ..utils import tracing
 from .metrics import InfluxHttpMetrics, InfluxLineMetrics, JsonlMetrics, LogMetrics
 from .rest import RestServer
 from .services import Fetcher, PetMessageHandler
@@ -87,11 +89,24 @@ def init_metrics(settings: Settings):
     return LogMetrics()
 
 
-async def serve(settings: Settings, store: Optional[Store] = None) -> None:
+def init_logging(settings: Settings) -> None:
+    """Default logging with request-id correlation: every record carries
+    ``%(request_id)s`` (set by ``tracing.RequestIdFilter`` from the
+    contextvar the message pipeline assigns), so one grep on an id yields
+    the full path of a message through pipeline and state machine."""
     logging.basicConfig(
         level=getattr(logging, settings.log.filter.upper(), logging.INFO),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        format="%(asctime)s %(name)s %(levelname)s [%(request_id)s] %(message)s",
     )
+    # the filter must sit on the handlers: logger-level filters don't apply
+    # to records propagated from child loggers
+    for handler in logging.getLogger().handlers:
+        if not any(isinstance(f, tracing.RequestIdFilter) for f in handler.filters):
+            handler.addFilter(tracing.RequestIdFilter())
+
+
+async def serve(settings: Settings, store: Optional[Store] = None) -> None:
+    init_logging(settings)
     store = store if store is not None else init_store(settings)
     if settings.storage.backend == "s3":
         # reference creates the bucket at startup (main.rs init_store path)
@@ -99,7 +114,14 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
 
         if isinstance(store.models, S3ModelStorage):
             await store.models.create_bucket()
-    metrics = init_metrics(settings)
+    # registry-first telemetry: the configured sink (if any) and the
+    # per-round JSON reporter both consume the bridge's measurements
+    reporter = (
+        RoundReporter(settings.metrics.round_report_path)
+        if settings.metrics.round_report_path
+        else None
+    )
+    metrics = BridgedMetrics(sink=init_metrics(settings), reporter=reporter)
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()
 
@@ -107,7 +129,7 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         events, request_tx, wire_ingest=settings.aggregation.wire_ingest
     )
     fetcher = Fetcher(events)
-    rest = RestServer(fetcher, handler)
+    rest = RestServer(fetcher, handler, registry=metrics.registry)
     host, _, port = settings.api.bind_address.partition(":")
     tls = None
     if settings.api.tls_certificate:
@@ -137,8 +159,10 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
     finally:
         machine_task.cancel()
         await rest.stop()
-        if metrics is not None:
-            metrics.close()  # drain the async sink's queued tail
+        # flush the in-flight round report and drain the dispatcher thread's
+        # queued tail — without this the InfluxHttp dispatcher dies with
+        # whatever was still batching
+        metrics.close()
         logger.info("coordinator stopped")
 
 

@@ -26,6 +26,7 @@ from ..core.crypto.sign import is_eligible, verify_detached
 from ..core.mask.serialization import DecodeError
 from ..core.message import Chunk, Message, Sum, Sum2, Tag, Update, peek_header
 from ..core.message.encoder import MessageBuilder
+from ..telemetry.registry import get_registry
 from ..utils import tracing
 from .events import EventSubscriber, PhaseName
 from .requests import RequestSender, request_from_message
@@ -35,6 +36,20 @@ _PHASE_TAGS = {
     PhaseName.UPDATE: Tag.UPDATE,
     PhaseName.SUM2: Tag.SUM2,
 }
+
+# ms-scale crypto stages; the 'total' series includes the state-machine wait
+_PIPELINE_SECONDS = get_registry().histogram(
+    "xaynet_message_pipeline_seconds",
+    "Message-pipeline stage wall time (decrypt_parse = sealed-box open + "
+    "signature verify on the thread pool; total = end-to-end handling).",
+    ("stage",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+_MULTIPART_BUFFERS = get_registry().gauge(
+    "xaynet_multipart_buffers",
+    "Multipart reassembly buffers currently held (bounded, oldest-evicted).",
+)
 
 
 class ServiceError(Exception):
@@ -76,12 +91,14 @@ class PetMessageHandler:
         """
         tracing.new_request_id()
         with tracing.span("handle_message", size=len(encrypted)):
-            message = await self._parse_message(encrypted)
-            if message is None:
-                return  # multipart message still incomplete
-            with tracing.span("task_validator"):
-                self._validate_task(message)
-            await self.request_tx.request(request_from_message(message))
+            with _PIPELINE_SECONDS.labels(stage="total").time():
+                with _PIPELINE_SECONDS.labels(stage="decrypt_parse").time():
+                    message = await self._parse_message(encrypted)
+                if message is None:
+                    return  # multipart message still incomplete
+                with tracing.span("task_validator"):
+                    self._validate_task(message)
+                await self.request_tx.request(request_from_message(message))
 
     # --- pipeline stages --------------------------------------------------
 
@@ -126,9 +143,11 @@ class PetMessageHandler:
             evicted = next(iter(self._multipart))
             del self._multipart[evicted]
         builder = self._multipart.setdefault(key, MessageBuilder())
+        _MULTIPART_BUFFERS.set(len(self._multipart))
         if not builder.add(chunk):
             return None
         del self._multipart[key]
+        _MULTIPART_BUFFERS.set(len(self._multipart))
         # streaming parse: chunk buffers are consumed as the parser reads,
         # never concatenated (reference: multipart/service.rs streaming
         # FromBytes re-parse; chunkable_iterator.rs:17-60)

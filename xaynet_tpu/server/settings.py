@@ -133,6 +133,10 @@ class MetricsSettings:
     path: str = "./metrics.jsonl"
     url: str = "http://127.0.0.1:8086"  # influx-http write endpoint
     database: str = "metrics"
+    # per-round JSON report artifact (JSONL; empty disables). Independent of
+    # `enable`: the in-process telemetry registry is always on — enable/sink
+    # only control the external line-protocol export.
+    round_report_path: str = ""
 
 
 @dataclass
@@ -295,6 +299,9 @@ class Settings:
                 path=str(metrics_raw.get("path", base.metrics.path)),
                 url=str(metrics_raw.get("url", base.metrics.url)),
                 database=str(metrics_raw.get("database", base.metrics.database)),
+                round_report_path=str(
+                    metrics_raw.get("round_report_path", base.metrics.round_report_path)
+                ),
             ),
             log=LoggingSettings(filter=str(log_raw.get("filter", base.log.filter))),
             aggregation=AggregationSettings(

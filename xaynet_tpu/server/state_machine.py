@@ -13,6 +13,7 @@ from typing import Optional
 import numpy as np
 
 from ..storage.traits import Store
+from ..telemetry.bridge import BridgedMetrics
 from .coordinator import CoordinatorState
 from .events import EventPublisher, EventSubscriber, ModelUpdate, PhaseName
 from .phases import Idle, PhaseState, Shared
@@ -56,7 +57,10 @@ class StateMachineInitializer:
         settings.validate()
         self.settings = settings
         self.store = store
-        self.metrics = metrics
+        # phase histograms and message counters must reach GET /metrics even
+        # when no external sink is configured: default to a registry-only
+        # bridge (callers may still inject any recorder, e.g. test spies)
+        self.metrics = metrics if metrics is not None else BridgedMetrics()
 
     async def init(self) -> tuple[StateMachine, RequestSender, EventSubscriber]:
         """Fresh start (or restore when enabled and state exists)."""

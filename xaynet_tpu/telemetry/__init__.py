@@ -1,0 +1,19 @@
+"""Unified telemetry layer: registry, kernel profiling, round reports.
+
+- ``registry``  — Prometheus-style in-process metrics (counters, gauges,
+  histograms, labels, text exposition) behind ``GET /metrics``;
+- ``profiling`` — device-synced kernel timing hooks for the aggregation
+  hot path (``XAYNET_KERNEL_PROFILE=0`` disables the sync points);
+- ``report``    — per-round JSON report emitter (JSONL artifact);
+- ``bridge``    — the reference eight-measurement recorder surface on top
+  of the registry, forwarding to the legacy Jsonl/Influx sinks.
+"""
+
+from .bridge import BridgedMetrics as BridgedMetrics
+from .registry import (
+    DEFAULT_BUCKETS as DEFAULT_BUCKETS,
+    MetricError as MetricError,
+    MetricsRegistry as MetricsRegistry,
+    get_registry as get_registry,
+)
+from .report import RoundReporter as RoundReporter

@@ -1,0 +1,146 @@
+"""TPU hot-path profiling hooks.
+
+Timing accelerator work honestly means syncing the device: JAX dispatch is
+asynchronous, so a wall-clock around the call alone measures dispatch, not
+the kernel. ``timed_kernel`` runs an op, blocks until its outputs are ready
+(``jax.block_until_ready`` — a no-op for host numpy results) and records
+device-synced seconds, element counts and derived elements/sec into the
+process registry, plus a per-round accumulator the round report drains.
+
+The sync point serializes dispatch pipelining (e.g. the wire-ingest path
+deliberately overlaps the fold with the acceptance-vector fetch), so the
+hooks can be disabled wholesale with ``XAYNET_KERNEL_PROFILE=0`` — the ops
+then run exactly as before, with zero added synchronization.
+
+Ops recorded by the stack today: ``mask_expand`` (PRNG seed -> mask limbs),
+``masked_add`` (the fold), ``wire_unpack``/``wire_ingest`` (device wire
+paths), ``unmask`` (modular subtract + decode).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, TypeVar
+
+from .registry import get_registry
+
+T = TypeVar("T")
+
+# sub-millisecond kernels up to minute-scale 25M-element folds
+_KERNEL_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_registry = get_registry()
+KERNEL_SECONDS = _registry.histogram(
+    "xaynet_kernel_seconds",
+    "Device-synced wall time of one aggregation kernel invocation.",
+    ("op",),
+    buckets=_KERNEL_BUCKETS,
+)
+KERNEL_CALLS = _registry.counter(
+    "xaynet_kernel_calls_total", "Aggregation kernel invocations.", ("op",)
+)
+KERNEL_ELEMENTS = _registry.counter(
+    "xaynet_kernel_elements_total", "Group elements processed by kernel.", ("op",)
+)
+KERNEL_RATE = _registry.gauge(
+    "xaynet_kernel_elements_per_second",
+    "Throughput of the most recent invocation of each kernel.",
+    ("op",),
+)
+KERNEL_CALIBRATION = _registry.gauge(
+    "xaynet_kernel_calibration_seconds",
+    "Steady-state fold time per candidate measured by kernel auto-calibration.",
+    ("kernel",),
+)
+KERNEL_FIRST_CALL = _registry.gauge(
+    "xaynet_kernel_first_call_seconds",
+    "Wall time of each op's first invocation this process — on jit-compiled "
+    "device paths this includes XLA/Mosaic compilation, so subtract it from "
+    "histogram aggregates for steady-state analysis.",
+    ("op",),
+)
+
+_round_lock = threading.Lock()
+_round_stats: dict[str, dict[str, float]] = {}
+_seen_ops: set[str] = set()
+
+
+def enabled() -> bool:
+    """Hot-path sync profiling toggle (``XAYNET_KERNEL_PROFILE=0`` disables)."""
+    return os.environ.get("XAYNET_KERNEL_PROFILE", "1") != "0"
+
+
+def _block(result: T) -> T:
+    """Wait for device work backing ``result`` (pytree-safe, numpy-safe).
+
+    Only the jax import is guarded: a device error surfacing at the sync
+    point must PROPAGATE — callers like kernel auto-calibration rely on it
+    (a Pallas candidate that fails on invocation falls back to XLA only if
+    the failure is visible here)."""
+    try:
+        import jax
+    except ImportError:  # telemetry stays usable in jax-less tooling
+        return result
+    return jax.block_until_ready(result)
+
+
+def record(op: str, seconds: float, elements: int) -> None:
+    """Record one kernel invocation into the registry and the round window."""
+    KERNEL_SECONDS.labels(op=op).observe(seconds)
+    KERNEL_CALLS.labels(op=op).inc()
+    KERNEL_ELEMENTS.labels(op=op).inc(elements)
+    if seconds > 0:
+        KERNEL_RATE.labels(op=op).set(elements / seconds)
+    with _round_lock:
+        if op not in _seen_ops:
+            _seen_ops.add(op)
+            KERNEL_FIRST_CALL.labels(op=op).set(seconds)
+        stats = _round_stats.setdefault(
+            op, {"calls": 0, "seconds": 0.0, "elements": 0}
+        )
+        stats["calls"] += 1
+        stats["seconds"] += seconds
+        stats["elements"] += elements
+
+
+def timed_kernel(op: str, elements: int, fn: Callable[[], T]) -> T:
+    """Run ``fn``, sync its outputs, record the timing; pass-through (no
+    sync, no record) when profiling is disabled."""
+    if not enabled():
+        return fn()
+    t0 = time.perf_counter()
+    result = _block(fn())
+    record(op, time.perf_counter() - t0, elements)
+    return result
+
+
+def measure(fn: Callable[[], T]) -> tuple[T, float]:
+    """(result, device-synced seconds) — the primitive for calibration code
+    that needs the number itself rather than a registry record."""
+    t0 = time.perf_counter()
+    result = _block(fn())
+    return result, time.perf_counter() - t0
+
+
+def record_calibration(kernel: str, seconds: float) -> None:
+    KERNEL_CALIBRATION.labels(kernel=kernel).set(seconds)
+
+
+def drain_round_stats() -> dict[str, dict[str, float]]:
+    """Per-op stats accumulated since the last drain (with derived
+    elements/sec); resets the window. Consumed by the round report."""
+    with _round_lock:
+        stats = dict(_round_stats)
+        _round_stats.clear()
+    out = {}
+    for op, s in stats.items():
+        out[op] = dict(s)
+        out[op]["elements_per_sec"] = (
+            round(s["elements"] / s["seconds"], 3) if s["seconds"] > 0 else 0.0
+        )
+    return out

@@ -1,0 +1,113 @@
+"""Per-round JSON round reports.
+
+One structured JSON object per completed round, appended to a JSONL file:
+round id, per-phase durations, message accepted/rejected/discarded counts
+per phase, unique-mask total, aggregation kernel stats (calls, device-synced
+seconds, elements, derived elements/sec) and any phase events. Consumers
+(``tools/tpu_watch.py``, ``bench.py``, dashboards) read one artifact instead
+of scraping coordinator logs.
+
+Fed by ``telemetry.bridge.BridgedMetrics``: a report window opens when Idle
+records ``round_total`` for a new round and flushes when the next round
+starts (or on ``close()`` for the in-flight tail).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+from . import profiling
+
+logger = logging.getLogger("xaynet.telemetry")
+
+
+class RoundReporter:
+    """Accumulates one round's telemetry and writes it as a JSON line."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.last_report: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._round_id: Optional[int] = None
+        self._started: float = 0.0
+        self._reset()
+
+    def _reset(self) -> None:
+        self._phases: list[str] = []
+        self._durations: dict[str, float] = {}
+        self._messages: dict[str, dict[str, int]] = {}
+        self._masks_total: Optional[int] = None
+        self._events: list[dict] = []
+
+    # --- recording (called by the bridge) ---------------------------------
+
+    def begin_round(self, round_id: int) -> None:
+        with self._lock:
+            if self._round_id is not None and self._round_id != round_id:
+                self._flush_locked()
+            if self._round_id != round_id:
+                self._round_id = round_id
+                self._started = time.time()
+
+    def record_phase(self, phase: str) -> None:
+        with self._lock:
+            self._phases.append(phase)
+
+    def record_phase_duration(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self._durations[phase] = round(
+                self._durations.get(phase, 0.0) + seconds, 6
+            )
+
+    def record_message(self, phase: str, outcome: str) -> None:
+        with self._lock:
+            counts = self._messages.setdefault(
+                phase, {"accepted": 0, "rejected": 0, "discarded": 0}
+            )
+            counts[outcome] = counts.get(outcome, 0) + 1
+
+    def record_masks_total(self, count: int) -> None:
+        with self._lock:
+            self._masks_total = count
+
+    def record_event(self, kind: str, detail: str) -> None:
+        with self._lock:
+            self._events.append({"kind": kind, "detail": detail})
+
+    # --- flushing ----------------------------------------------------------
+
+    def _flush_locked(self) -> None:
+        if self._round_id is None:
+            return
+        report = {
+            "ts": round(time.time(), 3),
+            "round_id": self._round_id,
+            "seconds": round(time.time() - self._started, 3),
+            "phases": self._phases,
+            "phase_durations": dict(self._durations),
+            "messages": self._messages,
+            "masks_total": self._masks_total,
+            "kernels": profiling.drain_round_stats(),
+            "events": self._events,
+        }
+        self.last_report = report
+        if self.path:
+            # a bad report path must never take the coordinator down: the
+            # flush runs inside round_total (next round's Idle) and inside
+            # close() — raising would abort the round / skip the sink drain
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(report) + "\n")
+            except OSError as err:
+                logger.warning("round report write failed (%s): %s", self.path, err)
+        self._round_id = None
+        self._reset()
+
+    def flush(self) -> None:
+        """Write the in-flight round (shutdown path)."""
+        with self._lock:
+            self._flush_locked()
