@@ -99,6 +99,7 @@ def _start(model_len):
     return info["url"]
 
 
+@_pytest.mark.slow  # multi-round REST training; minutes without the native crypto wheel
 def test_federated_mlp_learns():
     rng = np.random.default_rng(0)
     w_true = rng.normal(size=INPUT_DIM).astype(np.float32)

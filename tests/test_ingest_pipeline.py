@@ -1,0 +1,418 @@
+"""Ingest pipeline integration: full PET round, shed path, flood stress.
+
+Acceptance contract of the subsystem:
+
+- a full round through REST -> admission -> shards -> batched decrypt ->
+  coalescer -> state machine produces a BYTE-IDENTICAL aggregate to the
+  per-message direct path, with intake occupancy never above the configured
+  bound and FEWER aggregator dispatches than update messages;
+- a saturated intake answers 429 + Retry-After, counts
+  ``xaynet_ingest_shed_total``, flips /healthz to saturated, and recovers
+  (200s resume) once drained.
+"""
+
+import asyncio
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.ingest import IngestPipeline
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import build_update_message, flood, keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.aggregation import StagedAggregator
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    IngestSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+from xaynet_tpu.telemetry.registry import get_registry
+
+N_SUM, N_UPDATE, MODEL_LEN = 1, 4, 7
+SUM_PROB, UPDATE_PROB = 0.4, 0.5
+QUEUE_BOUND = 4
+
+
+class ArrayModelStore(ModelStore):
+    def __init__(self, model):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+def _settings(ingest: IngestSettings, phase_max: float = 30.0) -> Settings:
+    settings = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(
+                prob=SUM_PROB,
+                count=CountSettings(N_SUM, N_SUM),
+                time=TimeSettings(0, phase_max),
+            ),
+            update=PhaseSettings(
+                prob=UPDATE_PROB,
+                count=CountSettings(N_UPDATE, N_UPDATE),
+                time=TimeSettings(0, phase_max),
+            ),
+            sum2=Sum2Settings(
+                count=CountSettings(N_SUM, N_SUM), time=TimeSettings(0, phase_max)
+            ),
+        )
+    )
+    settings.model.length = MODEL_LEN
+    settings.ingest = ingest
+    return settings
+
+
+class _Coordinator:
+    """One in-process coordinator + REST server (pipeline optional)."""
+
+    def __init__(self, settings: Settings):
+        self.settings = settings
+
+    async def __aenter__(self):
+        store = Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+        machine, request_tx, events = await StateMachineInitializer(
+            self.settings, store
+        ).init()
+        self.handler = PetMessageHandler(events, request_tx)
+        self.fetcher = Fetcher(events)
+        self.events = events
+        self.request_tx = request_tx
+        self.pipeline = None
+        if self.settings.ingest.enabled:
+            self.pipeline = IngestPipeline(
+                self.handler, request_tx, events, self.settings.ingest
+            )
+            await self.pipeline.start()
+        self.rest = RestServer(self.fetcher, self.handler, pipeline=self.pipeline)
+        self.host, self.port = await self.rest.start("127.0.0.1", 0)
+        self.machine_task = asyncio.create_task(machine.run())
+        return self
+
+    async def __aexit__(self, *exc):
+        self.machine_task.cancel()
+        await self.rest.stop()
+        if self.pipeline is not None:
+            await self.pipeline.stop()
+        try:
+            await self.machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def wait_phase(self, name: str) -> None:
+        while self.fetcher.phase().value != name:
+            await asyncio.sleep(0.01)
+
+
+def _count_fold_dispatches(monkeypatch) -> list:
+    """Counts StagedAggregator flushes that actually dispatch a fold."""
+    dispatches = []
+    orig = StagedAggregator.flush
+
+    def counting(self):
+        if self.pending > 0:
+            dispatches.append(self.pending)
+        return orig(self)
+
+    monkeypatch.setattr(StagedAggregator, "flush", counting)
+    return dispatches
+
+
+async def _drive_round(coord: _Coordinator, models: list, dispatches: list) -> np.ndarray:
+    """One full PET round: SDK sum participant + flood-built update uploads."""
+    probe = HttpClient(coord.url)
+    await coord.wait_phase("sum")
+    params = await probe.get_round_params()
+    seed = params.seed.as_bytes()
+
+    sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=0)
+    summer = ParticipantSM(PetSettings(keys=sum_keys), HttpClient(coord.url), ArrayModelStore(None))
+
+    async def drive_summer():
+        for _ in range(2000):
+            try:
+                await summer.transition()
+            except Exception:
+                pass
+            model = await probe.get_model()
+            if model is not None and summer.phase.value == "awaiting":
+                return
+            await asyncio.sleep(0.01)
+
+    summer_task = asyncio.create_task(drive_summer())
+    try:
+        await coord.wait_phase("update")
+        sum_dict = None
+        while not sum_dict:
+            sum_dict = await probe.get_sums()
+            await asyncio.sleep(0.01)
+
+        sealed = [
+            build_update_message(
+                params,
+                keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(20 + i) * 1000),
+                sum_dict,
+                models[i],
+                Fraction(1, N_UPDATE),
+            )
+            for i in range(N_UPDATE)
+        ]
+        if coord.pipeline is not None:
+            # park the workers so all uploads are queued together — the
+            # coalescing then provably groups them instead of relying on
+            # network timing
+            await coord.pipeline.stop()
+        client = HttpClient(coord.url)
+        await asyncio.gather(*(client.send_message(blob) for blob in sealed))
+        if coord.pipeline is not None:
+            assert coord.pipeline.intake.occupancy == N_UPDATE
+            await coord.pipeline.start()
+
+        await asyncio.wait_for(summer_task, timeout=60)
+    finally:
+        if not summer_task.done():
+            summer_task.cancel()
+    model = await probe.get_model()
+    assert model is not None
+    return np.asarray(model)
+
+
+def test_full_round_through_ingest_pipeline_matches_direct_path(monkeypatch):
+    """(a) occupancy never exceeds the bound, (b) fewer fold dispatches than
+    update messages, (c) byte-identical aggregate vs. the per-message path."""
+
+    async def run():
+        rng = np.random.default_rng(5)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(N_UPDATE)]
+        expected = sum(m.astype(np.float64) for m in models) / N_UPDATE
+
+        dispatches = _count_fold_dispatches(monkeypatch)
+        ingest_on = IngestSettings(
+            enabled=True,
+            shards=2,
+            queue_bound=QUEUE_BOUND,
+            high_watermark=1.0,
+            low_watermark=0.5,
+            coalesce=True,
+            coalesce_max_batch=8,
+            coalesce_linger_ms=50.0,
+        )
+        async with _Coordinator(_settings(ingest_on)) as coord:
+            got_pipeline = await asyncio.wait_for(_drive_round(coord, models, dispatches), 90)
+            # (a) the bounded intake never grew past its configured bound
+            assert 0 < coord.pipeline.intake.max_occupancy <= QUEUE_BOUND
+            for shard in coord.pipeline.intake.shards:
+                assert shard.max_occupancy <= QUEUE_BOUND
+            # (b) coalescing amortized the fold: fewer dispatches than
+            # update messages (one stacked masked_add per micro-batch)
+            pipeline_dispatches = len(dispatches)
+            assert coord.pipeline.coalescer.members_sent == N_UPDATE
+            assert 1 <= pipeline_dispatches < N_UPDATE
+            assert sum(dispatches) == N_UPDATE
+
+        np.testing.assert_allclose(got_pipeline, expected, atol=1e-9)
+
+        dispatches.clear()
+        async with _Coordinator(_settings(IngestSettings(enabled=False))) as coord:
+            got_direct = await asyncio.wait_for(_drive_round(coord, models, dispatches), 90)
+        np.testing.assert_allclose(got_direct, expected, atol=1e-9)
+
+        # (c) the batched path computes the exact same aggregate
+        assert got_pipeline.tobytes() == got_direct.tobytes()
+
+    asyncio.run(run())
+
+
+async def _http_post(host, port, path, body: bytes):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        (
+            f"POST {path} HTTP/1.1\r\nHost: t\r\nContent-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        + body
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        name, _, value = line.decode().partition(":")
+        headers[name.strip().lower()] = value.strip()
+    writer.close()
+    return status, headers
+
+
+async def _http_get_json(host, port, path):
+    import json
+
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    body = raw.split(b"\r\n\r\n", 1)[1]
+    return json.loads(body.decode())
+
+
+def test_saturated_intake_sheds_429_and_recovers():
+    async def run():
+        registry = get_registry()
+
+        def shed_total():
+            return registry.sample_value("xaynet_ingest_shed_total") or 0
+
+        ingest = IngestSettings(
+            enabled=True,
+            shards=1,
+            queue_bound=QUEUE_BOUND,
+            high_watermark=0.5,  # saturate at 2 of 4
+            low_watermark=0.25,
+            retry_after_seconds=1.0,
+        )
+        async with _Coordinator(_settings(ingest)) as coord:
+            await coord.wait_phase("sum")
+            # park the worker: nothing drains, so occupancy climbs
+            await coord.pipeline.stop()
+            shed_before = shed_total()
+            garbage = b"\x00" * 400
+
+            s1, _ = await _http_post(coord.host, coord.port, "/message", garbage)
+            s2, _ = await _http_post(coord.host, coord.port, "/message", garbage)
+            assert (s1, s2) == (200, 200)
+            # high watermark crossed: the next arrival is shed
+            s3, h3 = await _http_post(coord.host, coord.port, "/message", garbage)
+            assert s3 == 429
+            assert int(h3["retry-after"]) >= 1
+            assert shed_total() == shed_before + 1
+
+            health = await _http_get_json(coord.host, coord.port, "/healthz")
+            assert health["status"] == "saturated"
+            assert health["ingest"]["saturated"] is True
+            assert health["ingest"]["occupancy"] == 2
+
+            # recovery: workers drain the garbage (decrypt drops), the
+            # hysteresis clears, and POSTs answer 200 again
+            await coord.pipeline.start()
+            for _ in range(500):
+                if coord.pipeline.intake.occupancy == 0:
+                    break
+                await asyncio.sleep(0.01)
+            s4, _ = await _http_post(coord.host, coord.port, "/message", garbage)
+            assert s4 == 200
+            health = await _http_get_json(coord.host, coord.port, "/healthz")
+            assert health["status"] == "ok"
+            assert health["ingest"]["saturated"] is False
+            dropped = registry.sample_value(
+                "xaynet_ingest_rejected_total", {"stage": "decrypt"}
+            )
+            assert dropped and dropped >= 2
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_flood_stress_shed_and_admit_paths():
+    """Load-generate against both targets: valid updates through the raw
+    ``PetMessageHandler`` (accept/reject verdicts) and a paused pipeline
+    (admission verdicts) — then verify the pipeline drains and recovers."""
+
+    async def run():
+        ingest = IngestSettings(
+            enabled=True,
+            shards=2,
+            queue_bound=8,  # capacity 16
+            high_watermark=0.5,  # saturate at 8
+            low_watermark=0.25,
+        )
+        settings = _settings(ingest, phase_max=60.0)
+        # the phase completes at count.min accepted (time.min = 0), so pin
+        # min == max == 8: exactly 8 of the 12 flooded updates are taken
+        settings.pet.update.count = CountSettings(8, 8)
+        async with _Coordinator(settings) as coord:
+            probe = HttpClient(coord.url)
+            await coord.wait_phase("sum")
+            params = await probe.get_round_params()
+            seed = params.seed.as_bytes()
+            summer = ParticipantSM(
+                PetSettings(keys=keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=0)),
+                HttpClient(coord.url),
+                ArrayModelStore(None),
+            )
+            while coord.fetcher.phase().value == "sum":
+                try:
+                    await summer.transition()
+                except Exception:
+                    pass
+                await asyncio.sleep(0.01)
+            await coord.wait_phase("update")
+            sum_dict = None
+            while not sum_dict:
+                sum_dict = await probe.get_sums()
+                await asyncio.sleep(0.01)
+
+            # leg 1: valid uploads against the raw handler — protocol
+            # verdicts (accepts up to count.max=8, discards beyond)
+            stats = await flood(
+                coord.handler, params, sum_dict, 12, key_start=100_000, concurrency=8
+            )
+            assert stats.sent == 12
+            assert stats.accepted == 8  # count.max, the rest discarded/stale
+            assert stats.rejected == 4
+
+            # leg 2: admission verdicts on a parked pipeline — garbage of
+            # valid length floods the intake until admission sheds
+            await coord.pipeline.stop()
+            stats = await flood(
+                coord.pipeline,
+                params,
+                sum_dict,
+                40,
+                build=lambda i: bytes([i % 251]) * 300,
+                concurrency=16,
+            )
+            assert stats.sent == 40
+            assert stats.accepted >= 8  # up to the high watermark
+            assert stats.shed > 0  # and shedding beyond it
+            assert stats.accepted + stats.shed + stats.rejected == 40
+            assert coord.pipeline.admission.saturated
+
+            # recovery: drain clears saturation, floods admit again
+            await coord.pipeline.start()
+            for _ in range(1000):
+                if coord.pipeline.intake.occupancy == 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert coord.pipeline.intake.occupancy == 0
+            stats = await flood(
+                coord.pipeline,
+                params,
+                sum_dict,
+                4,
+                build=lambda i: bytes([i % 251]) * 300,
+            )
+            assert stats.accepted == 4 and stats.shed == 0
+
+    asyncio.run(asyncio.wait_for(run(), timeout=300))

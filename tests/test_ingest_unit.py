@@ -1,0 +1,251 @@
+"""Ingest subsystem unit coverage: admission hysteresis, bounded shards,
+linger batching, update coalescing, settings validation, pre-filter."""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from xaynet_tpu.ingest import (
+    AdmissionController,
+    IngestPipeline,
+    IntakeShard,
+    ShardedIntake,
+    UpdateCoalescer,
+    Verdict,
+)
+from xaynet_tpu.ingest.intake import ShardFull
+from xaynet_tpu.server.events import PhaseName
+from xaynet_tpu.server.requests import CoalescedUpdates, RequestError, UpdateRequest
+from xaynet_tpu.server.settings import IngestSettings, SettingsError
+
+
+# --- admission ---------------------------------------------------------------
+
+
+def test_admission_watermark_hysteresis():
+    ctl = AdmissionController(capacity=10, high_watermark=0.8, low_watermark=0.5)
+    assert ctl.high_mark == 8 and ctl.low_mark == 5
+    assert ctl.admit(7).verdict is Verdict.ADMITTED
+    assert not ctl.saturated
+    # crossing high flips into shedding ...
+    assert ctl.admit(8).shed
+    assert ctl.saturated
+    # ... and stays shedding between low and high (hysteresis)
+    assert ctl.admit(6).shed
+    # draining to the low watermark clears the state without a new arrival
+    ctl.observe(5)
+    assert not ctl.saturated
+    assert ctl.admit(5).verdict is Verdict.ADMITTED
+
+
+def test_admission_full_capacity_always_sheds():
+    ctl = AdmissionController(capacity=4, high_watermark=1.0, low_watermark=1.0)
+    assert ctl.high_mark == 4  # 1.0 means "full", never capacity+1
+    assert ctl.admit(3).verdict is Verdict.ADMITTED
+    assert ctl.admit(4).shed
+
+
+def test_admission_retry_after_scales_with_overload():
+    ctl = AdmissionController(
+        capacity=100, high_watermark=0.5, low_watermark=0.25, retry_after_seconds=2.0
+    )
+    shallow = ctl.retry_after(50)
+    deep = ctl.retry_after(100)
+    assert shallow >= 2.0
+    assert deep > shallow
+
+
+def test_admission_validates_arguments():
+    with pytest.raises(ValueError):
+        AdmissionController(capacity=0)
+    with pytest.raises(ValueError):
+        AdmissionController(capacity=10, high_watermark=0.3, low_watermark=0.6)
+
+
+# --- intake ------------------------------------------------------------------
+
+
+def test_shard_hard_bound_and_max_occupancy():
+    async def run():
+        shard = IntakeShard(0, bound=2)
+        shard.put_nowait(b"a")
+        shard.put_nowait(b"b")
+        with pytest.raises(ShardFull):
+            shard.put_nowait(b"c")
+        assert shard.occupancy == 2
+        assert shard.max_occupancy == 2
+
+    asyncio.run(run())
+
+
+def test_sharded_intake_spreads_and_fails_only_when_all_full():
+    async def run():
+        intake = ShardedIntake(2, bound_per_shard=2)
+        for i in range(4):
+            intake.put_nowait(bytes([i]))
+        assert intake.occupancy == 4
+        assert [s.occupancy for s in intake.shards] == [2, 2]  # round robin
+        with pytest.raises(ShardFull):
+            intake.put_nowait(b"x")
+        assert intake.max_occupancy == 2  # never above the per-shard bound
+
+    asyncio.run(run())
+
+
+def test_get_batch_linger_and_cap():
+    async def run():
+        shard = IntakeShard(0, bound=16)
+        for i in range(5):
+            shard.put_nowait(bytes([i]))
+        batch = await shard.get_batch(max_batch=3, linger_s=0.0)
+        assert len(batch) == 3  # capped
+        batch = await shard.get_batch(max_batch=8, linger_s=0.01)
+        assert len(batch) == 2  # linger expires with what's there
+
+        async def late_put():
+            await asyncio.sleep(0.01)
+            shard.put_nowait(b"late")
+
+        asyncio.ensure_future(late_put())
+        batch = await asyncio.wait_for(shard.get_batch(max_batch=2, linger_s=1.0), 5)
+        assert batch == [b"late"]  # blocks for the first item, then returns
+
+    asyncio.run(run())
+
+
+# --- coalescer ---------------------------------------------------------------
+
+
+class _ChannelStub:
+    """Records coalesced envelopes; resolves member futures like a phase."""
+
+    def __init__(self, member_error=None, batch_error=None):
+        self.batches = []
+        self.member_error = member_error
+        self.batch_error = batch_error
+
+    async def request(self, req):
+        assert isinstance(req, CoalescedUpdates)
+        self.batches.append(req)
+        if self.batch_error is not None:
+            raise self.batch_error
+        for fut in req.responses:
+            if self.member_error is None:
+                fut.set_result(None)
+            else:
+                fut.set_exception(self.member_error)
+
+
+def _update(i: int) -> UpdateRequest:
+    return UpdateRequest(participant_pk=bytes([i]) * 32, local_seed_dict={}, masked_model=None)
+
+
+def test_coalescer_batches_at_max_batch():
+    async def run():
+        tx = _ChannelStub()
+        co = UpdateCoalescer(tx, max_batch=3, linger_s=60.0)
+        for i in range(7):
+            await co.add(_update(i))
+        assert [len(b) for b in tx.batches] == [3, 3]
+        assert co.pending == 1
+        await co.flush()
+        assert [len(b) for b in tx.batches] == [3, 3, 1]
+        assert co.batches_sent == 3 and co.members_sent == 7
+
+    asyncio.run(run())
+
+
+def test_coalescer_linger_flush():
+    async def run():
+        tx = _ChannelStub()
+        co = UpdateCoalescer(tx, max_batch=100, linger_s=0.01)
+        await co.add(_update(0))
+        await co.add(_update(1))
+        assert tx.batches == []
+        await asyncio.sleep(0.1)
+        assert [len(b) for b in tx.batches] == [2]
+
+    asyncio.run(run())
+
+
+def test_coalescer_batch_rejection_reaches_members():
+    async def run():
+        err = RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase ended")
+        tx = _ChannelStub(batch_error=err)
+        co = UpdateCoalescer(tx, max_batch=2, linger_s=60.0)
+        f1 = await co.add(_update(0))
+        f2 = await co.add(_update(1))  # triggers the flush that is rejected
+        for fut in (f1, f2):
+            assert fut.done()
+            with pytest.raises(RequestError, match="phase ended"):
+                fut.result()
+
+    asyncio.run(run())
+
+
+def test_coalescer_close_after_channel_shutdown_does_not_hang():
+    """pipeline.stop() after the runner closed the request channel (cancel
+    path) must reject the buffered members promptly, never await a state
+    machine that will not answer."""
+    from xaynet_tpu.server.requests import RequestReceiver
+
+    async def run():
+        rx = RequestReceiver()
+        co = UpdateCoalescer(rx.sender(), max_batch=10, linger_s=60.0)
+        fut = await co.add(_update(0))
+        rx.close()
+        await asyncio.wait_for(co.close(), timeout=1.0)
+        assert fut.done()
+        with pytest.raises(RequestError, match="shut down"):
+            fut.result()
+
+    asyncio.run(run())
+
+
+# --- settings + pre-filter ---------------------------------------------------
+
+
+def test_ingest_settings_validation():
+    IngestSettings().validate()
+    with pytest.raises(SettingsError):
+        IngestSettings(shards=0).validate()
+    with pytest.raises(SettingsError):
+        IngestSettings(queue_bound=0).validate()
+    with pytest.raises(SettingsError):
+        IngestSettings(high_watermark=0.4, low_watermark=0.6).validate()
+    with pytest.raises(SettingsError):
+        IngestSettings(max_batch=0).validate()
+    with pytest.raises(SettingsError):
+        IngestSettings(retry_after_seconds=0).validate()
+
+
+def _stub_events(phase: PhaseName):
+    latest = SimpleNamespace(event=phase)
+    return SimpleNamespace(phase=SimpleNamespace(get_latest=lambda: latest))
+
+
+def test_pipeline_prefilter_drops_before_any_queue_slot():
+    async def run():
+        pipe = IngestPipeline(
+            handler=None,
+            request_tx=None,
+            events=_stub_events(PhaseName.IDLE),
+            settings=IngestSettings(enabled=True, shards=1, queue_bound=4),
+        )
+        # no phase accepts messages: dropped pre-decrypt, nothing enqueued
+        verdict = await pipe.submit(b"\x00" * 400)
+        assert verdict.verdict is Verdict.DROPPED
+        assert pipe.intake.occupancy == 0
+
+        pipe.events = _stub_events(PhaseName.SUM)
+        # structurally impossible ciphertext: shorter than seal + header
+        verdict = await pipe.submit(b"\x00" * 16)
+        assert verdict.verdict is Verdict.DROPPED
+        assert pipe.intake.occupancy == 0
+
+        verdict = await pipe.submit(b"\x00" * 400)
+        assert verdict.verdict is Verdict.ADMITTED
+        assert pipe.intake.occupancy == 1
+
+    asyncio.run(run())

@@ -62,6 +62,7 @@ def test_device_keystream_matches_host():
     assert np.array_equal(np.asarray(words), host)
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 @pytest.mark.parametrize("order", ORDERS)
 def test_device_sampler_matches_host(order):
     seed = b"\x05" * 32
@@ -82,6 +83,7 @@ def test_device_sampler_with_offset():
     assert got == expected
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 def test_device_sampler_chunked_multi_chunk():
     """A tiny chunk size forces many chunks; result must stay bit-exact."""
     seed = b"\x0c" * 32
@@ -100,6 +102,7 @@ def test_device_sampler_chunked_memory_bound():
     assert chacha_jax._CHUNK_BYTES_CAP // bpn < chacha_jax.provision_candidates(10**9, order)
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 def test_derive_mask_device_matches_host():
     seed = MaskSeed(b"\x21" * 32)
     mask_host = seed.derive_mask(100, CFG.pair())
@@ -137,6 +140,7 @@ def test_sharded_aggregator_full_round():
     assert np.array_equal(unmasked_limbs, host_limbs_ref)
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 def test_sum_masks_device():
     seeds = [bytes([i]) * 32 for i in range(1, 6)]
     n = 40
@@ -149,6 +153,7 @@ def test_sum_masks_device():
     assert np.array_equal(np.asarray(got_vect), agg.object.vect.data)
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 def test_sum_masks_device_multi_group():
     """More seeds than one seed_batch: the group-accumulate path (sum2 at
     protocol scale runs #updates/seed_batch of these)."""
@@ -163,6 +168,7 @@ def test_sum_masks_device_multi_group():
     assert np.array_equal(np.asarray(got_vect), agg.object.vect.data)
 
 
+@pytest.mark.slow  # minutes on the CPU-emulated mesh
 def test_derive_uniform_limbs_batch_matches_single():
     """Each row of the batched derivation is bit-identical to the single-seed
     kernel at the same byte offset, including the multi-chunk case."""

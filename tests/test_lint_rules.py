@@ -1,0 +1,83 @@
+"""The lint gate's coordinator-queue rule: bare unbounded asyncio.Queue()
+under xaynet_tpu/server/ and xaynet_tpu/ingest/ is rejected unless the line
+carries the '# lint: unbounded-ok' allowlist comment."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("xn_lint", REPO / "tools" / "lint.py")
+xn_lint = importlib.util.module_from_spec(spec)
+sys.modules["xn_lint"] = spec.loader.exec_module(xn_lint) or xn_lint
+
+
+def _check(tmp_path, monkeypatch, rel: str, source: str) -> list[str]:
+    monkeypatch.setattr(xn_lint, "REPO", tmp_path)
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return xn_lint.check_file(path)
+
+
+def test_unbounded_queue_rejected_in_server_tree(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/foo.py",
+        "import asyncio\nq = asyncio.Queue()\n",
+    )
+    assert any("unbounded asyncio.Queue()" in p for p in problems)
+
+
+def test_unbounded_queue_rejected_in_ingest_tree(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/ingest/foo.py",
+        "from asyncio import Queue\nq = Queue()\n",
+    )
+    assert any("unbounded asyncio.Queue()" in p for p in problems)
+
+
+def test_literal_zero_maxsize_counts_as_unbounded(tmp_path, monkeypatch):
+    source = (
+        "import asyncio\n"
+        "a = asyncio.Queue(0)\n"
+        "b = asyncio.Queue(maxsize=0)\n"
+        "c = asyncio.Queue(maxsize=-1)\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/ingest/foo.py", source)
+    assert sum("unbounded asyncio.Queue()" in p for p in problems) == 3
+
+
+def test_bounded_and_allowlisted_queues_pass(tmp_path, monkeypatch):
+    source = (
+        "import asyncio\n"
+        "a = asyncio.Queue(maxsize=8)\n"
+        "b = asyncio.Queue(16)\n"
+        "c = asyncio.Queue()  # lint: unbounded-ok\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/server/foo.py", source)
+    assert not any("unbounded" in p for p in problems)
+
+
+def test_rule_scoped_to_coordinator_trees(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/sdk/foo.py",
+        "import asyncio\nq = asyncio.Queue()\n",
+    )
+    assert not any("unbounded" in p for p in problems)
+
+
+def test_repo_tree_is_clean():
+    """The real tree passes its own gate (same assertion CI would make)."""
+    targets = [REPO / "xaynet_tpu" / "server", REPO / "xaynet_tpu" / "ingest"]
+    problems = []
+    for target in targets:
+        for path in sorted(target.rglob("*.py")):
+            problems.extend(xn_lint.check_file(path))
+    assert problems == []

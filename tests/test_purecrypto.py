@@ -1,0 +1,116 @@
+"""RFC conformance of the pure-stdlib crypto fallback (_purecrypto).
+
+These vectors pin the fallback to the exact primitives the ``cryptography``
+wheel implements, so an environment without the wheel computes
+byte-identical sealed boxes and signatures to one with it: X25519 (RFC 7748
+§5.2/§6.1), Ed25519 (RFC 8032 §7.1), ChaCha20-Poly1305 (RFC 8439 §2.8.2),
+HKDF-SHA256 (RFC 5869 A.1). The roundtrip tests exercise the *public*
+``core.crypto`` API, whichever backend it picked.
+"""
+
+import pytest
+
+from xaynet_tpu.core.crypto import _purecrypto as pc
+from xaynet_tpu.core.crypto.encrypt import DecryptError, EncryptKeyPair, PublicEncryptKey
+from xaynet_tpu.core.crypto.sign import SigningKeyPair, verify_detached
+
+
+def test_x25519_rfc7748_vectors():
+    a = bytes.fromhex("77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a")
+    b = bytes.fromhex("5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb")
+    pub_a = pc.x25519_public(a)
+    pub_b = pc.x25519_public(b)
+    assert pub_a == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert pub_b == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = bytes.fromhex("4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742")
+    assert pc.x25519(a, pub_b) == shared
+    assert pc.x25519(b, pub_a) == shared
+    # §5.2 single-iteration vector
+    k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c")
+    assert pc.x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+
+
+def test_ed25519_rfc8032_vectors():
+    seed = bytes.fromhex("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60")
+    pk = pc.ed25519_public(seed)
+    assert pk == bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig = pc.ed25519_sign(seed, b"")
+    assert sig == bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert pc.ed25519_verify(pk, sig, b"")
+    assert not pc.ed25519_verify(pk, sig, b"x")
+
+    seed3 = bytes.fromhex("c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7")
+    msg3 = bytes.fromhex("af82")
+    sig3 = pc.ed25519_sign(seed3, msg3)
+    assert sig3 == bytes.fromhex(
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+    )
+    assert pc.ed25519_verify(pc.ed25519_public(seed3), sig3, msg3)
+
+
+def test_ed25519_rejects_malleable_s():
+    seed = bytes.fromhex("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60")
+    sig = bytearray(pc.ed25519_sign(seed, b"m"))
+    s = int.from_bytes(sig[32:], "little") + pc._L
+    sig[32:] = s.to_bytes(32, "little")
+    assert not pc.ed25519_verify(pc.ed25519_public(seed), bytes(sig), b"m")
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    sealed = pc.chacha20poly1305_encrypt(key, nonce, plaintext, aad)
+    assert sealed[:16] == bytes.fromhex("d31a8d34648e60db7b86afbc53ef7ec2")
+    assert sealed[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert pc.chacha20poly1305_decrypt(key, nonce, sealed, aad) == plaintext
+    tampered = sealed[:-1] + bytes([sealed[-1] ^ 1])
+    with pytest.raises(pc.AeadTagError):
+        pc.chacha20poly1305_decrypt(key, nonce, tampered, aad)
+
+
+def test_hkdf_sha256_rfc5869_vector():
+    okm = pc.hkdf_sha256(
+        bytes([0x0B] * 22), bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"), 42, bytes(range(13))
+    )
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf34007208d5b887185865"
+    )
+
+
+def test_sealed_box_roundtrip_public_api():
+    """Whichever backend ``encrypt.py`` picked, the sealed box roundtrips
+    and authenticates."""
+    kp = EncryptKeyPair.derive_from_seed(b"\x07" * 32)
+    msg = b"masked model bytes" * 64
+    sealed = PublicEncryptKey(kp.public.as_bytes()).encrypt(msg)
+    assert kp.secret.decrypt(sealed) == msg
+    with pytest.raises(DecryptError):
+        kp.secret.decrypt(sealed[:-1] + bytes([sealed[-1] ^ 0x40]))
+    with pytest.raises(DecryptError):
+        kp.secret.decrypt(b"\x00" * 20)
+
+
+def test_signing_roundtrip_public_api():
+    keys = SigningKeyPair.derive_from_seed(b"\x09" * 32)
+    sig = keys.sign(b"round seed" + b"sum")
+    assert verify_detached(keys.public, sig.as_bytes(), b"round seed" + b"sum")
+    assert not verify_detached(keys.public, sig.as_bytes(), b"round seed" + b"update")
+    assert not verify_detached(b"\x00" * 32, sig.as_bytes(), b"round seed" + b"sum")

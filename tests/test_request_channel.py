@@ -1,0 +1,251 @@
+"""Request-channel lifecycle: depth gauge accuracy, shutdown, purge.
+
+Regression coverage for the ``xaynet_request_queue_depth`` gauge (it must
+move on enqueue, dequeue, phase-end purge AND close — drift here hides a
+phase falling behind its ingest) and for the channel edge cases: a closed
+channel must fail in-flight ``request()`` calls instead of hanging them,
+and stale-phase envelopes are rejected at phase end (including every member
+of a coalesced micro-batch).
+"""
+
+import asyncio
+
+import pytest
+
+from xaynet_tpu.server.events import PhaseName
+from xaynet_tpu.server.phases.base import PhaseState, Shared, _Counter
+from xaynet_tpu.server.requests import (
+    ChannelClosed,
+    CoalescedUpdates,
+    RequestError,
+    RequestReceiver,
+    SumRequest,
+    UpdateRequest,
+)
+from xaynet_tpu.telemetry.registry import get_registry
+
+
+def _depth() -> float:
+    return get_registry().sample_value("xaynet_request_queue_depth")
+
+
+def _req(i: int = 0) -> SumRequest:
+    return SumRequest(participant_pk=bytes([i]) * 32, ephm_pk=b"\x01" * 32)
+
+
+def _update_req(i: int = 0) -> UpdateRequest:
+    return UpdateRequest(participant_pk=bytes([i]) * 32, local_seed_dict={}, masked_model=None)
+
+
+def test_depth_gauge_tracks_enqueue_dequeue_and_purge():
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        futs = [asyncio.ensure_future(tx.request(_req(i))) for i in range(3)]
+        await asyncio.sleep(0)  # let the sends enqueue
+        assert _depth() == 3  # enqueue moves the gauge
+
+        env = await rx.next_request()
+        assert _depth() == 2  # dequeue moves the gauge
+        env.response.set_result(None)
+
+        # phase-end purge: reject everything still queued
+        shared = Shared(
+            state=None, request_rx=rx, events=None, store=None, settings=None, metrics=None
+        )
+        phase = PhaseState(shared)
+        await phase.purge_outdated_requests()
+        assert _depth() == 0  # purge moves the gauge
+
+        await futs[0]
+        for fut in futs[1:]:
+            with pytest.raises(RequestError, match="phase ended"):
+                await fut
+
+    asyncio.run(run())
+
+
+def test_close_never_counts_the_sentinel_and_zeroes_the_gauge():
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        fut = asyncio.ensure_future(tx.request(_req()))
+        await asyncio.sleep(0)
+        assert _depth() == 1
+        rx.close()
+        assert _depth() == 0  # queued request rejected; sentinel not counted
+        with pytest.raises(RequestError, match="shut down"):
+            await fut
+        with pytest.raises(ChannelClosed):
+            await rx.next_request()
+        assert _depth() == 0
+
+    asyncio.run(run())
+
+
+def test_close_fails_in_flight_request_instead_of_hanging():
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        in_flight = asyncio.ensure_future(tx.request(_req()))
+        await asyncio.sleep(0)  # request is enqueued, nobody consuming
+        rx.close()
+        with pytest.raises(RequestError, match="shut down"):
+            await asyncio.wait_for(in_flight, timeout=1.0)
+        # sends after close are refused immediately
+        with pytest.raises(RequestError, match="shut down"):
+            await tx.request(_req(1))
+
+    asyncio.run(run())
+
+
+def test_bounded_channel_rejects_overflow():
+    async def run():
+        rx = RequestReceiver(maxsize=2)
+        tx = rx.sender()
+        futs = [asyncio.ensure_future(tx.request(_req(i))) for i in range(2)]
+        await asyncio.sleep(0)
+        with pytest.raises(RequestError, match="channel full"):
+            await tx.request(_req(9))
+        rx.close()
+        for fut in futs:
+            with pytest.raises(RequestError):
+                await fut
+
+    asyncio.run(run())
+
+
+def test_purge_rejects_stale_phase_envelopes_including_coalesced_members():
+    """Envelopes left over when a phase ends are rejected — and a coalesced
+    micro-batch resolves EVERY member future, not just the envelope."""
+
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        loop = asyncio.get_running_loop()
+        members = [_update_req(1), _update_req(2)]
+        responses = [loop.create_future() for _ in members]
+        batch = CoalescedUpdates(members=members, responses=responses)
+        batch_fut = asyncio.ensure_future(tx.request(batch))
+        stale = asyncio.ensure_future(tx.request(_update_req(3)))
+        await asyncio.sleep(0)
+        assert _depth() == 2  # one coalesced envelope + one plain envelope
+
+        shared = Shared(
+            state=None, request_rx=rx, events=None, store=None, settings=None, metrics=None
+        )
+        await PhaseState(shared).purge_outdated_requests()
+        assert _depth() == 0
+
+        with pytest.raises(RequestError, match="phase ended"):
+            await batch_fut
+        with pytest.raises(RequestError, match="phase ended"):
+            await stale
+        for member in responses:
+            assert member.done()
+            with pytest.raises(RequestError, match="phase ended"):
+                member.result()
+
+    asyncio.run(run())
+
+
+def test_infrastructure_failure_mid_coalesced_batch_resolves_every_future():
+    """A non-protocol exception on member k must still resolve member k
+    (INTERNAL), every later member, and the envelope — a dangling future
+    would wedge the coalescer's shard worker for the life of the process."""
+
+    class BoomPhase(PhaseState):
+        NAME = PhaseName.UPDATE
+
+        async def handle_request(self, req):
+            if req.participant_pk[0] == 2:
+                raise RuntimeError("storage outage")
+
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        loop = asyncio.get_running_loop()
+        members = [_update_req(1), _update_req(2), _update_req(3)]
+        responses = [loop.create_future() for _ in members]
+        batch_fut = asyncio.ensure_future(
+            tx.request(CoalescedUpdates(members=members, responses=responses))
+        )
+        await asyncio.sleep(0)
+        env = await rx.next_request()
+        shared = Shared(
+            state=None, request_rx=rx, events=None, store=None, settings=None, metrics=None
+        )
+        with pytest.raises(RuntimeError, match="storage outage"):
+            await BoomPhase(shared)._process_single(env, _Counter(0, 10))
+        assert all(fut.done() for fut in responses)
+        assert responses[0].exception() is None  # accepted before the outage
+        with pytest.raises(RequestError, match="storage outage"):
+            responses[1].result()
+        with pytest.raises(RequestError, match="storage outage"):
+            responses[2].result()
+        with pytest.raises(RequestError, match="storage outage"):
+            await batch_fut
+
+    asyncio.run(run())
+
+
+def test_cancellation_mid_coalesced_batch_resolves_every_future():
+    """The phase window expiring (wait_for cancellation) mid-batch must
+    resolve the envelope and every member future, same as an exception."""
+
+    class HangPhase(PhaseState):
+        NAME = PhaseName.UPDATE
+
+        async def handle_request(self, req):
+            if req.participant_pk[0] == 2:
+                await asyncio.Event().wait()  # parks until cancelled
+
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        loop = asyncio.get_running_loop()
+        members = [_update_req(1), _update_req(2), _update_req(3)]
+        responses = [loop.create_future() for _ in members]
+        batch_fut = asyncio.ensure_future(
+            tx.request(
+                CoalescedUpdates(members=members, responses=responses, request_ids=list("abc"))
+            )
+        )
+        await asyncio.sleep(0)
+        env = await rx.next_request()
+        shared = Shared(
+            state=None, request_rx=rx, events=None, store=None, settings=None, metrics=None
+        )
+        worker = asyncio.ensure_future(HangPhase(shared)._process_single(env, _Counter(0, 10)))
+        await asyncio.sleep(0.05)  # member 1 accepted, member 2 parked
+        worker.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await worker
+        assert all(fut.done() for fut in responses)
+        assert responses[0].exception() is None
+        for parked in responses[1:]:
+            with pytest.raises(RequestError):
+                parked.result()
+        with pytest.raises(RequestError):
+            await asyncio.wait_for(batch_fut, timeout=1.0)
+
+    asyncio.run(run())
+
+
+def test_close_rejects_coalesced_members():
+    async def run():
+        rx = RequestReceiver()
+        tx = rx.sender()
+        loop = asyncio.get_running_loop()
+        responses = [loop.create_future()]
+        batch_fut = asyncio.ensure_future(
+            tx.request(CoalescedUpdates(members=[_update_req()], responses=responses))
+        )
+        await asyncio.sleep(0)
+        rx.close()
+        with pytest.raises(RequestError, match="shut down"):
+            await batch_fut
+        with pytest.raises(RequestError, match="shut down"):
+            responses[0].result()
+
+    asyncio.run(run())

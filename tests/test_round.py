@@ -421,6 +421,7 @@ def test_sum_participant_save_restore_mid_round():
     asyncio.run(asyncio.wait_for(run(), timeout=60))
 
 
+@pytest.mark.slow  # ~2 min per config on the 8-device virtual CPU mesh
 @pytest.mark.parametrize(
     "group_type,data_type,model_type",
     [

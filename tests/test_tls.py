@@ -6,6 +6,10 @@ import ssl
 
 import pytest
 
+# certificate generation needs the real wheel (x509 is not covered by the
+# pure-python fallback primitives)
+pytest.importorskip("cryptography")
+
 from xaynet_tpu.server.rest import RestServer
 from xaynet_tpu.server.services import Fetcher, PetMessageHandler
 from xaynet_tpu.server.settings import Settings

@@ -20,6 +20,12 @@ Checks:
     ``xaynet_tpu.telemetry`` (profiling hooks / histogram timers) so it shows
     up on ``GET /metrics`` and in round reports. Annotate a deliberate
     exception with ``# telemetry-exempt`` on the offending line.
+  - bare unbounded ``asyncio.Queue()`` construction under
+    ``xaynet_tpu/server`` and ``xaynet_tpu/ingest``: every coordinator-side
+    queue must either carry a maxsize or sit behind the admission-controlled
+    intake. Annotate a deliberate exception (e.g. the request channel whose
+    bound lives upstream, or a shutdown sentinel channel) with
+    ``# lint: unbounded-ok`` on the offending line.
 
 Usage: python tools/lint.py [paths...]   (default: the repo tree)
 """
@@ -117,6 +123,36 @@ def _used_in_annotations(tree: ast.AST) -> set[str]:
     return out
 
 
+def _is_unbounded_queue(node: ast.Call) -> bool:
+    """True for ``asyncio.Queue()`` / ``Queue()`` constructed without a size,
+    or with a literal non-positive one (asyncio treats ``maxsize <= 0`` as
+    unbounded). Non-constant sizes are trusted — the rule is syntactic."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        is_queue = func.attr == "Queue" and (
+            isinstance(func.value, ast.Name) and func.value.id == "asyncio"
+        )
+    elif isinstance(func, ast.Name):
+        is_queue = func.id == "Queue"
+    else:
+        is_queue = False
+    if not is_queue:
+        return False
+    size = node.args[0] if node.args else None
+    if size is None:
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                size = kw.value
+                break
+    if size is None:
+        return True
+    if isinstance(size, ast.Constant) and isinstance(size.value, (int, float)):
+        return size.value <= 0
+    if isinstance(size, ast.UnaryOp) and isinstance(size.op, ast.USub):
+        return isinstance(size.operand, ast.Constant)
+    return False
+
+
 def check_file(path: Path) -> list[str]:
     problems: list[str] = []
     rel = path.relative_to(REPO)
@@ -171,7 +207,12 @@ def check_file(path: Path) -> list[str]:
 
     # hot-path trees: raw perf_counter timing bypasses the telemetry layer
     hot_path = str(rel).startswith(("xaynet_tpu/parallel", "xaynet_tpu/server"))
+    # coordinator queue trees: unbounded queues defeat admission control
+    bounded_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/ingest"))
     src_lines = text.splitlines()
+
+    def line_of(node: ast.AST) -> str:
+        return src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
 
     for node in ast.walk(tree):
         if hot_path and isinstance(node, ast.Call):
@@ -182,13 +223,19 @@ def check_file(path: Path) -> list[str]:
                 else func.id if isinstance(func, ast.Name) else ""
             )
             if callee == "perf_counter":
-                line_text = src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
-                if "telemetry-exempt" not in line_text:
+                if "telemetry-exempt" not in line_of(node):
                     problems.append(
                         f"{rel}:{node.lineno}: raw perf_counter timing bypasses the "
                         "telemetry registry (use xaynet_tpu.telemetry.profiling or a "
                         "registry histogram timer)"
                     )
+        if bounded_tree and isinstance(node, ast.Call) and _is_unbounded_queue(node):
+            if "lint: unbounded-ok" not in line_of(node):
+                problems.append(
+                    f"{rel}:{node.lineno}: unbounded asyncio.Queue() in the "
+                    "coordinator tree (pass a maxsize, or annotate a deliberate "
+                    "sentinel/upstream-bounded channel with '# lint: unbounded-ok')"
+                )
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in list(node.args.defaults) + [
                 d for d in node.args.kw_defaults if d is not None

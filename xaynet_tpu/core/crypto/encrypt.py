@@ -11,6 +11,11 @@ Construction: ``eph_pk(32) || ChaCha20Poly1305(msg)`` with
 ``key = HKDF-SHA256(X25519(eph_sk, pk), info = eph_pk || pk)`` and a zero
 nonce (the key is single-use). Overhead = 32 + 16 = 48 bytes = SEALBYTES,
 matching the reference's wire constant.
+
+Backend: the ``cryptography`` wheel when importable, otherwise the
+pure-stdlib RFC-conformant fallback (``_purecrypto``) — byte-identical
+output, not constant-time; fine for tests/simulation, pip the wheel for
+production coordinators.
 """
 
 from __future__ import annotations
@@ -18,11 +23,18 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:  # native primitives when the wheel is present ...
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    _HAVE_CRYPTO = True
+except ImportError:  # ... pure-stdlib fallback otherwise (see _purecrypto)
+    from . import _purecrypto
+
+    _HAVE_CRYPTO = False
 
 SEALBYTES = 48
 PUBLIC_KEY_LENGTH = 32
@@ -37,12 +49,10 @@ class DecryptError(ValueError):
 
 
 def _derive_key(shared: bytes, eph_pk: bytes, recipient_pk: bytes) -> bytes:
-    hkdf = HKDF(
-        algorithm=hashes.SHA256(),
-        length=32,
-        salt=None,
-        info=b"xaynet-tpu-sealedbox" + eph_pk + recipient_pk,
-    )
+    info = b"xaynet-tpu-sealedbox" + eph_pk + recipient_pk
+    if not _HAVE_CRYPTO:
+        return _purecrypto.hkdf_sha256(shared, info, 32)
+    hkdf = HKDF(algorithm=hashes.SHA256(), length=32, salt=None, info=info)
     return hkdf.derive(shared)
 
 
@@ -59,12 +69,18 @@ class PublicEncryptKey:
 
     def encrypt(self, message: bytes) -> bytes:
         """Seal ``message`` for this public key (anyone can seal)."""
-        eph_sk = X25519PrivateKey.generate()
-        eph_pk = eph_sk.public_key().public_bytes_raw()
-        shared = eph_sk.exchange(X25519PublicKey.from_public_bytes(self.bytes_))
+        if _HAVE_CRYPTO:
+            eph_sk = X25519PrivateKey.generate()
+            eph_pk = eph_sk.public_key().public_bytes_raw()
+            shared = eph_sk.exchange(X25519PublicKey.from_public_bytes(self.bytes_))
+            key = _derive_key(shared, eph_pk, self.bytes_)
+            ct = ChaCha20Poly1305(key).encrypt(_ZERO_NONCE, message, None)
+            return eph_pk + ct
+        eph_seed = os.urandom(32)
+        eph_pk = _purecrypto.x25519_public(eph_seed)
+        shared = _purecrypto.x25519(eph_seed, self.bytes_)
         key = _derive_key(shared, eph_pk, self.bytes_)
-        ct = ChaCha20Poly1305(key).encrypt(_ZERO_NONCE, message, None)
-        return eph_pk + ct
+        return eph_pk + _purecrypto.chacha20poly1305_encrypt(key, _ZERO_NONCE, message)
 
 
 @dataclass(frozen=True)
@@ -79,8 +95,10 @@ class SecretEncryptKey:
         return self.bytes_
 
     def public_key(self) -> PublicEncryptKey:
-        sk = X25519PrivateKey.from_private_bytes(self.bytes_)
-        return PublicEncryptKey(sk.public_key().public_bytes_raw())
+        if _HAVE_CRYPTO:
+            sk = X25519PrivateKey.from_private_bytes(self.bytes_)
+            return PublicEncryptKey(sk.public_key().public_bytes_raw())
+        return PublicEncryptKey(_purecrypto.x25519_public(self.bytes_))
 
     def decrypt(self, sealed: bytes, pk: "PublicEncryptKey | None" = None) -> bytes:
         """Open a sealed box addressed to this key.
@@ -92,12 +110,19 @@ class SecretEncryptKey:
             raise DecryptError("sealed box too short")
         my_pk = pk.as_bytes() if pk is not None else self.public_key().as_bytes()
         eph_pk, ct = sealed[:32], sealed[32:]
-        sk = X25519PrivateKey.from_private_bytes(self.bytes_)
-        shared = sk.exchange(X25519PublicKey.from_public_bytes(eph_pk))
+        if _HAVE_CRYPTO:
+            sk = X25519PrivateKey.from_private_bytes(self.bytes_)
+            shared = sk.exchange(X25519PublicKey.from_public_bytes(eph_pk))
+            key = _derive_key(shared, eph_pk, my_pk)
+            try:
+                return ChaCha20Poly1305(key).decrypt(_ZERO_NONCE, ct, None)
+            except InvalidTag as e:
+                raise DecryptError("sealed box authentication failed") from e
+        shared = _purecrypto.x25519(self.bytes_, eph_pk)
         key = _derive_key(shared, eph_pk, my_pk)
         try:
-            return ChaCha20Poly1305(key).decrypt(_ZERO_NONCE, ct, None)
-        except InvalidTag as e:
+            return _purecrypto.chacha20poly1305_decrypt(key, _ZERO_NONCE, ct)
+        except _purecrypto.AeadTagError as e:
             raise DecryptError("sealed box authentication failed") from e
 
 
@@ -108,6 +133,8 @@ class EncryptKeyPair:
 
     @classmethod
     def generate(cls) -> "EncryptKeyPair":
+        if not _HAVE_CRYPTO:
+            return cls.derive_from_seed(os.urandom(SEED_LENGTH))
         sk = X25519PrivateKey.generate()
         return cls(
             public=PublicEncryptKey(sk.public_key().public_bytes_raw()),
@@ -119,6 +146,11 @@ class EncryptKeyPair:
         """Deterministic keypair from a 32-byte seed."""
         if len(seed) != SEED_LENGTH:
             raise ValueError("seed must be 32 bytes")
+        if not _HAVE_CRYPTO:
+            return cls(
+                public=PublicEncryptKey(_purecrypto.x25519_public(seed)),
+                secret=SecretEncryptKey(bytes(seed)),
+            )
         sk = X25519PrivateKey.from_private_bytes(seed)
         return cls(
             public=PublicEncryptKey(sk.public_key().public_bytes_raw()),

@@ -15,8 +15,18 @@ import os
 from dataclasses import dataclass
 from fractions import Fraction
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey, Ed25519PublicKey
+try:  # native Ed25519 when the wheel is present, pure-stdlib fallback otherwise
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _HAVE_CRYPTO = True
+except ImportError:
+    from . import _purecrypto
+
+    _HAVE_CRYPTO = False
 
 from .hash import sha256
 
@@ -66,6 +76,8 @@ class SigningKeyPair:
     def derive_from_seed(cls, seed: bytes) -> "SigningKeyPair":
         if len(seed) != SEED_LENGTH:
             raise ValueError("seed must be 32 bytes")
+        if not _HAVE_CRYPTO:
+            return cls(public=_purecrypto.ed25519_public(seed), secret=seed)
         sk = Ed25519PrivateKey.from_private_bytes(seed)
         return cls(public=sk.public_key().public_bytes_raw(), secret=seed)
 
@@ -74,10 +86,17 @@ class SigningKeyPair:
 
 
 def sign_detached(secret: bytes, data: bytes) -> bytes:
+    if not _HAVE_CRYPTO:
+        return _purecrypto.ed25519_sign(secret, data)
     return Ed25519PrivateKey.from_private_bytes(secret).sign(data)
 
 
 def verify_detached(public: bytes, signature: bytes, data: bytes) -> bool:
+    if not _HAVE_CRYPTO:
+        try:
+            return _purecrypto.ed25519_verify(public, signature, data)
+        except ValueError:
+            return False
     try:
         Ed25519PublicKey.from_public_bytes(public).verify(signature, data)
         return True

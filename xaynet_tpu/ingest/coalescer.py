@@ -1,0 +1,98 @@
+"""Update coalescing: many verified updates, one channel envelope.
+
+During the update phase each accepted message costs a channel envelope, a
+phase wakeup, and eventually a fold dispatch. The coalescer buffers
+verified ``UpdateRequest``s for up to ``max_batch`` messages or
+``linger_s`` seconds and submits them as ONE ``CoalescedUpdates`` envelope;
+the update phase processes members in order (validation + seed-dict insert
+stay per-member, so the seed-dict/masked-model pairing is never reordered)
+and folds the whole micro-batch as a single stacked ``masked_add``
+dispatch. During sum/sum2 the pipeline bypasses the coalescer entirely —
+those requests are per-message by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..server.requests import CoalescedUpdates, RequestError, RequestSender, UpdateRequest
+from ..utils import tracing
+from .admission import BATCH_SIZE_HIST, AdmissionController
+
+
+class UpdateCoalescer:
+    """Micro-batches ``UpdateRequest``s into ``CoalescedUpdates`` envelopes."""
+
+    def __init__(self, request_tx: RequestSender, max_batch: int = 32, linger_s: float = 0.002):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.request_tx = request_tx
+        self.max_batch = max_batch
+        self.linger_s = linger_s
+        self._buf: list[tuple[UpdateRequest, asyncio.Future, str]] = []
+        self._linger_task: Optional[asyncio.Task] = None
+        self.batches_sent = 0
+        self.members_sent = 0
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+    async def add(self, req: UpdateRequest) -> asyncio.Future:
+        """Buffer one verified update; returns its member future.
+
+        The caller need not await the future — member rejections are
+        consumed and counted here so an abandoned future never warns.
+        """
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(_consume_member_result)
+        self._buf.append((req, fut, tracing.current_request_id()))
+        if len(self._buf) >= self.max_batch:
+            await self.flush()
+        elif self._linger_task is None:
+            self._linger_task = asyncio.create_task(self._linger_flush())
+        return fut
+
+    async def _linger_flush(self) -> None:
+        await asyncio.sleep(self.linger_s)
+        self._linger_task = None
+        await self.flush()
+
+    async def flush(self) -> None:
+        """Submit the buffered micro-batch as one envelope (no-op if empty).
+
+        Blocks until the state machine has handled the whole batch — the
+        ingest worker behind ``add`` therefore backpressures naturally.
+        """
+        if self._linger_task is not None:
+            self._linger_task.cancel()
+            self._linger_task = None
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        batch = CoalescedUpdates(
+            members=[req for req, _, _ in buf],
+            responses=[fut for _, fut, _ in buf],
+            request_ids=[rid for _, _, rid in buf],
+        )
+        BATCH_SIZE_HIST.labels(stage="coalesce").observe(len(batch))
+        self.batches_sent += 1
+        self.members_sent += len(batch)
+        try:
+            await self.request_tx.request(batch)
+        except RequestError as err:
+            # batch-level rejection (purge at phase end, shutdown): members
+            # that the phase never reached inherit the batch verdict
+            batch.reject_members(err)
+
+    async def close(self) -> None:
+        await self.flush()
+
+
+def _consume_member_result(fut: asyncio.Future) -> None:
+    if fut.cancelled():
+        return
+    err = fut.exception()
+    if err is not None:
+        AdmissionController.count_rejection("state-machine")

@@ -1,0 +1,197 @@
+"""The ingest pipeline: pre-filter -> admission -> shards -> workers.
+
+Wiring order per message:
+
+1. **pre-filter** (on the REST task, before any queue slot or crypto):
+   structural length check (a ciphertext shorter than sealed-box overhead +
+   message header cannot contain a PET message) and the wrong-phase gate —
+   during idle/unmask/failure/shutdown NO ciphertext can be valid, so the
+   message is dropped before sealed-box decryption. The tag-level phase
+   filter (sum message during update, ...) still runs right after the
+   sealed-box open and *before* signature verification / payload parse in
+   ``services._decrypt_parse_one`` — the sealed box hides the tag, so
+   pre-decrypt filtering cannot see it (docs/DESIGN.md §7).
+2. **admission** — watermark verdict; shed means HTTP 429 + Retry-After.
+3. **intake shard** — bounded queue, round-robin.
+4. **decrypt worker** (one task per shard) — drains a batch, ONE
+   thread-pool hop decrypts + verifies + task-validates all of it, then
+   submits: updates through the coalescer, everything else per-message.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..core.crypto.encrypt import SEALBYTES
+from ..core.message.message import HEADER_LENGTH
+from ..server.events import PhaseName
+from ..server.requests import RequestError, RequestSender, UpdateRequest, request_from_message
+from ..server.services import PetMessageHandler, ServiceError
+from ..server.settings import IngestSettings
+from ..utils import tracing
+from .admission import BATCH_SIZE_HIST, Admission, AdmissionController
+from .coalescer import UpdateCoalescer
+from .intake import ShardedIntake, ShardFull
+
+logger = logging.getLogger("xaynet.ingest")
+
+# phases whose tag can appear in a valid ciphertext; anything else is shed
+# before we even pay for the sealed-box open
+_INGESTIBLE = {PhaseName.SUM, PhaseName.UPDATE, PhaseName.SUM2}
+
+_MIN_CIPHERTEXT = SEALBYTES + HEADER_LENGTH
+
+
+class IngestPipeline:
+    """Admission-controlled, batched path from REST to the state machine."""
+
+    def __init__(
+        self,
+        handler: PetMessageHandler,
+        request_tx: RequestSender,
+        events,
+        settings: IngestSettings,
+    ):
+        settings.validate()
+        self.handler = handler
+        self.request_tx = request_tx
+        self.events = events
+        self.settings = settings
+        self.intake = ShardedIntake(settings.shards, settings.queue_bound)
+        self.admission = AdmissionController(
+            capacity=self.intake.capacity,
+            high_watermark=settings.high_watermark,
+            low_watermark=settings.low_watermark,
+            retry_after_seconds=settings.retry_after_seconds,
+        )
+        self.coalescer = (
+            UpdateCoalescer(
+                request_tx,
+                max_batch=settings.coalesce_max_batch,
+                linger_s=settings.coalesce_linger_ms / 1000.0,
+            )
+            if settings.coalesce
+            else None
+        )
+        self._workers: list[asyncio.Task] = []
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._workers:
+            return
+        self._workers = [
+            asyncio.create_task(self._worker(shard), name=f"ingest-worker-{shard.index}")
+            for shard in self.intake.shards
+        ]
+        logger.info(
+            "ingest pipeline up: %d shards x %d bound, decrypt batch <= %d, coalesce %s",
+            self.settings.shards,
+            self.settings.queue_bound,
+            self.settings.max_batch,
+            f"<= {self.settings.coalesce_max_batch}" if self.coalescer else "off",
+        )
+
+    async def stop(self) -> None:
+        for task in self._workers:
+            task.cancel()
+        for task in self._workers:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._workers = []
+        if self.coalescer is not None:
+            await self.coalescer.close()
+
+    @property
+    def running(self) -> bool:
+        return bool(self._workers)
+
+    # --- intake -----------------------------------------------------------
+
+    def _phase(self) -> PhaseName:
+        return self.events.phase.get_latest().event
+
+    async def submit(self, encrypted: bytes) -> Admission:
+        """Admit, shed, or drop one encrypted message (REST entry point)."""
+        if len(encrypted) < _MIN_CIPHERTEXT or self._phase() not in _INGESTIBLE:
+            # cheap pre-decrypt rejection: structurally impossible, or no
+            # phase is accepting messages at all
+            return self.admission.dropped("pre-filter")
+        verdict = self.admission.admit(self.intake.occupancy)
+        if verdict.shed:
+            return verdict
+        try:
+            self.intake.put_nowait(encrypted)
+        except ShardFull:
+            return self.admission.shed_shard_full(self.intake.occupancy)
+        self.admission.count_admitted()
+        return verdict
+
+    # --- drain ------------------------------------------------------------
+
+    async def _worker(self, shard) -> None:
+        while True:
+            batch = await shard.get_batch(
+                self.settings.max_batch, self.settings.linger_ms / 1000.0
+            )
+            self.intake.drained()
+            self.admission.observe(self.intake.occupancy)
+            BATCH_SIZE_HIST.labels(stage="decrypt").observe(len(batch))
+            try:
+                await self._process(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a poisoned batch must not kill the shard's worker
+                logger.exception("ingest worker %d: batch failed", shard.index)
+
+    async def _process(self, batch: list[bytes]) -> None:
+        results = await self.handler.process_batch(batch)
+        submits = []
+        coalescing = self.coalescer is not None and self._phase() is PhaseName.UPDATE
+        for res in results:
+            if res is None:
+                continue  # multipart chunk absorbed
+            if isinstance(res, ServiceError):
+                self.admission.count_rejection(res.stage)
+                continue
+            request_id = tracing.new_request_id()
+            req = request_from_message(res)
+            if coalescing and isinstance(req, UpdateRequest):
+                await self.coalescer.add(req)  # captures the current id
+            else:
+                submits.append(self._submit_one(req, request_id))
+        if submits:
+            await asyncio.gather(*submits)
+        if self.coalescer is not None and self.coalescer.pending:
+            # don't leave a partial micro-batch lingering when the shard
+            # queue is empty anyway — latency buys nothing here
+            if self.intake.occupancy == 0:
+                await self.coalescer.flush()
+
+    async def _submit_one(self, req, request_id: str) -> None:
+        # the coroutine runs later under gather, so the message's tracing id
+        # must be re-entered here — reading the ambient contextvar would
+        # stamp every envelope of the batch with the LAST message's id
+        try:
+            with tracing.use_request_id(request_id):
+                await self.request_tx.request(req)
+        except RequestError:
+            self.admission.count_rejection("state-machine")
+
+    # --- health -----------------------------------------------------------
+
+    def health(self) -> dict:
+        """Saturation snapshot for GET /healthz."""
+        occupancy = self.intake.occupancy
+        self.admission.observe(occupancy)
+        return {
+            "saturated": self.admission.saturated,
+            "occupancy": occupancy,
+            "capacity": self.intake.capacity,
+            "shards": len(self.intake.shards),
+            "running": self.running,
+        }

@@ -30,6 +30,25 @@ logger = logging.getLogger(__name__)
 
 _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it at top level with ``check_vma``; 0.4.x ships it in
+    ``jax.experimental.shard_map`` with the equivalent ``check_rep`` knob
+    (pallas_call's out_shape carries no vma/rep either way, so the check is
+    disabled in both).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    return _exp_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
 # auto-calibration verdicts, process-wide: a long-running coordinator builds
 # a fresh aggregator every round but the (backend, shape, order) question has
 # the same answer every time
@@ -265,12 +284,11 @@ class ShardedAggregator:
                     # shard_map makes the kernel multichip without a custom
                     # partitioner; the outer jit restores accumulator donation
                     fn = jax.jit(
-                        jax.shard_map(
+                        _shard_map(
                             call,
                             mesh=self.mesh,
                             in_specs=(P(None, MODEL_AXIS), P(None, None, MODEL_AXIS)),
                             out_specs=P(None, MODEL_AXIS),
-                            check_vma=False,  # pallas_call's out_shape carries no vma
                         ),
                         donate_argnums=(0,),
                     )
@@ -297,12 +315,11 @@ class ShardedAggregator:
         unpack_mask = _build_wire_unpack(bpn, self.order, multi)
         if multi:
             fn = jax.jit(
-                jax.shard_map(
+                _shard_map(
                     unpack_mask,
                     mesh=self.mesh,
                     in_specs=(P(None, MODEL_AXIS),),
                     out_specs=(P(None, None, MODEL_AXIS), P()),
-                    check_vma=False,
                 )
             )
         else:
@@ -329,12 +346,11 @@ class ShardedAggregator:
 
         if multi:
             fn = jax.jit(
-                jax.shard_map(
+                _shard_map(
                     ingest,
                     mesh=self.mesh,
                     in_specs=(P(None, MODEL_AXIS), P(None, MODEL_AXIS)),
                     out_specs=(P(None, MODEL_AXIS), P()),
-                    check_vma=False,
                 ),
                 donate_argnums=(0,),
             )

@@ -1,14 +1,31 @@
-"""Simulation helpers: deterministic role assignment for in-process rounds.
+"""Simulation helpers: deterministic role assignment and load generation.
 
 PET task selection is probabilistic over each participant's Ed25519 key and
 the round seed. For simulations and tests we need participants with *known*
 roles, so we rejection-sample signing keys until the eligibility check lands
 on the desired task — the protocol itself stays untouched.
+
+``flood`` drives N concurrent, fully valid update uploads (deterministic
+keys via ``keys_for_task``) against a ``PetMessageHandler`` or an
+``ingest.IngestPipeline`` — the load generator behind the shed/admit stress
+tests.
 """
 
 from __future__ import annotations
 
+import asyncio
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..core.common import RoundParameters
+from ..core.crypto.encrypt import PublicEncryptKey
 from ..core.crypto.sign import SigningKeyPair, is_eligible
+from ..core.mask.masking import Masker
+from ..core.mask.model import Scalar
+from ..core.message import Message, Update
 
 
 def keys_for_task(
@@ -36,3 +53,135 @@ def keys_for_task(
         if role == want:
             return keys
     raise RuntimeError(f"no key found for task {want} in {max_tries} tries")
+
+
+def build_update_message(
+    params: RoundParameters,
+    keys: SigningKeyPair,
+    sum_dict: dict,
+    model,
+    scalar: Fraction = Fraction(1),
+) -> bytes:
+    """One fully valid, sealed update upload for an update-task participant.
+
+    The exact client-side pipeline (mask -> seed-dict encrypt -> sign ->
+    sealed box) without the participant state machine around it — what a
+    load generator needs.
+    """
+    masker = Masker(params.mask_config)
+    seed, masked_model = masker.mask(Scalar.from_fraction(scalar), np.asarray(model))
+    payload = Update(
+        sum_signature=keys.sign(params.seed.as_bytes() + b"sum").as_bytes(),
+        update_signature=keys.sign(params.seed.as_bytes() + b"update").as_bytes(),
+        masked_model=masked_model,
+        local_seed_dict={
+            sum_pk: seed.encrypt(PublicEncryptKey(ephm_pk))
+            for sum_pk, ephm_pk in sum_dict.items()
+        },
+    )
+    message = Message(participant_pk=keys.public, coordinator_pk=params.pk, payload=payload)
+    return PublicEncryptKey(params.pk).encrypt(message.to_bytes(keys.secret))
+
+
+@dataclass
+class FloodStats:
+    """Outcome counts of one ``flood`` run.
+
+    ``accepted`` means the target took the message (handler completed, or
+    the pipeline admitted it — admitted messages resolve asynchronously);
+    ``rejected`` counts pipeline-stage/protocol drops surfaced at submit
+    time; ``shed`` counts admission-control refusals (429 upstream).
+    """
+
+    sent: int = 0
+    accepted: int = 0
+    rejected: int = 0
+    shed: int = 0
+
+
+async def flood(
+    target,
+    params: RoundParameters,
+    sum_dict: dict,
+    n: int,
+    *,
+    models: Optional[Sequence] = None,
+    scalar: Optional[Fraction] = None,
+    key_start: int = 0,
+    key_spacing: int = 1000,
+    concurrency: int = 64,
+    build: Optional[Callable[[int], bytes]] = None,
+) -> FloodStats:
+    """Drive ``n`` concurrent valid update uploads against ``target``.
+
+    ``target`` is a ``PetMessageHandler`` (awaits each message's verdict),
+    an ``ingest.IngestPipeline`` (admission verdicts), or any async callable
+    of one ``bytes`` argument. Keys are deterministic — participant ``i``
+    searches from ``key_start + i * key_spacing`` — so repeated floods in
+    the same round collide on purpose (duplicate-participant rejections)
+    and distinct ``key_start`` ranges never do. ``build`` overrides message
+    construction (e.g. pre-sealed garbage for decrypt-path floods).
+    """
+    if models is None:
+        rng = np.random.default_rng(key_start or 7)
+        models = [
+            rng.uniform(-1, 1, params.model_length).astype(np.float32) for _ in range(n)
+        ]
+    scalar = scalar if scalar is not None else Fraction(1, max(1, n))
+    seed = params.seed.as_bytes()
+
+    def default_build(i: int) -> bytes:
+        keys = keys_for_task(
+            seed, params.sum, params.update, "update", start=key_start + i * key_spacing
+        )
+        return build_update_message(params, keys, sum_dict, models[i % len(models)], scalar)
+
+    build = build or default_build
+    # sealing is CPU-bound and deterministic: do it before the clock starts
+    sealed = [build(i) for i in range(n)]
+
+    submit = _submitter(target)
+    stats = FloodStats()
+    gate = asyncio.Semaphore(max(1, concurrency))
+
+    async def one(blob: bytes) -> None:
+        async with gate:
+            stats.sent += 1
+            outcome = await submit(blob)
+            setattr(stats, outcome, getattr(stats, outcome) + 1)
+
+    await asyncio.gather(*(one(blob) for blob in sealed))
+    return stats
+
+
+def _submitter(target):
+    """Normalize the three target kinds to ``async (bytes) -> outcome``."""
+    from ..server.requests import RequestError
+    from ..server.services import ServiceError
+
+    if hasattr(target, "submit"):  # ingest.IngestPipeline
+
+        async def submit_pipeline(blob: bytes) -> str:
+            verdict = await target.submit(blob)
+            if verdict.shed:
+                return "shed"
+            return "accepted" if verdict.verdict.value == "admitted" else "rejected"
+
+        return submit_pipeline
+
+    if hasattr(target, "handle_message"):  # PetMessageHandler
+
+        async def submit_handler(blob: bytes) -> str:
+            try:
+                await target.handle_message(blob)
+                return "accepted"
+            except (ServiceError, RequestError):
+                return "rejected"
+
+        return submit_handler
+
+    async def submit_callable(blob: bytes) -> str:
+        await target(blob)
+        return "accepted"
+
+    return submit_callable

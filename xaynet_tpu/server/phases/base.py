@@ -25,7 +25,13 @@ from typing import TYPE_CHECKING, Optional
 from ...storage.traits import Store
 from ...utils import tracing
 from ..events import EventPublisher, PhaseName
-from ..requests import ChannelClosed, RequestError, RequestReceiver, StateMachineRequest
+from ..requests import (
+    ChannelClosed,
+    CoalescedUpdates,
+    RequestError,
+    RequestReceiver,
+    StateMachineRequest,
+)
 from ..settings import PhaseSettings, Settings, Sum2Settings
 
 if TYPE_CHECKING:
@@ -108,6 +114,10 @@ class PhaseState:
     async def handle_request(self, req: StateMachineRequest) -> None:
         """Phase-specific request handling; raises ``RequestError`` to reject."""
         raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase accepts no requests")
+
+    async def coalesced_batch_done(self, n: int) -> None:
+        """Hook: a coalesced micro-batch of ``n`` members was just processed
+        (the update phase flushes its staged fold here)."""
 
     # --- run loop ---------------------------------------------------------
 
@@ -202,6 +212,31 @@ class PhaseState:
             await self._process_single(env, counter)
 
     async def _process_single(self, env, counter: _Counter) -> None:
+        if isinstance(env.request, CoalescedUpdates):
+            # unpack the micro-batch: every member is counted, handled and
+            # answered exactly as if it had arrived alone (count.min/max
+            # protocol semantics are per UPDATE, not per envelope), then the
+            # phase gets one batch-done hook for the stacked fold dispatch
+            try:
+                for member_env in env.request.envelopes(env.request_id):
+                    await self._process_single(member_env, counter)
+                await self.coalesced_batch_done(len(env.request))
+            except BaseException as err:
+                # infrastructure failure OR cancellation (phase window
+                # expiring) mid-batch: EVERY future must still resolve — a
+                # dangling member would wedge the coalescer (and its shard
+                # worker) for the life of the process
+                failure = (
+                    err
+                    if isinstance(err, RequestError)
+                    else RequestError(
+                        RequestError.Kind.INTERNAL, str(err) or type(err).__name__
+                    )
+                )
+                self._respond(env, failure)  # fans out to pending members
+                raise
+            self._respond(env, None)
+            return
         if counter.has_overmuch:
             counter.discarded += 1
             if self.shared.metrics is not None:
@@ -221,11 +256,15 @@ class PhaseState:
                 self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
             self._respond(env, err)
             return
-        except Exception as err:
-            # infrastructure failure (e.g. storage outage): resolve the
-            # requester's future before the phase error propagates, or the
-            # client would wait forever on a round that already failed
-            self._respond(env, RequestError(RequestError.Kind.INTERNAL, str(err)))
+        except BaseException as err:
+            # infrastructure failure (e.g. storage outage) or cancellation
+            # (phase window expiring mid-handle): resolve the requester's
+            # future before the phase error propagates, or the client would
+            # wait forever on a round that already failed
+            self._respond(
+                env,
+                RequestError(RequestError.Kind.INTERNAL, str(err) or type(err).__name__),
+            )
             raise
         counter.accepted += 1
         self._record_handled(t0)
@@ -244,6 +283,10 @@ class PhaseState:
 
     @staticmethod
     def _respond(env, error: Optional[Exception]) -> None:
+        if error is not None and isinstance(env.request, CoalescedUpdates):
+            # purge / infrastructure failure on a whole micro-batch: members
+            # the phase never reached inherit the envelope's verdict
+            env.request.reject_members(error)
         if env.response.done():
             return
         if error is None:

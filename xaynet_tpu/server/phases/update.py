@@ -79,3 +79,10 @@ class UpdatePhase(PhaseState):
             # fold off the event loop so the API stays responsive during
             # large folds; handle_request awaits it, so folds serialize
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
+
+    async def coalesced_batch_done(self, n: int) -> None:
+        """One stacked fold per coalesced micro-batch: the whole batch of
+        staged updates goes to the aggregator as a single ``masked_add``
+        dispatch, amortizing host->HBM transfer and kernel launch."""
+        if self.aggregator.pending:
+            await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)

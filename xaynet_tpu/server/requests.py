@@ -61,7 +61,43 @@ class Sum2Request:
     model_mask: MaskObject
 
 
-StateMachineRequest = Union[SumRequest, UpdateRequest, Sum2Request]
+@dataclass
+class CoalescedUpdates:
+    """A micro-batch of verified ``UpdateRequest``s travelling as ONE
+    channel envelope (built by ``ingest.coalescer``).
+
+    Each member keeps its own response future: the phase resolves them
+    individually, so one rejected update never fails its batch-mates, and
+    the seed-dict insert stays paired with its masked model per member.
+    ``request_ids`` (parallel to ``members``, optional) preserves each
+    message's tracing id through the batch.
+    """
+
+    members: list[UpdateRequest]
+    responses: list[asyncio.Future]
+    request_ids: Optional[list[str]] = None
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def envelopes(self, fallback_request_id: str = "-"):
+        """One per-member ``_Envelope``, carrying the member's own tracing
+        id (so batched log lines keep per-message correlation)."""
+        ids = self.request_ids or [fallback_request_id] * len(self.members)
+        return [
+            _Envelope(req, fut, rid)
+            for req, fut, rid in zip(self.members, self.responses, ids)
+        ]
+
+    def reject_members(self, error: Exception) -> None:
+        """Resolve every still-pending member future with ``error`` (purge
+        at phase end, channel shutdown, infrastructure failure)."""
+        for fut in self.responses:
+            if not fut.done():
+                fut.set_exception(error)
+
+
+StateMachineRequest = Union[SumRequest, UpdateRequest, Sum2Request, CoalescedUpdates]
 
 
 def request_from_message(message: Message) -> StateMachineRequest:
@@ -89,15 +125,45 @@ class _Envelope:
 
 
 class RequestReceiver:
-    """The state machine's end of the request channel."""
+    """The state machine's end of the request channel.
 
-    def __init__(self):
-        self._queue: asyncio.Queue[Optional[_Envelope]] = asyncio.Queue()
+    ``maxsize`` bounds the channel (0 = unbounded, the historical default;
+    deployments running the admission-controlled ingest pipeline are bounded
+    upstream by the intake shards). The depth gauge tracks REAL envelopes
+    only — the shutdown sentinel is never counted — and is kept in sync on
+    enqueue, dequeue, phase-end purge (via ``try_recv``) and close.
+    """
+
+    def __init__(self, maxsize: int = 0):
+        # one queue carries both envelopes and the single shutdown sentinel;
+        # the +1 slack below keeps a full bounded channel closable
+        self._queue: asyncio.Queue[Optional[_Envelope]] = (
+            # unbounded only on request: ingest deployments bound upstream
+            asyncio.Queue()  # lint: unbounded-ok
+            if maxsize <= 0
+            else asyncio.Queue(maxsize + 1)
+        )
+        self.maxsize = maxsize
+        self._depth = 0
         self._closed = False
 
+    def _enqueue(self, env: _Envelope) -> None:
+        if self._closed:
+            raise RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
+        if self.maxsize and self._depth >= self.maxsize:
+            raise RequestError(RequestError.Kind.INTERNAL, "request channel full")
+        self._queue.put_nowait(env)
+        self._depth += 1
+        _QUEUE_DEPTH.set(self._depth)
+
+    def _dequeued(self, env: Optional[_Envelope]) -> Optional[_Envelope]:
+        if env is not None:
+            self._depth -= 1
+            _QUEUE_DEPTH.set(self._depth)
+        return env
+
     async def next_request(self) -> _Envelope:
-        env = await self._queue.get()
-        _QUEUE_DEPTH.set(self._queue.qsize())
+        env = self._dequeued(await self._queue.get())
         if env is None:
             raise ChannelClosed()
         return env
@@ -108,13 +174,31 @@ class RequestReceiver:
             env = self._queue.get_nowait()
         except asyncio.QueueEmpty:
             return None
-        _QUEUE_DEPTH.set(self._queue.qsize())
+        env = self._dequeued(env)
         if env is None:
             raise ChannelClosed()
         return env
 
     def close(self) -> None:
+        """Shut the channel: every queued request is rejected immediately so
+        an in-flight ``request()`` can never hang on a dead state machine."""
+        if self._closed:
+            return
         self._closed = True
+        error = RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
+        while True:
+            try:
+                env = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if env is None:
+                continue
+            if isinstance(env.request, CoalescedUpdates):
+                env.request.reject_members(error)
+            if not env.response.done():
+                env.response.set_exception(error)
+        self._depth = 0
+        _QUEUE_DEPTH.set(0)
         self._queue.put_nowait(None)
 
     def sender(self) -> "RequestSender":
@@ -131,14 +215,22 @@ class RequestSender:
     def __init__(self, receiver: RequestReceiver):
         self._receiver = receiver
 
+    def close(self) -> None:
+        """Shut the channel from the services' side.
+
+        The runner uses this on the cancel path: a cancelled state machine
+        never reaches the Shutdown phase (which closes the channel in normal
+        termination), and draining components — the ingest pipeline's final
+        coalescer flush in particular — must fail fast instead of awaiting a
+        request nobody will ever handle.
+        """
+        self._receiver.close()
+
     async def request(self, req: StateMachineRequest) -> None:
         """Submit a request and await the state machine's verdict.
 
         Raises ``RequestError`` when the request is rejected/discarded.
         """
-        if self._receiver._closed:
-            raise RequestError(RequestError.Kind.INTERNAL, "state machine is shut down")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._receiver._queue.put_nowait(_Envelope(req, fut, tracing.current_request_id()))
-        _QUEUE_DEPTH.set(self._receiver._queue.qsize())
+        self._receiver._enqueue(_Envelope(req, fut, tracing.current_request_id()))
         await fut

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import ssl
 import time
 from typing import Optional
@@ -52,14 +53,19 @@ class RestServer:
         handler: PetMessageHandler,
         read_timeout: float = 120.0,
         registry: Optional[MetricsRegistry] = None,
+        pipeline=None,
     ):
         # `registry` selects what GET /metrics renders. Hot-path modules
         # (request queue, message pipeline, kernel profiling, dispatcher)
         # record into the PROCESS registry at import time, so a custom
         # registry exposes only the families created against it (unit
         # tests); production keeps the default.
+        # `pipeline` (ingest.IngestPipeline) switches POST /message to the
+        # admission-controlled path: 429 + Retry-After under saturation, and
+        # /healthz gains the intake section. None keeps the direct path.
         self.fetcher = fetcher
         self.handler = handler
+        self.pipeline = pipeline
         self.read_timeout = read_timeout  # slow-client defense
         self.registry = registry if registry is not None else get_registry()
         self._started_at = time.monotonic()
@@ -113,8 +119,8 @@ class RestServer:
                     else b""
                 )
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-                status, payload, ctype = await self._route(method, target, body)
-                await self._respond(writer, status, payload, ctype, keep_alive)
+                status, payload, ctype, extra = await self._route(method, target, body)
+                await self._respond(writer, status, payload, ctype, keep_alive, extra)
                 if not keep_alive:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.TimeoutError):
@@ -126,17 +132,20 @@ class RestServer:
             except Exception:
                 pass
 
-    async def _route(self, method: str, target: str, body: bytes) -> tuple[int, bytes, str]:
+    async def _route(self, method: str, target: str, body: bytes):
         url = urlparse(target)
-        status, payload, ctype = await self._dispatch(method, url, body)
+        # handlers return (status, payload, ctype) or + an extra-headers dict
+        result = await self._dispatch(method, url, body)
+        status, payload, ctype = result[:3]
+        extra = result[3] if len(result) > 3 else None
         self._http_requests.labels(
             method=method if method in _KNOWN_METHODS else "other",
             path=url.path if url.path in _KNOWN_PATHS else "other",
             status=status,
         ).inc()
-        return status, payload, ctype
+        return status, payload, ctype, extra
 
-    async def _dispatch(self, method: str, url, body: bytes) -> tuple[int, bytes, str]:
+    async def _dispatch(self, method: str, url, body: bytes):
         path = url.path
         try:
             if method == "POST" and path == "/message":
@@ -176,6 +185,11 @@ class RestServer:
                 payload = self._health_payload()
                 payload["status"] = "ok"
                 payload["uptime_seconds"] = round(time.monotonic() - self._started_at, 3)
+                if self.pipeline is not None:
+                    ingest = self.pipeline.health()
+                    payload["ingest"] = ingest
+                    if ingest["saturated"]:
+                        payload["status"] = "saturated"
                 return 200, json.dumps(payload).encode(), "application/json"
             if method == "GET" and path == "/health":
                 return 200, json.dumps(self._health_payload()).encode(), "application/json"
@@ -196,7 +210,21 @@ class RestServer:
             "round_id": self.fetcher.events.params.get_latest().round_id,
         }
 
-    async def _post_message(self, body: bytes) -> tuple[int, bytes, str]:
+    async def _post_message(self, body: bytes):
+        if self.pipeline is not None:
+            verdict = await self.pipeline.submit(body)
+            if verdict.shed:
+                retry = str(max(1, math.ceil(verdict.retry_after)))
+                return (
+                    429,
+                    b"intake saturated; retry later",
+                    "text/plain",
+                    {"Retry-After": retry},
+                )
+            # admitted (processed asynchronously) or pre-filter drop: both
+            # answer 200 — the reference reports drops via round
+            # progression, not the POST status
+            return 200, b"", "text/plain"
         try:
             await self.handler.handle_message(body)
         except (ServiceError, RequestError) as err:
@@ -212,6 +240,7 @@ class RestServer:
         payload: bytes,
         ctype: str = "text/plain",
         keep_alive: bool = False,
+        extra_headers: Optional[dict] = None,
     ) -> None:
         reason = {
             200: "OK",
@@ -219,12 +248,17 @@ class RestServer:
             400: "Bad Request",
             404: "Not Found",
             413: "Payload Too Large",
+            429: "Too Many Requests",
             500: "Internal Server Error",
         }.get(status, "")
+        extra = "".join(
+            f"{name}: {value}\r\n" for name, value in (extra_headers or {}).items()
+        )
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(payload)}\r\n"
+            f"{extra}"
             f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
         ).encode()
         writer.write(head + payload)

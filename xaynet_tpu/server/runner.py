@@ -129,7 +129,13 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         events, request_tx, wire_ingest=settings.aggregation.wire_ingest
     )
     fetcher = Fetcher(events)
-    rest = RestServer(fetcher, handler, registry=metrics.registry)
+    pipeline = None
+    if settings.ingest.enabled:
+        from ..ingest import IngestPipeline
+
+        pipeline = IngestPipeline(handler, request_tx, events, settings.ingest)
+        await pipeline.start()
+    rest = RestServer(fetcher, handler, registry=metrics.registry, pipeline=pipeline)
     host, _, port = settings.api.bind_address.partition(":")
     tls = None
     if settings.api.tls_certificate:
@@ -158,7 +164,14 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         pass
     finally:
         machine_task.cancel()
+        # a cancelled machine never reaches the Shutdown phase, so close the
+        # request channel here: queued/in-flight requests are rejected and
+        # the pipeline's final coalescer flush fails fast instead of
+        # awaiting a state machine that will never answer
+        request_tx.close()
         await rest.stop()
+        if pipeline is not None:
+            await pipeline.stop()
         # flush the in-flight round report and drain the dispatcher thread's
         # queued tail — without this the InfluxHttp dispatcher dies with
         # whatever was still batching

@@ -11,7 +11,11 @@ settings/mod.rs:307-376).
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: env/default settings still work
+    tomllib = None
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -162,6 +166,52 @@ class AggregationSettings:
 
 
 @dataclass
+class IngestSettings:
+    """Admission-controlled batched ingest (``xaynet_tpu.ingest``).
+
+    Defaults keep single-node behavior identical to the direct path: the
+    pipeline is off unless enabled, and when enabled the bounds are generous
+    enough that an un-saturated coordinator never sheds.
+    """
+
+    enabled: bool = False
+    # bounded intake topology: total capacity = shards * queue_bound
+    shards: int = 2
+    queue_bound: int = 1024  # per-shard ceiling (hard bound, never exceeded)
+    # admission hysteresis as fractions of total capacity: shed at/above
+    # high, resume below low (low <= high)
+    high_watermark: float = 0.8
+    low_watermark: float = 0.5
+    # decrypt worker pool: drain up to max_batch messages per thread-pool
+    # hop, waiting at most linger_ms for the batch to fill
+    max_batch: int = 32
+    linger_ms: float = 2.0
+    # update coalescing: group verified UpdateRequests into micro-batches
+    # submitted to the state machine (and folded) as one stacked dispatch
+    coalesce: bool = True
+    coalesce_max_batch: int = 32
+    coalesce_linger_ms: float = 2.0
+    # Retry-After floor handed to shed clients (seconds)
+    retry_after_seconds: float = 1.0
+
+    def validate(self) -> None:
+        if self.shards < 1:
+            raise SettingsError("ingest.shards must be >= 1")
+        if self.queue_bound < 1:
+            raise SettingsError("ingest.queue_bound must be >= 1")
+        if not (0.0 < self.low_watermark <= self.high_watermark <= 1.0):
+            raise SettingsError(
+                "ingest watermarks must satisfy 0 < low <= high <= 1"
+            )
+        if self.max_batch < 1 or self.coalesce_max_batch < 1:
+            raise SettingsError("ingest batch sizes must be >= 1")
+        if self.linger_ms < 0 or self.coalesce_linger_ms < 0:
+            raise SettingsError("ingest linger must be >= 0")
+        if self.retry_after_seconds <= 0:
+            raise SettingsError("ingest.retry_after_seconds must be > 0")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -172,10 +222,12 @@ class Settings:
     metrics: MetricsSettings = field(default_factory=MetricsSettings)
     log: LoggingSettings = field(default_factory=LoggingSettings)
     aggregation: AggregationSettings = field(default_factory=AggregationSettings)
+    ingest: IngestSettings = field(default_factory=IngestSettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
+        self.ingest.validate()
         if self.model.length < 1:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
@@ -213,8 +265,12 @@ class Settings:
         """Load from TOML (optional) with ``XAYNET__SECTION__KEY`` env overrides."""
         raw: dict[str, Any] = {}
         if path is not None:
-            with open(path, "rb") as f:
-                raw = tomllib.load(f)
+            if tomllib is not None:
+                with open(path, "rb") as f:
+                    raw = tomllib.load(f)
+            else:
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = _mini_toml(f.read())
         env = dict(os.environ if env is None else env)
         for key, value in env.items():
             if not key.startswith("XAYNET__"):
@@ -259,6 +315,7 @@ class Settings:
         metrics_raw = raw.get("metrics", {})
         log_raw = raw.get("log", {})
         agg_raw = raw.get("aggregation", {})
+        ingest_raw = raw.get("ingest", {})
 
         return cls(
             pet=PetSettings(
@@ -310,7 +367,80 @@ class Settings:
                 kernel=str(agg_raw.get("kernel", base.aggregation.kernel)),
                 wire_ingest=bool(agg_raw.get("wire_ingest", base.aggregation.wire_ingest)),
             ),
+            ingest=IngestSettings(
+                enabled=bool(ingest_raw.get("enabled", base.ingest.enabled)),
+                shards=int(ingest_raw.get("shards", base.ingest.shards)),
+                queue_bound=int(ingest_raw.get("queue_bound", base.ingest.queue_bound)),
+                high_watermark=float(
+                    ingest_raw.get("high_watermark", base.ingest.high_watermark)
+                ),
+                low_watermark=float(
+                    ingest_raw.get("low_watermark", base.ingest.low_watermark)
+                ),
+                max_batch=int(ingest_raw.get("max_batch", base.ingest.max_batch)),
+                linger_ms=float(ingest_raw.get("linger_ms", base.ingest.linger_ms)),
+                coalesce=bool(ingest_raw.get("coalesce", base.ingest.coalesce)),
+                coalesce_max_batch=int(
+                    ingest_raw.get("coalesce_max_batch", base.ingest.coalesce_max_batch)
+                ),
+                coalesce_linger_ms=float(
+                    ingest_raw.get("coalesce_linger_ms", base.ingest.coalesce_linger_ms)
+                ),
+                retry_after_seconds=float(
+                    ingest_raw.get("retry_after_seconds", base.ingest.retry_after_seconds)
+                ),
+            ),
         )
+
+
+def _mini_toml(text: str) -> dict:
+    """TOML-subset parser for Python < 3.11 (no ``tomllib``).
+
+    Covers exactly what the coordinator configs use: ``[dotted.section]``
+    headers, ``key = value`` with string/bool/int/float scalars, comments
+    and blank lines. Anything fancier (arrays, inline tables, multi-line
+    strings) raises — better a loud error than silently dropped settings.
+    """
+    root: dict[str, Any] = {}
+    node = root
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("[") and stripped.endswith("]"):
+            header = stripped[1:-1].strip()
+            if header.startswith("[") or header.endswith("]"):
+                raise SettingsError(
+                    f"config line {lineno}: arrays of tables ({stripped!r}) are "
+                    "not supported by the tomllib fallback parser"
+                )
+            node = root
+            for part in header.split("."):
+                node = node.setdefault(part.strip(), {})
+            continue
+        key, eq, value = stripped.partition("=")
+        if not eq:
+            raise SettingsError(f"config line {lineno}: expected 'key = value'")
+        value = value.strip()
+        # strip a trailing comment (quote-aware for string values)
+        if value.startswith('"'):
+            end = value.find('"', 1)
+            if end < 0:
+                raise SettingsError(f"config line {lineno}: unterminated string")
+            trailing = value[end + 1 :].split("#", 1)[0].strip()
+            if trailing:
+                raise SettingsError(
+                    f"config line {lineno}: unexpected content after string: {trailing!r}"
+                )
+            node[key.strip()] = value[1:end]
+            continue
+        value = value.split("#", 1)[0].strip()
+        coerced = _coerce(value)  # same bool/int/float ladder as env overrides
+        if isinstance(coerced, str):
+            # unquoted non-scalar (array, inline table, bareword): loud error
+            raise SettingsError(f"config line {lineno}: unsupported value {value!r}")
+        node[key.strip()] = coerced
+    return root
 
 
 def _coerce(value: str):
