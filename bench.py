@@ -93,15 +93,23 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    # persistent compile cache: a brief tunnel-up window must not be spent
-    # recompiling kernels a previous capture already built (~20-40s each)
-    try:
-        cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # cache is an optimization, never a failure
-        print(f"compilation cache unavailable: {e}", file=sys.stderr)
+    # persistent compile cache — accelerator runs only: a brief tunnel-up
+    # window must not be spent recompiling kernels a previous capture
+    # already built (~20-40s each). On CPU the cache is a net negative: the
+    # shared-container fleet migrates between host types, so a cached CPU
+    # executable regularly fails XLA's machine-feature check and every load
+    # spews the multi-KB "CPU compilation doesn't match the machine type
+    # ... could lead to execution errors such as SIGILL" warning over the
+    # bench tail and kernel-selection log, while CPU kernels recompile in
+    # seconds anyway.
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        try:
+            cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # cache is an optimization, never a failure
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
 
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
     from xaynet_tpu.ops import limbs as host_limbs
@@ -194,9 +202,18 @@ def main() -> None:
         from xaynet_tpu.utils import native as native_lib
 
         order_limbs = host_limbs.order_limbs_for(order)
+        _native_spare = {"buf": None}
 
         def _native(a, s):
-            return host_limbs.fold_planar_batch_host(a, host_stack_np, order_limbs)
+            # ping-pong the result buffer: a fresh 200 MB np.empty per fold
+            # costs ~0.15 s of page faults — the dropped accumulator becomes
+            # the next spare (same trick as the aggregator's native kernel)
+            out = host_limbs.fold_planar_batch_host(
+                a, host_stack_np, order_limbs, out=_native_spare["buf"]
+            )
+            reusable = out is not a and isinstance(a, np.ndarray) and a.flags.writeable
+            _native_spare["buf"] = a if reusable else None
+            return out
 
         def _zero_acc_np():
             return np.zeros((n_limb, model_len), dtype=np.uint32)
@@ -236,8 +253,10 @@ def main() -> None:
 
     # median of >=3 repetitions with min/max spread (VERDICT r04 weak 1):
     # the r4 headline (26.4) sat 17% under a same-code mid-round draw (30.8)
-    # purely from shared-container noise — one draw is not defensible
-    reps = 3
+    # purely from shared-container noise — one draw is not defensible. CPU
+    # reps are ~1s each, so take 5 there (two bad draws can no longer drag
+    # the median); TPU reps stay at 3 (tunnel-window budget)
+    reps = 3 if on_tpu else 5
     rep_ups = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -247,6 +266,72 @@ def main() -> None:
         dt = time.perf_counter() - t0
         rep_ups.append(k * n_batches / dt)
     ups = float(np.median(rep_ups))
+    # streaming vs sync: the SAME staged-per-batch aggregation through the
+    # production ShardedAggregator — sequential add_batch (stage then fold,
+    # serialized) vs the streaming pipeline (ring-buffer staging of batch
+    # N+1 overlapping the fold of batch N). The headline above measures the
+    # bare fold; this field tracks what the pipeline overlap buys on the
+    # full stage+fold path. CPU-only: the TPU capture path never holds a
+    # host-side wire copy of the stack (per-slice staging, tunnel limits).
+    streaming_vs_sync = None
+    if not on_tpu:
+        try:
+            # the comparison runs at half the headline batch so its extra
+            # footprint (wire copy + 2 ring buffers + a second aggregator,
+            # ~3x one half-batch) stays well inside the remaining headroom;
+            # a cgroup OOM kill here would lose the headline JSON entirely,
+            # which a try/except cannot catch — so gate on CURRENT
+            # MemAvailable and skip rather than gamble
+            k_s = max(2, k // 2)
+            extra_kb = int(3.5 * k_s * n_limb * model_len * 4) // 1024
+            try:
+                with open("/proc/meminfo") as f:
+                    avail_now_kb = next(
+                        int(line.split()[1])
+                        for line in f
+                        if line.startswith("MemAvailable:")
+                    )
+            except (OSError, StopIteration):
+                avail_now_kb = extra_kb * 2  # no meminfo: proceed (tiny smoke)
+            if avail_now_kb < extra_kb * 2:
+                raise MemoryError(
+                    f"skipping: {avail_now_kb // 1024} MB available, "
+                    f"comparison needs ~{extra_kb // 1024} MB"
+                )
+            from xaynet_tpu.parallel.aggregator import ShardedAggregator
+            from xaynet_tpu.parallel.streaming import StreamingAggregator
+
+            wire_stack = np.ascontiguousarray(host_stack_np[:k_s].transpose(0, 2, 1))
+            b_batches = 3
+            seq = ShardedAggregator(config, model_len, kernel="auto")
+            seq.add_batch(wire_stack)  # resolve kernel + warm
+            t0 = time.perf_counter()
+            for _ in range(b_batches):
+                seq.add_batch(wire_stack)
+            _sync(np.asarray(seq.acc))
+            t_sync = time.perf_counter() - t0
+            stream_agg = ShardedAggregator(config, model_len, kernel=seq.kernel_used)
+            stream = StreamingAggregator(
+                stream_agg, staging_buffers=2, dispatch_ahead=2, max_batch=k_s
+            )
+            stream.submit_batch(wire_stack)
+            stream.drain()  # warm (kernel resolve + ring page-in)
+            t0 = time.perf_counter()
+            for _ in range(b_batches):
+                stream.submit_batch(wire_stack)
+            stream.drain()
+            t_stream = time.perf_counter() - t0
+            stream.close()
+            streaming_vs_sync = round(t_sync / t_stream, 3)
+            print(
+                f"streaming_vs_sync: sync {t_sync:.2f}s vs streaming {t_stream:.2f}s "
+                f"-> {streaming_vs_sync}x (kernel {seq.kernel_used}, k={k_s})",
+                file=sys.stderr,
+            )
+            del wire_stack
+        except Exception as e:  # diagnostics must never sink the headline
+            print(f"streaming_vs_sync unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+
     # scale CPU smoke runs to the 25M-param metric so the number is comparable
     scale = model_len / 25_000_000
     scaled_ups = ups * scale
@@ -273,6 +358,7 @@ def main() -> None:
                 "platform": platform,
                 "kernel": best,
                 "model_len": model_len,
+                "streaming_vs_sync": streaming_vs_sync,
                 "spread": {
                     "median_of": reps,
                     "min": round(min(rep_ups) * scale, 2),

@@ -14,6 +14,7 @@
 // Built as a plain shared library; loaded via ctypes (no pybind11).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -284,50 +285,107 @@ XN_EXPORT void xn_mod_add(const uint32_t* a, const uint32_t* b, uint32_t* out,
 
 namespace {
 
-// Shared core of the single-pass u64 batch folds. `Wire` selects the data
-// layout: planar uint32[L, n] (limb-major) or wire uint32[n, L] (for L == 2
-// a wire row is one little-endian u64 — contiguous 8-byte loads). The
-// arithmetic — double-reciprocal quotient with two rounding fixups, u64
-// wraparound on pow2-boundary orders — lives exactly once here.
+// Worker-thread count for the batch folds: XAYNET_NATIVE_THREADS overrides
+// (values < 1 mean single-threaded), otherwise 2x hardware_concurrency
+// capped at 16. The folds are bandwidth-bound; the 2x oversubscription is
+// deliberate — on the small shared-container CPU quotas the coordinator
+// runs under, extra runnable threads hide per-thread DRAM stalls and
+// scheduler preemption (measured ~15% over 1x at the 25M bench shape on a
+// 2-CPU cgroup), while the cap keeps big hosts from spawning threads well
+// past the memory channels.
+unsigned fold_threads() {
+  static const unsigned cached = [] {
+    const char* env = std::getenv("XAYNET_NATIVE_THREADS");
+    if (env && *env) {
+      const long v = std::strtol(env, nullptr, 10);
+      if (v < 1) return 1u;
+      return (unsigned)(v > 64 ? 64 : v);
+    }
+    unsigned hc = std::thread::hardware_concurrency();
+    if (hc == 0) hc = 1;
+    const unsigned t = 2 * hc;
+    return t > 16 ? 16u : t;
+  }();
+  return cached;
+}
+
+// Run fn(s0, s1) over contiguous slices of [0, n): the fold's element axis
+// is embarrassingly parallel, so each thread owns a disjoint slice and no
+// merge step exists. Slices align to `align` (the fold's BLOCK size) and a
+// minimum slice keeps tiny folds single-threaded — thread spawn (~10us)
+// must never dominate a sub-millisecond fold.
+template <typename F>
+void run_sliced(uint64_t n, uint64_t align, F&& fn) {
+  unsigned nt = fold_threads();
+  constexpr uint64_t MIN_SLICE = 1ull << 19;  // 512k elements (~4 MB of u64 sums)
+  if (nt > 1) {
+    const uint64_t cap = n / MIN_SLICE;
+    if (cap < nt) nt = (unsigned)(cap ? cap : 1);
+  }
+  if (nt <= 1) {
+    fn((uint64_t)0, n);
+    return;
+  }
+  uint64_t chunk = (n + nt - 1) / nt;
+  chunk = (chunk + align - 1) / align * align;
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (unsigned t = 0; t < nt; t++) {
+    const uint64_t s0 = (uint64_t)t * chunk;
+    if (s0 >= n) break;
+    const uint64_t s1 = s0 + chunk < n ? s0 + chunk : n;
+    threads.emplace_back([&fn, s0, s1] { fn(s0, s1); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Shared core of the single-pass u64 batch folds, one element slice
+// [s0, s1). `Wire` selects the data layout: planar uint32[L, n]
+// (limb-major) or wire uint32[n, L] (for L == 2 a wire row is one
+// little-endian u64 — contiguous 8-byte loads). The arithmetic —
+// double-reciprocal quotient with two rounding fixups, u64 wraparound on
+// pow2-boundary orders — lives exactly once here.
 template <bool Wire>
-void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
-                   uint32_t n_limbs, uint64_t k, const uint32_t* order_limbs) {
-  uint64_t order = 0;
-  for (uint32_t j = 0; j < n_limbs; j++) order |= (uint64_t)order_limbs[j] << (32 * j);
+void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
+                    uint32_t n_limbs, uint64_t k, uint64_t order, uint64_t s0, uint64_t s1) {
   const bool pow2_boundary = order == 0;
   const bool two_limbs = n_limbs == 2;
   // quotient sum/order is tiny (< K+1): one double multiply approximates it
   // to +-1 and two fixups make it exact — far cheaper than a u64 divide
   const double inv_order = pow2_boundary ? 0.0 : 1.0 / (double)order;
 
-  const auto load2 = [n](const uint32_t* base, uint64_t s, uint64_t i) -> uint64_t {
-    if (Wire) {
-      const uint32_t* row = base + 2 * (s + i);
-      return (uint64_t)row[0] | ((uint64_t)row[1] << 32);
-    }
-    return (uint64_t)base[s + i] | ((uint64_t)base[n + s + i] << 32);
-  };
-  const auto store2 = [n](uint32_t* base, uint64_t s, uint64_t i, uint64_t v) {
-    if (Wire) {
-      base[2 * (s + i)] = (uint32_t)v;
-      base[2 * (s + i) + 1] = (uint32_t)(v >> 32);
-    } else {
-      base[s + i] = (uint32_t)v;
-      base[n + s + i] = (uint32_t)(v >> 32);
-    }
-  };
-
   // i-blocked so every inner loop is a flat auto-vectorizable stream and
   // the u64 partial sums stay in L1/L2 while the K streams are read once
   constexpr uint64_t BLOCK = 4096;
   uint64_t sum[BLOCK];
-  for (uint64_t s = 0; s < n; s += BLOCK) {
-    const uint64_t bn = (n - s) < BLOCK ? (n - s) : BLOCK;
+  for (uint64_t s = s0; s < s1; s += BLOCK) {
+    const uint64_t bn = (s1 - s) < BLOCK ? (s1 - s) : BLOCK;
     if (two_limbs) {
-      for (uint64_t i = 0; i < bn; i++) sum[i] = load2(acc, s, i);
-      for (uint64_t kk = 0; kk < k; kk++) {
-        const uint32_t* up = stack + kk * 2 * n;
-        for (uint64_t i = 0; i < bn; i++) sum[i] += load2(up, s, i);
+      if (Wire) {
+        for (uint64_t i = 0; i < bn; i++) {
+          const uint32_t* row = acc + 2 * (s + i);
+          sum[i] = (uint64_t)row[0] | ((uint64_t)row[1] << 32);
+        }
+        for (uint64_t kk = 0; kk < k; kk++) {
+          const uint32_t* up = stack + kk * 2 * n + 2 * s;
+          for (uint64_t i = 0; i < bn; i++)
+            sum[i] += (uint64_t)up[2 * i] | ((uint64_t)up[2 * i + 1] << 32);
+        }
+      } else {
+        // planar: walk the lo and hi limb planes as two lockstep
+        // CONTIGUOUS streams (lo[i] / hi[i]) rather than indexing both
+        // through one base pointer — measured ~1.5x on the 25M bench
+        // shape (the prefetcher tracks two unit-stride streams)
+        const uint32_t* alo = acc + s;
+        const uint32_t* ahi = acc + n + s;
+        for (uint64_t i = 0; i < bn; i++)
+          sum[i] = (uint64_t)alo[i] | ((uint64_t)ahi[i] << 32);
+        for (uint64_t kk = 0; kk < k; kk++) {
+          const uint32_t* lo = stack + kk * 2 * n + s;
+          const uint32_t* hi = lo + n;
+          for (uint64_t i = 0; i < bn; i++)
+            sum[i] += (uint64_t)lo[i] | ((uint64_t)hi[i] << 32);
+        }
       }
     } else {
       for (uint64_t i = 0; i < bn; i++) sum[i] = acc[s + i];
@@ -349,21 +407,45 @@ void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, ui
       for (uint64_t i = 0; i < bn; i++) sum[i] &= 0xFFFFFFFFull;
     }  // order == 2^64: u64 arithmetic wraps naturally
     if (two_limbs) {
-      for (uint64_t i = 0; i < bn; i++) store2(out, s, i, sum[i]);
+      if (Wire) {
+        for (uint64_t i = 0; i < bn; i++) {
+          out[2 * (s + i)] = (uint32_t)sum[i];
+          out[2 * (s + i) + 1] = (uint32_t)(sum[i] >> 32);
+        }
+      } else {
+        uint32_t* olo = out + s;
+        uint32_t* ohi = out + n + s;
+        for (uint64_t i = 0; i < bn; i++) {
+          olo[i] = (uint32_t)sum[i];
+          ohi[i] = (uint32_t)(sum[i] >> 32);
+        }
+      }
     } else {
       for (uint64_t i = 0; i < bn; i++) out[s + i] = (uint32_t)sum[i];
     }
   }
 }
 
+template <bool Wire>
+void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
+                   uint32_t n_limbs, uint64_t k, const uint32_t* order_limbs) {
+  uint64_t order = 0;
+  for (uint32_t j = 0; j < n_limbs; j++) order |= (uint64_t)order_limbs[j] << (32 * j);
+  run_sliced(n, 4096, [=](uint64_t s0, uint64_t s1) {
+    fold_u64_slice<Wire>(acc, stack, out, n, n_limbs, k, order, s0, s1);
+  });
+}
+
 }  // namespace
 
 // Single-pass batch fold for orders that fit in 64 bits (n_limbs <= 2 —
 // every f32/i32 B0-B6 config): fold K planar uint32[L, n] updates plus the
-// accumulator in ONE read of the batch. The host analogue of
-// ops/fold_jax.fold_planar_batch, used as a bench/aggregation fast path on
-// CPU where XLA's strided u16 reduction leaves ~10x bandwidth unused
-// (reference hot loop analogue: rust/xaynet-core/src/mask/masking.rs:292-316).
+// accumulator in ONE read of the batch, sliced over the element axis across
+// fold_threads() workers (the fold is elementwise — no merge step). The
+// host analogue of ops/fold_jax.fold_planar_batch, used as a production
+// aggregation kernel on CPU where XLA's strided u16 reduction leaves ~10x
+// bandwidth unused (reference hot loop analogue:
+// rust/xaynet-core/src/mask/masking.rs:292-316).
 //
 // Layouts: acc/out uint32[L, n] planar (limb-major), stack uint32[K, L, n].
 // Requirements: every input element < order; (K+1) * order < 2^64 for
@@ -458,51 +540,56 @@ XN_EXPORT int xn_fold_wire_nlimb(const uint32_t* acc, const uint32_t* stack, uin
   // block over elements so each batch row is read as one contiguous
   // stretch (element-at-a-time order would reload every cache line
   // ~elements-per-line times); block sized to keep the u64 column
-  // accumulator ~16 KB regardless of L
+  // accumulator ~16 KB regardless of L. Element slices are independent, so
+  // the blocks fan out over fold_threads() workers (shifted is shared
+  // read-only; colbuf/w are per-slice).
   uint64_t block = 2048 / L;
   if (block == 0) block = 1;
-  std::vector<uint64_t> colbuf(block * L);
-  uint32_t w[64];  // carry-propagated (L+1)-limb value, one element
-  for (uint64_t i0 = 0; i0 < n; i0 += block) {
-    const uint64_t bn = (i0 + block <= n) ? block : n - i0;
-    uint64_t* col = colbuf.data();
-    for (uint64_t j = 0; j < bn * L; j++) col[j] = acc[i0 * L + j];
-    for (uint64_t kk = 0; kk < k; kk++) {
-      const uint32_t* row = stack + (kk * n + i0) * L;
-      for (uint64_t j = 0; j < bn * L; j++) col[j] += row[j];
-    }
-    for (uint64_t bi = 0; bi < bn; bi++) {
-      const uint64_t i = i0 + bi;
-      uint64_t carry = 0;
-      for (uint32_t l = 0; l < L; l++) {
-        const uint64_t t = col[bi * L + l] + carry;
-        w[l] = (uint32_t)t;
-        carry = t >> 32;
+  const uint32_t* shifted_ro = shifted.data();
+  run_sliced(n, block, [=](uint64_t e0, uint64_t e1) {
+    std::vector<uint64_t> colbuf(block * L);
+    uint32_t w[64];  // carry-propagated (L+1)-limb value, one element
+    for (uint64_t i0 = e0; i0 < e1; i0 += block) {
+      const uint64_t bn = (i0 + block <= e1) ? block : e1 - i0;
+      uint64_t* col = colbuf.data();
+      for (uint64_t j = 0; j < bn * L; j++) col[j] = acc[i0 * L + j];
+      for (uint64_t kk = 0; kk < k; kk++) {
+        const uint32_t* row = stack + (kk * n + i0) * L;
+        for (uint64_t j = 0; j < bn * L; j++) col[j] += row[j];
       }
-      w[L] = (uint32_t)carry;  // < K+1 <= 2^16
-      if (pow2_boundary) {
+      for (uint64_t bi = 0; bi < bn; bi++) {
+        const uint64_t i = i0 + bi;
+        uint64_t carry = 0;
+        for (uint32_t l = 0; l < L; l++) {
+          const uint64_t t = col[bi * L + l] + carry;
+          w[l] = (uint32_t)t;
+          carry = t >> 32;
+        }
+        w[L] = (uint32_t)carry;  // < K+1 <= 2^16
+        if (pow2_boundary) {
+          for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
+          continue;
+        }
+        // reduce: repeated conditional subtract of the precomputed order << b
+        for (int b = (int)kbits; b >= 0; b--) {
+          const uint32_t* so = shifted_ro + (uint32_t)b * (L + 1);
+          int ge = 1;  // lexicographic w >= (order << b), from the top limb down
+          for (int l = (int)L; l >= 0; l--) {
+            if (w[l] > so[l]) { ge = 1; break; }
+            if (w[l] < so[l]) { ge = 0; break; }
+          }
+          if (!ge) continue;
+          uint64_t borrow = 0;
+          for (uint32_t l = 0; l <= L; l++) {
+            const uint64_t d = (uint64_t)w[l] - so[l] - borrow;
+            w[l] = (uint32_t)d;
+            borrow = (d >> 63) & 1;
+          }
+        }
         for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
-        continue;
       }
-      // reduce: repeated conditional subtract of the precomputed order << b
-      for (int b = (int)kbits; b >= 0; b--) {
-        const uint32_t* so = shifted.data() + (uint32_t)b * (L + 1);
-        int ge = 1;  // lexicographic w >= (order << b), from the top limb down
-        for (int l = (int)L; l >= 0; l--) {
-          if (w[l] > so[l]) { ge = 1; break; }
-          if (w[l] < so[l]) { ge = 0; break; }
-        }
-        if (!ge) continue;
-        uint64_t borrow = 0;
-        for (uint32_t l = 0; l <= L; l++) {
-          const uint64_t d = (uint64_t)w[l] - so[l] - borrow;
-          w[l] = (uint32_t)d;
-          borrow = (d >> 63) & 1;
-        }
-      }
-      for (uint32_t l = 0; l < L; l++) out[i * L + l] = w[l];
     }
-  }
+  });
   return 0;
 }
 
@@ -598,7 +685,7 @@ XN_EXPORT uint64_t xn_count_ge(const uint32_t* limbs, uint64_t count, uint32_t n
   return bad;
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 4; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 5; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout

@@ -175,11 +175,101 @@ def test_auto_mosaic_failure_falls_back_to_xla(monkeypatch, clean_caches):
 
 def test_auto_on_cpu_short_circuits_to_xla(clean_caches, monkeypatch):
     """Interpret-mode Pallas is an oracle, not a production kernel: on a CPU
-    backend auto must not burn time calibrating it."""
+    backend auto must not burn time calibrating it. On the default
+    (multi-device) test mesh the native host fold is unusable too — it
+    cannot shard — so auto goes straight to XLA with no timing loop."""
     made = _spy_make_fold_fn(monkeypatch)
     stack, host = _masked_stacks(40, 3)
     agg = ShardedAggregator(CFG, 40, kernel="auto")
     agg.add_batch(stack)
     assert agg.kernel_used == "xla"
     assert made == ["xla"]
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+
+
+def test_auto_on_cpu_races_native_u64_on_single_device_mesh(clean_caches, monkeypatch):
+    """Single-device CPU mesh: auto calibrates the native host fold against
+    XLA (the ~2.5x CPU win BENCH_r05 measured while auto short-circuited
+    to XLA and left it on the table). Whichever wins, the arithmetic must
+    match the host oracle."""
+    made = _spy_make_fold_fn(monkeypatch)
+    stack, host = _masked_stacks(48, 4)
+    agg = ShardedAggregator(CFG, 48, mesh=make_mesh(jax.devices()[:1]), kernel="auto")
+    if not agg._native_u64_usable(4):
+        pytest.skip("native library unavailable in this environment")
+    agg.add_batch(stack)
+    assert made == ["xla", "native-u64"]  # the CPU timing branch really ran
+    assert agg.kernel_used in ("xla", "native-u64")
+    assert agg.nb_models == 4
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+    key = ("cpu", 1, agg.n_limbs, agg.padded_length, agg.order, 4)
+    assert agg_mod._AUTO_KERNEL_CACHE[key] == agg.kernel_used
+
+
+def test_explicit_native_u64_runs_and_matches(clean_caches):
+    """kernel="native-u64" as a first-class production choice: folds run on
+    the host C++ kernel (no device staging after resolution) and stay
+    byte-identical to the host oracle across multiple batches."""
+    stack, host = _masked_stacks(30, 6)
+    agg = ShardedAggregator(CFG, 30, mesh=make_mesh(jax.devices()[:1]), kernel="native-u64")
+    if not agg._native_u64_usable(3):
+        pytest.skip("native library unavailable in this environment")
+    agg.add_batch(stack[:3])
+    agg.add_batch(stack[3:])
+    assert agg.kernel_used == "native-u64"
+    assert agg.nb_models == 6
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+
+
+def test_explicit_native_u64_falls_back_cleanly_without_library(
+    clean_caches, monkeypatch
+):
+    """A missing/unbuildable .so must degrade to XLA, never sink a round."""
+    from xaynet_tpu.utils import native
+
+    monkeypatch.setattr(native, "load", lambda: None)
+    stack, host = _masked_stacks(30, 3)
+    agg = ShardedAggregator(
+        CFG, 30, mesh=make_mesh(jax.devices()[:1]), kernel="native-u64"
+    )
+    agg.add_batch(stack)
+    assert agg.kernel_used == "xla"
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+
+
+def test_native_u64_oversized_batch_takes_xla_not_numpy_tree(clean_caches, caplog):
+    """A native-u64 verdict bound on a small first batch must not send a
+    later batch past the u64 running-sum headroom into the silent
+    pairwise-numpy fallback: the oversized batch folds through the XLA
+    kernel (with a one-time warning) and the arithmetic stays exact.
+
+    INTEGER/B2/M6 is a real such config: a ~2^61 order leaves u64 headroom
+    for only K+1 <= 9 terms, so a coalescer-style small first flush (K=3)
+    binds native-u64 while the steady-state batch (K=16) exceeds it."""
+    import logging
+
+    from xaynet_tpu.parallel import aggregator as agg_module
+
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B2, ModelType.M6)
+    assert (1 << 64) // cfg.order < 17  # the premise: K=16 exceeds headroom
+    n, k_small, k_big = 16, 3, 16
+    rng = np.random.default_rng(23)
+    host = Aggregation(cfg.pair(), n)
+    stacks = []
+    for _ in range(k_small + k_big):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        _, masked = Masker(cfg.pair()).mask(Scalar(1, k_small + k_big), w)
+        host.aggregate(masked)
+        stacks.append(masked.vect.data)
+    stack = np.stack(stacks)
+
+    agg = ShardedAggregator(cfg, n, mesh=make_mesh(jax.devices()[:1]), kernel="native-u64")
+    if not agg._native_u64_usable(k_small):
+        pytest.skip("native library unavailable in this environment")
+    agg.add_batch(stack[:k_small])
+    assert agg.kernel_used == "native-u64"
+    with caplog.at_level(logging.WARNING, logger=agg_module.__name__):
+        agg.add_batch(stack[k_small:])
+    assert any("headroom exceeded" in r.message for r in caplog.records)
+    assert agg.nb_models == k_small + k_big
     assert np.array_equal(agg.snapshot(), host.object.vect.data)

@@ -81,3 +81,44 @@ def test_repo_tree_is_clean():
         for path in sorted(target.rglob("*.py")):
             problems.extend(xn_lint.check_file(path))
     assert problems == []
+
+
+# --- the device_put staging rule ---------------------------------------------
+
+
+def test_device_put_rejected_in_server_tree(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/foo.py",
+        "import jax\nx = jax.device_put(batch)\n",
+    )
+    assert any("device_put" in p for p in problems)
+
+
+def test_device_put_rejected_in_ingest_tree_bare_name(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/ingest/foo.py",
+        "from jax import device_put\nx = device_put(batch, sharding)\n",
+    )
+    assert any("device_put" in p for p in problems)
+
+
+def test_device_put_allowlisted_and_out_of_tree_pass(tmp_path, monkeypatch):
+    allow = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/foo.py",
+        "import jax\nx = jax.device_put(tiny)  # lint: device-put-ok\n",
+    )
+    assert not any("device_put" in p for p in allow)
+    # the parallel tree (the pipeline itself) is exempt by scope
+    elsewhere = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/parallel/foo.py",
+        "import jax\nx = jax.device_put(batch)\n",
+    )
+    assert not any("device_put" in p for p in elsewhere)

@@ -1,0 +1,317 @@
+"""Streaming aggregation pipeline (parallel.streaming) correctness.
+
+The pipeline moves staging, folding, and acceptance syncs off the caller's
+critical path; these tests pin the property everything rests on —
+**byte-identity with the sequential path** — across fold kernels
+(including the native host kernel), for both planar and raw-wire submits,
+under dispatch-ahead schedules where the producer runs several batches
+ahead of late-completing folds, plus the batch-prevalidation single-
+dispatch contract and the settings/metrics surface.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    Masker,
+    MaskConfig,
+    ModelType,
+    Scalar,
+)
+from xaynet_tpu.core.mask.serialization import serialize_mask_vect, vect_element_block
+from xaynet_tpu.parallel.aggregator import ShardedAggregator
+from xaynet_tpu.parallel.mesh import make_mesh
+from xaynet_tpu.parallel.streaming import (
+    BATCHES_TOTAL,
+    INFLIGHT_FOLDS,
+    STAGING_DEPTH,
+    StreamingAggregator,
+    StreamingError,
+)
+
+CFG = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+
+# native-u64 requires a single-device mesh (the host kernel cannot shard);
+# the conftest forces 8 virtual CPU devices, so pin device 0 explicitly
+KERNELS = ("xla", "native-u64", "auto")
+
+
+def _mesh1():
+    return make_mesh(jax.devices()[:1])
+
+
+def _updates(n, total, seed=0):
+    rng = np.random.default_rng(seed)
+    host = Aggregation(CFG.pair(), n)
+    stacks, raws = [], []
+    for _ in range(total):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        _, masked = Masker(CFG.pair()).mask(Scalar(1, total), w)
+        host.aggregate(masked)
+        stacks.append(masked.vect.data)
+        raws.append(
+            np.frombuffer(
+                vect_element_block(serialize_mask_vect(masked.vect)), dtype=np.uint8
+            )
+        )
+    return stacks, raws, host
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_streaming_planar_byte_identical_to_sequential(kernel):
+    n, total, bs = 103, 13, 4
+    stacks, _, host = _updates(n, total)
+    seq = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel=kernel)
+    for i in range(0, total, bs):
+        seq.add_batch(np.stack(stacks[i : i + bs]))
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    for i in range(0, total, bs):
+        stream.submit_batch(np.stack(stacks[i : i + bs]))
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total
+    # both equal the host oracle, not merely each other
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+    assert agg.kernel_used == seq.kernel_used
+    stream.close()
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_streaming_wire_deferred_acceptance_matches_sequential(kernel):
+    """Raw-wire streaming: accumulator, nb_models AND the per-member
+    acceptance vectors (fetched in one deferred sync at drain) must equal
+    the sequential add_wire_batch path, invalid members included."""
+    n, total, bs = 57, 11, 4
+    _, raws, _ = _updates(n, total, seed=3)
+    bad = raws[5].copy()
+    bad[: CFG.bytes_per_number] = 0xFF  # element >= order -> member rejected
+    wires = raws[:5] + [bad] + raws[6:]
+
+    seq = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel=kernel)
+    seq_oks = [
+        seq.add_wire_batch(np.stack(wires[i : i + bs])) for i in range(0, total, bs)
+    ]
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    tickets = [
+        stream.submit_wire_batch(np.stack(wires[i : i + bs]))
+        for i in range(0, total, bs)
+    ]
+    # deferred: before drain no ticket has resolved acceptance
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total - 1
+    got = np.concatenate([t.accepted for t in tickets])
+    assert np.array_equal(got, np.concatenate(seq_oks))
+    assert not got[5] and int(got.sum()) == total - 1
+    stream.close()
+
+
+def test_dispatch_ahead_out_of_order_completion_stress():
+    """Producer races several batches ahead of folds that complete late and
+    with jittered timing: the ring/queue bounds must hold (gauges return to
+    zero), every batch must fold exactly once, and the aggregate must stay
+    byte-identical to the sequential schedule."""
+    n, total, bs = 64, 36, 3
+    stacks, _, host = _updates(n, total, seed=7)
+    seq = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel="xla")
+    for i in range(0, total, bs):
+        seq.add_batch(np.stack(stacks[i : i + bs]))
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=4, dispatch_ahead=3, max_batch=bs)
+    # resolve the kernel on the first batch, then wrap the fold with jitter
+    stream.submit_batch(np.stack(stacks[0:bs]))
+    stream.drain()
+    real_fold = agg._fold_fn
+    jitter = iter(np.random.default_rng(1).uniform(0.0, 0.004, size=total))
+    folded_sizes = []
+
+    def slow_fold(acc, staged):
+        time.sleep(float(next(jitter)))
+        folded_sizes.append(int(staged.shape[0]))
+        return real_fold(acc, staged)
+
+    agg._fold_fn = slow_fold
+    staged_before = BATCHES_TOTAL.labels(stage="staged").value
+    for i in range(bs, total, bs):
+        stream.submit_batch(np.stack(stacks[i : i + bs]))
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+    assert agg.nb_models == seq.nb_models == total
+    # every submitted batch folded exactly once, none dropped or duplicated
+    assert sum(folded_sizes) == total - bs
+    assert (
+        BATCHES_TOTAL.labels(stage="staged").value - staged_before
+        == (total - bs) / bs
+    )
+    # bounds released: nothing left in flight, no ring buffer leaked
+    assert INFLIGHT_FOLDS.value == 0
+    assert STAGING_DEPTH.value == 0
+    stream.close()
+
+
+def test_worker_failure_surfaces_at_drain():
+    n, bs = 32, 2
+    stacks, _, _ = _updates(n, 4, seed=9)
+    agg = ShardedAggregator(CFG, n, mesh=_mesh1(), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=2, dispatch_ahead=1, max_batch=bs)
+    stream.submit_batch(np.stack(stacks[0:bs]))
+    stream.drain()
+
+    def boom(acc, staged):
+        raise RuntimeError("fold died (stand-in)")
+
+    agg._fold_fn = boom
+    stream.submit_batch(np.stack(stacks[bs : 2 * bs]))
+    with pytest.raises(StreamingError):
+        stream.drain()
+    # the poison is PERMANENT: a later drain (the finalize/close path) must
+    # keep failing rather than hand out a snapshot whose accumulator and
+    # nb_models no longer describe the same update set
+    with pytest.raises(StreamingError):
+        stream.drain()
+    stream.close()  # cleanup still works on a poisoned pipeline
+
+
+def test_prevalidate_wire_batch_one_dispatch_per_group():
+    """StagedAggregator.prevalidate_wire_batch: one wire_unpack dispatch +
+    one acceptance fetch for the whole micro-batch; validate_aggregation
+    then consumes the cached per-member verdicts (invalid member rejected,
+    valid members staged) with NO further device round-trips."""
+    from xaynet_tpu.core.mask.masking import AggregationError
+    from xaynet_tpu.core.mask.object import LazyWireMaskVect, MaskObject
+    from xaynet_tpu.server.aggregation import StagedAggregator
+    from xaynet_tpu.telemetry import profiling
+
+    n, k = 57, 5
+    rng = np.random.default_rng(11)
+    host = StagedAggregator(CFG.pair(), n, device=False, batch_size=8)
+    dev = StagedAggregator(CFG.pair(), n, device=True, batch_size=8, kernel="xla")
+    objs = []
+    for i in range(k):
+        w = rng.uniform(-1, 1, n).astype(np.float32)
+        _, masked = Masker(CFG.pair()).mask(Scalar(1, k), w)
+        raw = np.array(vect_element_block(serialize_mask_vect(masked.vect)))
+        if i == 2:
+            raw[: CFG.bytes_per_number] = 0xFF  # invalid member
+        else:
+            host.validate_aggregation(masked)
+            host.aggregate(masked)
+        objs.append(MaskObject(LazyWireMaskVect(CFG, raw, n), masked.unit))
+
+    unpacks = profiling.KERNEL_CALLS.labels(op="wire_unpack")
+    before = unpacks.value
+    dev.prevalidate_wire_batch(objs)
+    assert unpacks.value - before == 1  # ONE dispatch for the group
+    for i, obj in enumerate(objs):
+        if i == 2:
+            with pytest.raises(AggregationError):
+                dev.validate_aggregation(obj)
+        else:
+            dev.validate_aggregation(obj)
+            assert obj.vect._staged_planar is not None
+            dev.aggregate(obj)
+    assert unpacks.value - before == 1  # cached verdicts, no re-dispatch
+    a, b = host.finalize(), dev.finalize()
+    assert a.nb_models == b.nb_models == k - 1
+    assert a.object == b.object
+
+
+def test_staged_aggregator_flush_is_submit_drain_is_sync():
+    """flush() submits without losing updates; nb_models counts staged +
+    in-flight + folded at every point; drain() is the synchronization."""
+    from xaynet_tpu.server.aggregation import StagedAggregator
+
+    n, k = 40, 6
+    rng = np.random.default_rng(13)
+    host = StagedAggregator(CFG.pair(), n, device=False, batch_size=2)
+    dev = StagedAggregator(
+        CFG.pair(), n, device=True, batch_size=2, kernel="xla",
+        dispatch_ahead=2, staging_buffers=3,
+    )
+    for _ in range(k):
+        w = rng.uniform(-1, 1, n).astype(np.float32)
+        _, masked = Masker(CFG.pair()).mask(Scalar(1, k), w)
+        for s in (host, dev):
+            s.validate_aggregation(masked)
+            s.aggregate(masked)
+        assert dev.nb_models == host.nb_models  # staged/in-flight included
+    dev.drain()
+    assert dev.nb_models == host.nb_models == k
+    a, b = host.finalize(), dev.finalize()
+    assert a.nb_models == b.nb_models == k
+    assert a.object == b.object
+
+
+def test_streaming_settings_surface():
+    from xaynet_tpu.server.settings import Settings, SettingsError
+
+    s = Settings.load(env={"XAYNET__AGGREGATION__DISPATCH_AHEAD": "4",
+                           "XAYNET__AGGREGATION__STAGING_BUFFERS": "5",
+                           "XAYNET__AGGREGATION__KERNEL": "native-u64"})
+    assert s.aggregation.dispatch_ahead == 4
+    assert s.aggregation.staging_buffers == 5
+    assert s.aggregation.kernel == "native-u64"
+    with pytest.raises(SettingsError):
+        Settings.load(env={"XAYNET__AGGREGATION__DISPATCH_AHEAD": "0"})
+    with pytest.raises(SettingsError):
+        Settings.load(env={"XAYNET__AGGREGATION__STAGING_BUFFERS": "1"})
+
+
+def test_prevalidate_skips_count_mismatched_member():
+    """A member whose declared count mismatches the round's model length
+    must be SKIPPED by batch prevalidation (ragged np.stack would otherwise
+    abort the whole micro-batch with an internal error) and rejected alone
+    by the per-member ModelMismatch check, exactly like the sequential
+    path."""
+    from xaynet_tpu.core.mask.masking import AggregationError
+    from xaynet_tpu.core.mask.object import LazyWireMaskVect, MaskObject
+    from xaynet_tpu.server.aggregation import StagedAggregator
+
+    n = 57
+    rng = np.random.default_rng(17)
+    dev = StagedAggregator(CFG.pair(), n, device=True, batch_size=8, kernel="xla")
+    w = rng.uniform(-1, 1, n).astype(np.float32)
+    _, good_masked = Masker(CFG.pair()).mask(Scalar(1, 2), w)
+    good = MaskObject(
+        LazyWireMaskVect(
+            CFG,
+            np.array(vect_element_block(serialize_mask_vect(good_masked.vect))),
+            n,
+        ),
+        good_masked.unit,
+    )
+    w_short = rng.uniform(-1, 1, n - 3).astype(np.float32)
+    _, short_masked = Masker(CFG.pair()).mask(Scalar(1, 2), w_short)
+    short = MaskObject(
+        LazyWireMaskVect(
+            CFG,
+            np.array(vect_element_block(serialize_mask_vect(short_masked.vect))),
+            n - 3,
+        ),
+        short_masked.unit,
+    )
+
+    dev.prevalidate_wire_batch([good, short])  # must not raise on ragged rows
+    dev.validate_aggregation(good)
+    assert good.vect._staged_planar is not None
+    dev.aggregate(good)
+    with pytest.raises(AggregationError):  # ModelMismatch for THAT member only
+        dev.validate_aggregation(short)
+    assert dev.nb_models == 1

@@ -133,7 +133,9 @@ def main() -> None:
                 return staged._device.unmask_limbs(mask_vect)
 
             def flush(self):
-                staged.flush()
+                # drain, not flush: this script reads .acc right after, so
+                # the streaming pipeline must have fully folded the batch
+                staged.drain()
 
         agg = _WireAggregator()
     else:

@@ -26,6 +26,13 @@ Checks:
     intake. Annotate a deliberate exception (e.g. the request channel whose
     bound lives upstream, or a shutdown sentinel channel) with
     ``# lint: unbounded-ok`` on the offending line.
+  - direct ``jax.device_put`` under ``xaynet_tpu/server`` and
+    ``xaynet_tpu/ingest``: update-batch staging must flow through the
+    streaming pipeline's buffer ring (``parallel.streaming``) so host
+    staging overlaps the in-flight folds and the per-batch pad/stack
+    allocations stay dead. Annotate a deliberate exception (tiny
+    non-update tensors) with ``# lint: device-put-ok`` on the offending
+    line.
 
 Usage: python tools/lint.py [paths...]   (default: the repo tree)
 """
@@ -153,6 +160,16 @@ def _is_unbounded_queue(node: ast.Call) -> bool:
     return False
 
 
+def _is_device_put(node: ast.Call) -> bool:
+    """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
+    rule is syntactic, like the queue rule: any spelling that resolves to
+    the jax transfer entry point counts)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "device_put"
+    return isinstance(func, ast.Name) and func.id == "device_put"
+
+
 def check_file(path: Path) -> list[str]:
     problems: list[str] = []
     rel = path.relative_to(REPO)
@@ -235,6 +252,14 @@ def check_file(path: Path) -> list[str]:
                     f"{rel}:{node.lineno}: unbounded asyncio.Queue() in the "
                     "coordinator tree (pass a maxsize, or annotate a deliberate "
                     "sentinel/upstream-bounded channel with '# lint: unbounded-ok')"
+                )
+        if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
+            if "lint: device-put-ok" not in line_of(node):
+                problems.append(
+                    f"{rel}:{node.lineno}: direct jax.device_put in the coordinator "
+                    "tree (stage update batches through the streaming pipeline's "
+                    "buffer ring — parallel.streaming — or annotate a deliberate "
+                    "non-update-tensor upload with '# lint: device-put-ok')"
                 )
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in list(node.args.defaults) + [

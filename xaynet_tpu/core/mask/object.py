@@ -90,8 +90,11 @@ class LazyWireMaskVect(MaskVect):
         self._count = count
         self._data: np.ndarray | None = None
         # device planar cached by StagedAggregator.validate_aggregation so
-        # stage() never re-uploads
+        # stage() never re-uploads; _wire_invalid is the cached REJECTED
+        # verdict from a batch prevalidation (validate_aggregation raises
+        # on it without another device round-trip)
         self._staged_planar = None
+        self._wire_invalid = False
 
     @property
     def materialized(self) -> bool:

@@ -4,11 +4,15 @@ During the update phase each accepted message costs a channel envelope, a
 phase wakeup, and eventually a fold dispatch. The coalescer buffers
 verified ``UpdateRequest``s for up to ``max_batch`` messages or
 ``linger_s`` seconds and submits them as ONE ``CoalescedUpdates`` envelope;
-the update phase processes members in order (validation + seed-dict insert
-stay per-member, so the seed-dict/masked-model pairing is never reordered)
-and folds the whole micro-batch as a single stacked ``masked_add``
-dispatch. During sum/sum2 the pipeline bypasses the coalescer entirely —
-those requests are per-message by construction.
+the update phase batch-prevalidates the members (one device round-trip for
+the group when wire ingest is on), processes them in order (validation +
+seed-dict insert stay per-member, so the seed-dict/masked-model pairing is
+never reordered) and SUBMITS the micro-batch into the streaming
+aggregation pipeline as a single stacked ``masked_add`` dispatch — the
+fold of batch N overlaps the decrypt/validate/stage of batch N+1, and the
+pipeline drains at the phase transition. During sum/sum2 the pipeline
+bypasses the coalescer entirely — those requests are per-message by
+construction.
 """
 
 from __future__ import annotations

@@ -9,5 +9,12 @@ zero-collective elementwise kernels and multi-host extensions.
 from .aggregator import ShardedAggregator
 from .mesh import MODEL_AXIS, make_mesh
 from .multihost import MultiHostAggregator
+from .streaming import StreamingAggregator
 
-__all__ = ["ShardedAggregator", "MODEL_AXIS", "make_mesh", "MultiHostAggregator"]
+__all__ = [
+    "ShardedAggregator",
+    "StreamingAggregator",
+    "MODEL_AXIS",
+    "make_mesh",
+    "MultiHostAggregator",
+]

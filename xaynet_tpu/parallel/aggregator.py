@@ -61,6 +61,18 @@ _AUTO_KERNEL_CACHE: dict[tuple, str] = {}
 _FOLD_FN_CACHE: dict[tuple, object] = {}
 
 
+def _mesh_key(mesh) -> tuple:
+    """Cache identity of a mesh: (axis shape, flat device ids).
+
+    The ``Mesh`` object itself must NOT be the key: a coordinator that
+    rebuilds its mesh every round (fresh ``make_mesh()`` per aggregator)
+    would then grow the process-wide caches — and the compiled executables
+    they hold — without bound, one entry per round, even though two meshes
+    over the same devices in the same shape compile to the same program.
+    """
+    return (tuple(mesh.devices.shape), tuple(int(d.id) for d in mesh.devices.flat))
+
+
 def _build_wire_unpack(bpn: int, order: int, multi_device: bool):
     """The ONE wire unpack + per-update validity + exclusion body, shared by
     the two-step and fused ingest builders so the accelerator-only fused
@@ -149,8 +161,15 @@ class ShardedAggregator:
             raise ValueError("model length mismatch")
         if stack.shape[0] > MAX_LAZY_BATCH:
             raise ValueError("batch too large for lazy-carry fold")
-        staged = jax.device_put(self._to_planar_padded(stack), self._batch_sharding)
-        self.acc = self._fold(self.acc, staged)
+        planar = self._to_planar_padded(stack)
+        self._resolve_kernel_cheap(stack.shape[0])
+        if self.kernel_used == "native-u64":
+            # the host kernel reads the planar directly — staging it onto
+            # the (CPU) jax device would only buy a copy
+            self.acc = self._fold(self.acc, planar)
+        else:
+            staged = jax.device_put(planar, self._batch_sharding)
+            self.acc = self._fold(self.acc, staged)
         self.nb_models += stack.shape[0]
 
     def add_planar_batch(self, stack_planar: jax.Array) -> None:
@@ -204,18 +223,49 @@ class ShardedAggregator:
         raw = np.asarray(raw)
         if raw.ndim != 1:
             raise ValueError("expected uint8[model_len * bytes_per_number]")
-        staged = self._stage_raw_bytes(raw[None])
-        planar, ok = profiling.timed_kernel(
-            "wire_unpack", self.padded_length, lambda: self._make_unpack_fn()(staged)
-        )
-        if not bool(np.asarray(ok)[0]):
-            return None
-        return planar[0]
+        return self.validate_wire_updates([raw])[0]
 
-    def _ingest_staged_bytes(self, staged) -> np.ndarray:
-        """Unpack + validity + fold an already device/mesh-resident raw-byte
-        batch (``add_wire_batch`` after device_put; the multihost path after
-        ``make_array_from_process_local_data``)."""
+    def validate_wire_updates(self, raws) -> list:
+        """Unpack + validity-check a GROUP of raw wire updates in ONE device
+        round-trip: one staged upload, one unpack+validity dispatch, one
+        acceptance-vector fetch — where the per-update path pays a full
+        dispatch + blocking ``np.asarray(ok)`` sync per update. Semantics
+        are per update and identical to ``validate_wire_update``: the
+        returned list is parallel to ``raws``, holding the validity-masked
+        device planar ``[L, padded_len]`` for accepted updates and ``None``
+        for any whose element is >= the group order.
+        """
+        if not raws:
+            return []
+        block = np.stack([np.asarray(r) for r in raws])
+        # bucket K to the next power of two: the unpack jit specializes on
+        # the batch dimension, and coalescer linger timeouts produce ragged
+        # group sizes — without bucketing every new K would stall the
+        # update phase on a fresh XLA compile mid-round. Zero pad rows
+        # decode to zero elements (valid group members) and are sliced off
+        # below; at most log2(batch) programs ever compile.
+        k = len(raws)
+        bucket = min(1 << max(0, k - 1).bit_length(), MAX_LAZY_BATCH)
+        if bucket > k:
+            block = np.concatenate(
+                [block, np.zeros((bucket - k, block.shape[1]), dtype=block.dtype)]
+            )
+        staged = self._stage_raw_bytes(block)
+        planar, ok = profiling.timed_kernel(
+            "wire_unpack",
+            staged.shape[0] * self.padded_length,
+            lambda: self._make_unpack_fn()(staged),
+        )
+        ok_host = np.asarray(ok)
+        return [planar[i] if ok_host[i] else None for i in range(k)]
+
+    def dispatch_staged_bytes(self, staged):
+        """Unpack + validity + fold a staged raw-byte batch WITHOUT syncing
+        the acceptance vector: returns the device ``ok`` array still in
+        flight. The caller owns the deferred accounting — it must fetch the
+        vector eventually and credit ``nb_models`` (what
+        ``_ingest_staged_bytes`` does inline, and the streaming pipeline
+        does once per drain instead of once per batch)."""
         n_elements = staged.shape[0] * self.padded_length
         if (
             self._fold_fn is not None
@@ -245,7 +295,14 @@ class ShardedAggregator:
             # profiling is on, the sync points serialize this overlap —
             # XAYNET_KERNEL_PROFILE=0 restores it exactly)
             self.acc = self._fold(self.acc, planar)
-        ok_host = np.asarray(ok)
+        return ok
+
+    def _ingest_staged_bytes(self, staged) -> np.ndarray:
+        """Unpack + validity + fold an already device/mesh-resident raw-byte
+        batch (``add_wire_batch`` after device_put; the multihost path after
+        ``make_array_from_process_local_data``) with an immediate
+        acceptance sync."""
+        ok_host = np.asarray(self.dispatch_staged_bytes(staged))
         self.nb_models += int(ok_host.sum())
         return ok_host
 
@@ -263,9 +320,11 @@ class ShardedAggregator:
         aggregator (one per round) would recompile every round and retain
         every old executable.
         """
+        if kernel == "native-u64":
+            return self._make_native_fold_fn()
         if kernel in ("pallas", "pallas-interpret"):
             interpret = kernel == "pallas-interpret"
-            key = (kernel, self.mesh, self.order)
+            key = (kernel, _mesh_key(self.mesh), self.order)
             fn = _FOLD_FN_CACHE.get(key)
             if fn is None:
                 from ..ops import fold_pallas
@@ -303,11 +362,77 @@ class ShardedAggregator:
             fn = _FOLD_FN_CACHE[key] = lambda a, s: fold_planar_batch(a, s, order)
         return fn
 
+    def _make_native_fold_fn(self):
+        """Host C++ single-pass u64 fold (``utils.native``), same
+        ``(acc, staged) -> acc`` contract as the device folds but over host
+        numpy (jax inputs are viewed with ``np.asarray`` — zero-copy for
+        CPU-backend arrays). NOT memoized in ``_FOLD_FN_CACHE``: there is no
+        compiled executable to leak, and the closure carries a
+        per-aggregator spare accumulator so the steady state allocates
+        nothing (a fresh 200 MB result buffer costs ~0.15 s/fold in page
+        faults at 25M params)."""
+        order = self.order
+        order_limbs = host_limbs.order_limbs_for(order)
+        # u64 running-sum headroom: K+1 terms < order each must fit u64
+        # (None = pow2-boundary order, which wraps exactly for any K)
+        headroom = (
+            None if order == (1 << (32 * self.n_limbs)) else (1 << 64) // order
+        )
+        state = {"spare": None, "warned": False}
+
+        def fold(acc, staged):
+            stack_np = np.asarray(staged)
+            if headroom is not None and stack_np.shape[0] + 1 > headroom:
+                # the usability check binds kernel_used on the FIRST batch's
+                # K; a later larger batch past the u64 headroom (high-order
+                # 2-limb configs) must take the XLA fold, not
+                # fold_planar_batch_host's silent pairwise-numpy fallback
+                if not state["warned"]:
+                    state["warned"] = True
+                    logger.warning(
+                        "native-u64 headroom exceeded at K=%d (order ~2^%d); "
+                        "folding oversized batches with the XLA kernel",
+                        stack_np.shape[0],
+                        order.bit_length(),
+                    )
+                return fold_planar_batch(np.asarray(acc), stack_np, order)
+            acc_np = np.asarray(acc)
+            out = host_limbs.fold_planar_batch_host(
+                acc_np, stack_np, order_limbs, out=state["spare"]
+            )
+            # the old accumulator's buffer becomes the next spare: the
+            # aggregator owns ``acc`` exclusively (readers go through
+            # snapshot(), which copies), so it is dead once the caller
+            # rebinds self.acc to the returned array. jax-owned buffers
+            # (the initial zeros) are read-only views — never reused.
+            state["spare"] = (
+                acc_np if (out is not acc_np and acc_np.flags.writeable) else None
+            )
+            return out
+
+        return fold
+
+    def _native_u64_usable(self, k: int) -> bool:
+        """Whether the native u64 fold can serve THIS aggregator: single
+        device (the host kernel cannot shard), an order within 2 limbs whose
+        K+1-term running sum fits u64 (``fold_planar_batch_host``'s fast
+        path — anything else would silently fall back to the slow pairwise
+        tree), and a loadable shared library."""
+        if self.mesh.devices.size > 1 or self.n_limbs > 2:
+            return False
+        if self.order != (1 << (32 * self.n_limbs)) and (k + 1) > (
+            (1 << 64) // self.order
+        ):
+            return False
+        from ..utils import native
+
+        return native.load() is not None
+
     def _make_unpack_fn(self):
         """Device wire-unpack + validity callable, memoized process-wide
         (same identity-caching rationale as the fold fns)."""
         bpn = self.config.bytes_per_number
-        key = ("unpack", self.mesh, bpn, self.order)
+        key = ("unpack", _mesh_key(self.mesh), bpn, self.order)
         fn = _FOLD_FN_CACHE.get(key)
         if fn is not None:
             return fn
@@ -332,7 +457,7 @@ class ShardedAggregator:
         the XLA fold in ONE jit (donated accumulator), memoized
         process-wide."""
         bpn = self.config.bytes_per_number
-        key = ("ingest", self.mesh, bpn, self.order)
+        key = ("ingest", _mesh_key(self.mesh), bpn, self.order)
         fn = _FOLD_FN_CACHE.get(key)
         if fn is not None:
             return fn
@@ -359,6 +484,44 @@ class ShardedAggregator:
         _FOLD_FN_CACHE[key] = fn
         return fn
 
+    def _auto_cache_key(self, k: int) -> tuple:
+        """Auto-verdict memo key. K is part of it: a verdict timed on a
+        small remainder flush must not bind the steady-state batch size
+        (and vice versa); the mesh size too — same padded_length on
+        different meshes means a different per-device shard (ADVICE r04)."""
+        return (
+            jax.default_backend(),
+            self.mesh.devices.size,
+            self.n_limbs,
+            self.padded_length,
+            self.order,
+            k,
+        )
+
+    def _resolve_kernel_cheap(self, k: int) -> None:
+        """Resolve ``kernel_used`` when no timing run is needed — explicit
+        kernel, or an auto verdict already memoized for this shape. Callers
+        invoke this BEFORE staging the first batch: when the winner is the
+        host-native kernel, skipping resolution-time ``device_put`` saves a
+        full-batch host->device copy per round (~13 GB at 25M/batch 64)
+        whose result the native fold would only view back on the host."""
+        if self.kernel_used is not None:
+            return
+        if self.kernel != "auto":
+            used = self.kernel
+            if used == "native-u64" and not self._native_u64_usable(k):
+                logger.warning(
+                    "native-u64 fold unavailable (no loadable library, multi-device "
+                    "mesh, or order outside the u64 fast path); falling back to xla"
+                )
+                used = "xla"
+            self.kernel_used = used
+            return
+        cached = _AUTO_KERNEL_CACHE.get(self._auto_cache_key(k))
+        if cached is not None:
+            self.kernel_used = cached
+            logger.info("aggregation kernel resolved: %s (auto, cached verdict)", cached)
+
     def _fold(self, acc, staged):
         if self._fold_fn is None:
             self._resolve_kernel(staged)  # may already set _fold_fn (winner)
@@ -383,30 +546,24 @@ class ShardedAggregator:
         aggregator every round, but the answer only depends on the backend
         and the problem shape.
         """
-        if self.kernel != "auto":
-            self.kernel_used = self.kernel
+        self._resolve_kernel_cheap(staged.shape[0])
+        if self.kernel_used is not None:
             return
         backend = jax.default_backend()
-        # K is part of the key: a verdict timed on a small remainder flush
-        # must not bind the steady-state batch size (and vice versa); the
-        # mesh size too — same padded_length on different meshes means a
-        # different per-device shard (ADVICE r04)
-        key = (
-            backend,
-            self.mesh.devices.size,
-            self.n_limbs,
-            self.padded_length,
-            self.order,
-            staged.shape[0],
-        )
-        cached = _AUTO_KERNEL_CACHE.get(key)
-        if cached is not None:
-            self.kernel_used = cached
-            logger.info("aggregation kernel resolved: %s (auto, cached verdict)", cached)
-            return
+        key = self._auto_cache_key(staged.shape[0])
         if backend == "cpu":
-            # interpret-mode Pallas is an oracle, not a production kernel
-            self.kernel_used = "xla"
+            # interpret-mode Pallas is an oracle, not a production kernel —
+            # but the native single-pass u64 fold IS: race it against XLA on
+            # the real staged batch (it wins ~2.5x at the 25M bench shape;
+            # BENCH_r05 showed auto leaving that on the table by
+            # short-circuiting to XLA here)
+            candidates = ["xla"]
+            if self._native_u64_usable(staged.shape[0]):
+                candidates.append("native-u64")
+        else:
+            candidates = ["xla", "pallas"]
+        if len(candidates) == 1:
+            self.kernel_used = candidates[0]
         else:
             timings, fns = {}, {}
             # one scratch accumulator shared across candidates and calls: the
@@ -419,12 +576,21 @@ class ShardedAggregator:
             # through the telemetry registry
             # (xaynet_kernel_calibration_seconds{kernel=...}).
             scratch = self._zero_acc()
-            for name in ("xla", "pallas"):
+            host_staged = None
+            for name in candidates:
                 try:
                     fold = self._make_fold_fn(name)
-                    scratch = fold(scratch, staged)
-                    scratch.block_until_ready()  # compile
-                    scratch, dt = profiling.measure(lambda: fold(scratch, staged))
+                    arg = staged
+                    if name == "native-u64":
+                        # the production native path never stages to device
+                        # (the kernel reads host memory), so time it on the
+                        # host view — on the CPU backend this is zero-copy
+                        if host_staged is None:
+                            host_staged = np.asarray(staged)
+                        arg = host_staged
+                    scratch = fold(scratch, arg)
+                    scratch = jax.block_until_ready(scratch)  # compile / first touch
+                    scratch, dt = profiling.measure(lambda: fold(scratch, arg))
                     timings[name] = dt
                     profiling.record_calibration(name, dt)
                     fns[name] = fold

@@ -1,0 +1,495 @@
+"""Streaming aggregation: bounded producer/consumer over the sharded fold.
+
+``ShardedAggregator``'s batch entry points serialize the three legs of every
+fold — host staging (pad + transpose + ``device_put``), the fold dispatch,
+and (on the wire path) a blocking acceptance-vector fetch — so the host and
+the device take turns idling. This module turns that into a pipeline:
+
+- **staging buffer ring** — a small set of pre-allocated host buffers;
+  batch N+1 is padded/copied into a ring buffer while batch N folds, and
+  the per-batch ``np.pad``/``np.stack`` allocations (plus their page-fault
+  tax, ~0.15 s per 200 MB at 25M params) disappear entirely. A buffer is
+  reused only after the fold that consumed it has finished reading host
+  memory (for device kernels: after the ``device_put`` transfer is
+  complete; for the native host kernel: after the fold call returns).
+- **dispatch-ahead depth** — up to ``dispatch_ahead`` batches are queued to
+  a single fold worker thread, so XLA's asynchronous dispatch keeps
+  multiple folds in flight behind one another while the producer stages
+  ahead (DrJAX-style MapReduce pipelining, arxiv 2403.07128).
+- **deferred acceptance syncs** — wire batches collect their ``ok`` arrays
+  as in-flight device values; ``drain()`` fetches them all in ONE sync at
+  flush/phase end instead of one blocking ``np.asarray(ok)`` per batch.
+  Per-member accept/reject semantics and ``nb_models`` are byte-identical
+  to the sequential path — invalid updates are zeroed inside the fold
+  either way, and the deferred fetch only moves *when* the host learns the
+  verdict, never what it is.
+
+Fold order is FIFO (single worker), and the lazy-carry fold is an exact
+modular sum, so the aggregate is byte-identical to sequential
+``add_batch``/``add_wire_batch`` calls over the same updates regardless of
+how far the pipeline runs ahead.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..ops.fold_jax import MAX_LAZY_BATCH
+from ..telemetry.registry import get_registry
+from .aggregator import ShardedAggregator
+
+logger = logging.getLogger(__name__)
+
+_registry = get_registry()
+STAGING_DEPTH = _registry.gauge(
+    "xaynet_streaming_staging_depth",
+    "Staging ring buffers currently owned by in-flight batches.",
+)
+INFLIGHT_FOLDS = _registry.gauge(
+    "xaynet_streaming_inflight_folds",
+    "Fold batches submitted to the streaming pipeline and not yet folded.",
+)
+OVERLAP_RATIO = _registry.gauge(
+    "xaynet_streaming_overlap_ratio",
+    "Fraction of the shorter pipeline leg (staging vs folding) that ran "
+    "concurrently with the other leg during the last drain window "
+    "(1 = perfect overlap, 0 = fully serialized).",
+)
+BATCHES_TOTAL = _registry.counter(
+    "xaynet_streaming_batches_total",
+    "Streaming pipeline batches, by stage (staged = submitted, "
+    "folded = fold completed).",
+    ("stage",),
+)
+
+_SHUTDOWN = object()
+
+
+class StreamingError(RuntimeError):
+    """The fold worker died; the pipeline result is unusable."""
+
+
+class StreamTicket:
+    """Handle for one submitted batch.
+
+    ``accepted`` resolves at the next ``drain()``: a ``bool[K]`` per-member
+    acceptance vector for wire batches, all-True for pre-validated planar
+    batches.
+    """
+
+    __slots__ = ("k", "accepted", "_ok")
+
+    def __init__(self, k: int):
+        self.k = k
+        self.accepted: np.ndarray | None = None
+        self._ok = None  # in-flight device acceptance vector (wire batches)
+
+
+class _StagingRing:
+    """Fixed pool of pre-allocated host staging buffers.
+
+    ``acquire`` blocks while every buffer is owned by an in-flight batch —
+    this is the pipeline's memory bound (the producer can run at most
+    ``size`` batches ahead of the fold worker).
+    """
+
+    def __init__(self, size: int, shape: tuple, dtype):
+        self._free: queue_mod.Queue = queue_mod.Queue()
+        self.size = size
+        for _ in range(size):
+            self._free.put(np.zeros(shape, dtype=dtype))
+
+    def acquire(self, timeout: float | None = None) -> np.ndarray:
+        buf = self._free.get(timeout=timeout)
+        STAGING_DEPTH.inc()
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        STAGING_DEPTH.dec()
+        self._free.put(buf)
+
+
+def _worker_main(ref: "weakref.ref[StreamingAggregator]", q: queue_mod.Queue) -> None:
+    """Fold worker loop. Holds NO strong reference to the pipeline between
+    items: an abandoned pipeline (e.g. a round that died before drain) is
+    garbage-collected normally, and its ``weakref.finalize`` wakes this
+    thread with the shutdown sentinel so it exits instead of leaking."""
+    while True:
+        item = q.get()
+        try:
+            if item is _SHUTDOWN:
+                return
+            self = ref()
+            if self is None:
+                return
+            self._process(item)
+            del self
+        finally:
+            q.task_done()
+
+
+class StreamingAggregator:
+    """Bounded streaming front-end over a :class:`ShardedAggregator`.
+
+    One fold worker consumes staged batches FIFO; the caller's thread only
+    stages. ``submit_*`` may block — on the staging ring when the producer
+    is ``staging_buffers`` batches ahead, on the dispatch queue when it is
+    ``dispatch_ahead`` folds ahead — which is the pipeline's backpressure.
+    ``drain()`` waits for in-flight work, performs the one deferred
+    acceptance sync, credits ``nb_models`` for wire batches, and publishes
+    the overlap ratio.
+
+    NOT thread-safe for concurrent producers: submits must come from one
+    thread at a time (the coordinator's executor serializes them; tests and
+    the bench are single-producer by construction).
+    """
+
+    def __init__(
+        self,
+        agg: ShardedAggregator,
+        staging_buffers: int = 3,
+        dispatch_ahead: int = 2,
+        max_batch: int = 64,
+    ):
+        if staging_buffers < 2:
+            raise ValueError("staging_buffers must be >= 2 (no overlap below that)")
+        if dispatch_ahead < 1:
+            raise ValueError("dispatch_ahead must be >= 1")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.agg = agg
+        self.staging_buffers = staging_buffers
+        self.dispatch_ahead = dispatch_ahead
+        self.max_batch = min(max_batch, MAX_LAZY_BATCH)
+        self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=dispatch_ahead)
+        self._rings: dict[str, _StagingRing] = {}  # lazy: planar / wire
+        self._pending: list[StreamTicket] = []  # wire tickets awaiting ok sync
+        self._in_flight_models = 0  # submitted, not yet folded (upper bound)
+        self._error: BaseException | None = None
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        self._lock = threading.Lock()  # worker-shared counters/pending
+        # overlap accounting, reset per drain window
+        self._stage_seconds = 0.0
+        self._fold_seconds = 0.0
+        self._window_start: float | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=_worker_main,
+                args=(weakref.ref(self), self._queue),
+                name="xn-stream-fold",
+                daemon=True,
+            )
+            self._worker.start()
+            # wake the worker if this pipeline is dropped without close()
+            weakref.finalize(self, self._queue.put, _SHUTDOWN)
+
+    def close(self) -> None:
+        """Drain, then stop the fold worker. Idempotent. A poisoned
+        pipeline (worker failure) still shuts down — the error has already
+        surfaced (or will) through drain()/submit, and close() is the
+        cleanup path."""
+        if self._closed:
+            return
+        try:
+            self.drain()
+        except StreamingError:
+            logger.warning("closing poisoned streaming pipeline")
+        self._closed = True
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(_SHUTDOWN)
+            self._worker.join(timeout=60.0)
+
+    # -- producer side -----------------------------------------------------
+
+    @property
+    def in_flight_models(self) -> int:
+        """Submitted-but-uncredited update count (an upper bound for wire
+        batches until their acceptance vector syncs at drain)."""
+        with self._lock:
+            return self._in_flight_models
+
+    def counted_models(self) -> int:
+        """``in_flight + agg.nb_models`` read atomically with the worker's
+        per-batch handoff (credit nb_models / drop in-flight under the same
+        lock), so a caller's capacity check (TooManyModels) never sees a
+        batch double-counted mid-fold or dropped between fold and drain."""
+        with self._lock:
+            return self._in_flight_models + self.agg.nb_models
+
+    def _ring(self, kind: str) -> _StagingRing:
+        ring = self._rings.get(kind)
+        if ring is None:
+            agg = self.agg
+            if kind == "planar":
+                shape = (self.max_batch, agg.n_limbs, agg.padded_length)
+                dtype = np.uint32
+            else:  # raw wire bytes
+                shape = (self.max_batch, agg.padded_length * agg.config.bytes_per_number)
+                dtype = np.uint8
+            ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
+        return ring
+
+    def _check(self, k: int) -> None:
+        if self._closed:
+            raise StreamingError("pipeline is closed")
+        if self._error is not None:
+            raise StreamingError("fold worker failed") from self._error
+        if k > self.max_batch:
+            raise ValueError(f"batch of {k} exceeds max_batch={self.max_batch}")
+        if self._window_start is None:
+            self._window_start = time.monotonic()
+
+    def _enqueue(self, item: tuple) -> None:
+        self._ensure_worker()
+        with self._lock:
+            self._in_flight_models += item[3]
+        INFLIGHT_FOLDS.inc()
+        BATCHES_TOTAL.labels(stage="staged").inc()
+        self._queue.put(item)
+
+    def submit_batch(self, stack: np.ndarray) -> StreamTicket:
+        """Stage + stream-fold wire-layout ``uint32[K, model_len, L]``
+        updates (the pre-validated path: all members count immediately)."""
+        stack = np.asarray(stack, dtype=np.uint32)
+        if stack.ndim != 3 or stack.shape[2] != self.agg.n_limbs:
+            raise ValueError("expected uint32[K, model_len, L]")
+        if stack.shape[1] != self.agg.model_length:
+            raise ValueError("model length mismatch")
+        k = stack.shape[0]
+        self._check(k)
+        t0 = time.monotonic()
+        buf = self._ring("planar").acquire()
+        # transpose+pad straight into the ring buffer (numpy strided copy,
+        # no wire_to_planar intermediate): per-batch host allocation in the
+        # steady state is zero
+        view = buf[:k]
+        view[:, :, : self.agg.model_length] = stack.transpose(0, 2, 1)
+        if self.agg.padded_length != self.agg.model_length:
+            view[:, :, self.agg.model_length :] = 0
+        ticket = StreamTicket(k)
+        self._stage_seconds += time.monotonic() - t0
+        self._enqueue((buf, view, "planar", k, ticket))
+        return ticket
+
+    def fold_planar_rows_now(self, rows: list) -> None:
+        """Fold already device-resident, validity-checked planar
+        ``[L, padded_len]`` updates on the CALLER's thread (the wire-ingest
+        server path: validated planars cached by ``validate_wire_update(s)``).
+
+        Deliberately NOT queued: these rows already occupy device memory,
+        so parking them behind ``dispatch_ahead`` would pin up to
+        ``dispatch_ahead + 1`` full batches in HBM (~13 GB each at
+        25M/batch 64) — and XLA's own asynchronous dispatch already
+        overlaps device-side folds without our queue. Waits out queued
+        work first (``agg.acc`` has exactly one mutator at a time), then
+        stacks + folds in chunks, dropping consumed references, so peak
+        device memory stays at the staged rows + one chunk-sized copy —
+        the same bound as the pre-streaming flush."""
+        if not rows:
+            return
+        self._queue.join()
+        if self._error is not None:
+            raise StreamingError("fold worker failed") from self._error
+        if self._closed:
+            raise StreamingError("pipeline is closed")
+        import jax
+        import jax.numpy as jnp
+
+        agg = self.agg
+        rows = list(rows)
+        while rows:
+            piece, rows = rows[:8], rows[8:]
+            staged = jax.device_put(jnp.stack(piece), agg._batch_sharding)
+            n_piece = len(piece)
+            del piece
+            agg.acc = agg._fold(agg.acc, staged)
+            with self._lock:
+                agg.nb_models += n_piece
+
+    def submit_host_planar_rows(self, rows: list) -> StreamTicket:
+        """Stream-fold host planar ``[L, padded_len]`` rows (numpy), copied
+        into a ring buffer here so the caller can recycle its arrays."""
+        k = len(rows)
+        if k == 0:
+            raise ValueError("empty planar batch")
+        self._check(k)
+        t0 = time.monotonic()
+        buf = self._ring("planar").acquire()
+        view = buf[:k]
+        for i, row in enumerate(rows):
+            np.copyto(view[i], row)
+        ticket = StreamTicket(k)
+        self._stage_seconds += time.monotonic() - t0
+        self._enqueue((buf, view, "planar", k, ticket))
+        return ticket
+
+    def submit_wire_batch(self, raw: np.ndarray) -> StreamTicket:
+        """Stage + stream-fold RAW wire element blocks
+        ``uint8[K, model_len * bpn]``. Acceptance is DEFERRED: the per-member
+        ``bool[K]`` lands on the ticket at the next ``drain()`` (the fold
+        itself excludes invalid members either way)."""
+        agg = self.agg
+        bpn = agg.config.bytes_per_number
+        raw = np.asarray(raw)
+        if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != agg.model_length * bpn:
+            raise ValueError("expected uint8[K, model_len * bytes_per_number]")
+        k = raw.shape[0]
+        self._check(k)
+        t0 = time.monotonic()
+        buf = self._ring("wire").acquire()
+        view = buf[:k]
+        view[:, : raw.shape[1]] = raw
+        if agg.padded_length != agg.model_length:
+            view[:, raw.shape[1] :] = 0  # zero bytes decode to zero elements
+        ticket = StreamTicket(k)
+        self._stage_seconds += time.monotonic() - t0
+        self._enqueue((buf, view, "wire", k, ticket))
+        return ticket
+
+    # -- fold worker -------------------------------------------------------
+
+    def _credit(self, staged, k: int) -> None:
+        """Fold a planar batch and hand its count over atomically: the
+        nb_models credit and the in-flight drop happen under one lock, so
+        ``counted_models()`` never observes the batch twice (double count →
+        spurious TooManyModels near the cap) or zero times."""
+        agg = self.agg
+        new_acc = agg._fold(agg.acc, staged)
+        with self._lock:
+            agg.acc = new_acc
+            agg.nb_models += k
+            self._in_flight_models -= k
+
+    def _process(self, item: tuple) -> None:
+        buf, payload, kind, k, ticket = item
+        agg = self.agg
+        t0 = time.monotonic()
+        ok = False
+        # updates whose count this worker still owes a handoff for: credited
+        # chunks subtract as they land, a wire ticket hands its whole count
+        # to drain(); whatever remains on error leaves flight uncredited
+        remaining = k
+        try:
+            import jax
+
+            if kind == "wire":
+                staged = jax.device_put(payload, agg._batch_bytes_sharding)
+                ticket._ok = agg.dispatch_staged_bytes(staged)
+                with self._lock:
+                    self._pending.append(ticket)
+                remaining = 0  # stays in flight until the drain credit
+                # the transfer out of the ring buffer must complete before
+                # reuse; the fold itself stays in flight behind it
+                jax.block_until_ready(staged)
+            else:
+                agg._resolve_kernel_cheap(k)
+                if agg.kernel_used == "native-u64":
+                    # host fold reads the ring buffer directly (synchronous)
+                    # — no device staging at all
+                    self._credit(payload, k)
+                else:
+                    staged = jax.device_put(payload, agg._batch_sharding)
+                    self._credit(staged, k)
+                    jax.block_until_ready(staged)  # host buffer free to reuse
+                remaining = 0
+                ticket.accepted = np.ones(k, dtype=bool)
+            ok = True
+        except BaseException as e:
+            with self._lock:
+                self._error = e
+            logger.exception("streaming fold worker failed")
+        finally:
+            if buf is not None:
+                self._ring("wire" if kind == "wire" else "planar").release(buf)
+            with self._lock:
+                if remaining:
+                    # a dead batch leaves flight without any credit (the
+                    # error surfaces at the next submit/drain)
+                    self._in_flight_models -= remaining
+                self._fold_seconds += time.monotonic() - t0
+            INFLIGHT_FOLDS.dec()
+            # a failed fold is NOT folded: dashboards comparing staged vs
+            # folded must be able to see the loss
+            BATCHES_TOTAL.labels(stage="folded" if ok else "failed").inc()
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self) -> int:
+        """Wait for every in-flight fold, then perform the ONE deferred
+        acceptance sync: fetch all pending ``ok`` vectors, resolve their
+        tickets, credit ``nb_models``. Returns the number of updates
+        accepted from deferred wire batches in this window."""
+        self._queue.join()
+        if self._error is not None:
+            # the pipeline is poisoned — PERMANENTLY: once a fold has
+            # failed the accumulator no longer corresponds to any
+            # consistent update set, so every later drain (finalize,
+            # close) must keep failing rather than let a snapshot with
+            # missing/uncounted updates escape as a valid round result.
+            # The deferred state is discarded once (stale tickets must not
+            # resolve and their counts must leave flight).
+            with self._lock:
+                stale, self._pending = self._pending, []
+                self._in_flight_models -= sum(t.k for t in stale)
+            for ticket in stale:
+                ticket._ok = None
+            raise StreamingError("fold worker failed") from self._error
+        with self._lock:
+            pending, self._pending = self._pending, []
+        accepted = 0
+        try:
+            for ticket in pending:
+                ok_host = np.asarray(ticket._ok)
+                ticket._ok = None
+                ticket.accepted = ok_host
+                accepted += int(ok_host.sum())
+            # a true completion barrier: the worker only blocks on staged
+            # INPUTS (ring-buffer reuse), so with profiling off the last
+            # folds may still be executing behind XLA's async dispatch —
+            # and their errors surface here, not in the worker
+            import jax
+
+            jax.block_until_ready(self.agg.acc)
+        except Exception as e:
+            # an asynchronously-dispatched fold failed (e.g. device OOM):
+            # poison exactly like a worker failure — drop the deferred
+            # counts and keep every later drain failing
+            with self._lock:
+                self._error = e
+                self._in_flight_models -= sum(t.k for t in pending)
+            for ticket in pending:
+                ticket._ok = None
+            raise StreamingError("deferred fold/acceptance sync failed") from e
+        if pending:
+            # the ONE deferred credit: the accepted count lands and the
+            # optimistic in-flight count drops in the same locked step, so
+            # counted_models() never dips (folded-but-uncredited) nor
+            # double-counts
+            with self._lock:
+                self.agg.nb_models += accepted
+                self._in_flight_models -= sum(t.k for t in pending)
+        self._publish_overlap()
+        return accepted
+
+    def _publish_overlap(self) -> None:
+        if self._window_start is None:
+            return
+        wall = max(time.monotonic() - self._window_start, 1e-9)
+        shorter = min(self._stage_seconds, self._fold_seconds)
+        if shorter > 0:
+            overlap = (self._stage_seconds + self._fold_seconds - wall) / shorter
+            OVERLAP_RATIO.set(max(0.0, min(1.0, overlap)))
+        self._stage_seconds = 0.0
+        self._fold_seconds = 0.0
+        self._window_start = None

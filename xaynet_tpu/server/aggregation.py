@@ -8,7 +8,12 @@ are staged and folded in batches:
 - **host**: vectorized numpy limb kernels (``core.mask.Aggregation``);
 - **device**: the sharded single-pass fold on the TPU mesh
   (``parallel.ShardedAggregator``) for the vector part, host for the tiny
-  unit part.
+  unit part. Device folds flow through the streaming pipeline
+  (``parallel.streaming``): ``flush()`` *submits* the staged micro-batch
+  into a bounded producer/consumer (ring-buffer staging overlaps the
+  in-flight folds) and ``drain()`` — called at phase end and in
+  ``finalize`` — blocks for the result. The fold math is an exact modular
+  sum, so the aggregate is byte-identical to the synchronous path.
 
 Validation still happens per-update at accept time (the client-visible
 protocol behavior is unchanged); only the arithmetic is deferred into
@@ -37,6 +42,8 @@ class StagedAggregator:
         ingest_workers: int = 4,
         mesh=None,
         kernel: str = "auto",
+        dispatch_ahead: int = 2,
+        staging_buffers: int = 3,
     ):
         self.config = config
         self.object_size = object_size
@@ -46,14 +53,23 @@ class StagedAggregator:
         self._count = 0
         self._host = Aggregation(config, object_size)
         self._device = None
+        self._stream = None
         self._ingest_pool = None
         if device:
             from concurrent.futures import ThreadPoolExecutor
 
             from ..ops import limbs as limb_ops
             from ..parallel.aggregator import ShardedAggregator
+            from ..parallel.streaming import StreamingAggregator
 
             self._device = ShardedAggregator(config.vect, object_size, mesh=mesh, kernel=kernel)
+            # flush() submits micro-batches here; drain()/finalize() sync
+            self._stream = StreamingAggregator(
+                self._device,
+                staging_buffers=staging_buffers,
+                dispatch_ahead=dispatch_ahead,
+                max_batch=self.batch_size,
+            )
             # tiny unit part stays on host
             self._unit_acc = np.zeros(
                 limb_ops.n_limbs_for_order(config.unit.order), dtype=np.uint32
@@ -75,7 +91,12 @@ class StagedAggregator:
 
     @property
     def nb_models(self) -> int:
-        return self._count + (self._device.nb_models if self._device else self._host.nb_models)
+        if self._device is not None:
+            # staged + (in-flight + folded, read atomically with the fold
+            # worker's handoff): every accepted update counts the moment it
+            # is staged, exactly as before streaming
+            return self._count + self._stream.counted_models()
+        return self._count + self._host.nb_models
 
     def validate_aggregation(self, obj: MaskObject) -> None:
         """Per-update protocol validation (same checks as the reference,
@@ -99,13 +120,57 @@ class StagedAggregator:
             # device wire ingest: unpack + element validity run on the
             # accelerator, and the resulting planar is cached on the object
             # so stage() never re-uploads. Ordering is preserved — this runs
-            # before the caller's seed-dict insert (update.rs:119-152).
-            planar = self._device.validate_wire_update(np.asarray(vect.wire_block))
+            # before the caller's seed-dict insert (update.rs:119-152). A
+            # prior prevalidate_wire_batch may already have cached the
+            # verdict (one device round-trip for the whole micro-batch);
+            # only un-prevalidated updates pay the per-update sync here.
+            planar = vect._staged_planar
+            if planar is None and not vect._wire_invalid:
+                planar = self._device.validate_wire_update(np.asarray(vect.wire_block))
             if planar is None or not obj.unit.is_valid():
                 raise AggregationError("InvalidObject")
             vect._staged_planar = planar
         elif not obj.is_valid():
             raise AggregationError("InvalidObject")
+
+    def prevalidate_wire_batch(self, objs) -> None:
+        """Batch device validation for a micro-batch about to be processed
+        member-wise: ONE staged upload + unpack dispatch + acceptance fetch
+        for the whole group (``ShardedAggregator.validate_wire_updates``),
+        where the per-member path pays a full device round-trip sync each.
+        Results are cached on the vect objects; ``validate_aggregation``
+        consumes them per member in order, so the protocol's
+        validate-before-seed-dict-insert sequencing is unchanged (caching a
+        verdict earlier has no observable side effect). Non-wire members
+        and host mode are untouched."""
+        if self._device is None:
+            return
+        # only members the device branch would actually validate: matching
+        # config and declared length (a count/config-mismatched member must
+        # fall through to the per-member path, which rejects IT alone with
+        # ModelMismatch — a ragged np.stack here would instead blow up the
+        # whole micro-batch with an internal error)
+        want_bytes = self.object_size * self.config.vect.bytes_per_number
+        lazies = [
+            obj.vect
+            for obj in objs
+            if isinstance(obj.vect, LazyWireMaskVect)
+            and not obj.vect.materialized
+            and obj.vect._staged_planar is None
+            and not obj.vect._wire_invalid
+            and obj.vect.config == self.config.vect
+            and np.asarray(obj.vect.wire_block).size == want_bytes
+        ]
+        for start in range(0, len(lazies), self.batch_size):
+            chunk = lazies[start : start + self.batch_size]
+            planars = self._device.validate_wire_updates(
+                [np.asarray(v.wire_block) for v in chunk]
+            )
+            for vect, planar in zip(chunk, planars):
+                if planar is None:
+                    vect._wire_invalid = True
+                else:
+                    vect._staged_planar = planar
 
     @property
     def pending(self) -> int:
@@ -145,13 +210,19 @@ class StagedAggregator:
             self.flush()
 
     def flush(self) -> None:
+        """Hand the staged micro-batch to the fold backend.
+
+        Device mode SUBMITS into the streaming pipeline and returns without
+        waiting for the fold (the pipeline's dispatch-ahead/ring bounds
+        provide backpressure); call :meth:`drain` to synchronize. Host mode
+        folds inline as before.
+        """
         if self._count == 0:
             return
         stack = None if self._ingest_pool is not None else np.stack(self._staged_vect)
         units = np.stack(self._staged_unit)
         if self._device is not None:
             import jax
-            import jax.numpy as jnp
 
             from ..ops import limbs as limb_ops
 
@@ -159,23 +230,24 @@ class StagedAggregator:
             self._staged_vect.clear()  # consume destructively: free as we fold
             if all(isinstance(p, jax.Array) for p in parts):
                 # wire ingest: every planar is already device-resident and
-                # validity-checked. Stack + fold in CHUNKS, dropping each
-                # consumed reference, so peak HBM stays at the staged
-                # planars + one chunk-sized copy instead of + a full second
-                # batch (at 25M/batch 64 that difference is ~13 GB)
-                chunk = 8
-                while parts:
-                    piece, parts = parts[:chunk], parts[chunk:]
-                    staged_batch = jax.device_put(
-                        jnp.stack(piece), self._device._batch_sharding
-                    )
-                    del piece
-                    self._device.add_planar_batch(staged_batch)
+                # validity-checked — folded INLINE (not queued: parking
+                # device-resident batches behind dispatch_ahead would pin
+                # several full batches in HBM at once, ~13 GB each at
+                # 25M/batch 64, where XLA's async dispatch already overlaps
+                # device folds). Chunked stack+fold keeps peak HBM at the
+                # staged planars + one chunk-sized copy, the pre-streaming
+                # bound.
+                self._stream.fold_planar_rows_now(parts)
             else:
-                staged_batch = jax.device_put(
-                    np.stack([np.asarray(p) for p in parts]), self._device._batch_sharding
-                )
-                self._device.add_planar_batch(staged_batch)
+                # host planars: copied into the pipeline's staging ring
+                # (no np.stack allocation) and folded by the worker while
+                # this thread returns to staging the next micro-batch
+                host_rows = [np.asarray(p) for p in parts]
+                for start in range(0, len(host_rows), self._stream.max_batch):
+                    self._stream.submit_host_planar_rows(
+                        host_rows[start : start + self._stream.max_batch]
+                    )
+            parts.clear()
             order_limbs = limb_ops.order_limbs_for(self.config.unit.order)
             batch_unit = limb_ops.batch_mod_sum(units[:, None, :], order_limbs)[0]
             self._unit_acc = limb_ops.mod_add(
@@ -193,11 +265,19 @@ class StagedAggregator:
         self._staged_unit.clear()
         self._count = 0
 
+    def drain(self) -> None:
+        """Flush, then block until every in-flight fold has completed (the
+        phase-transition synchronization point)."""
+        self.flush()
+        if self._stream is not None:
+            self._stream.drain()
+
     def finalize(self) -> Aggregation:
         """Materialize the protocol-level ``Aggregation`` (for Unmask)."""
-        self.flush()
+        self.drain()
         if self._device is None:
             return self._host
+        self._stream.close()
         agg = Aggregation(self.config, self.object_size)
         agg.object = MaskObject(
             MaskVect(self.config.vect, self._device.snapshot()),

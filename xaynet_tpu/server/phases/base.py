@@ -115,6 +115,11 @@ class PhaseState:
         """Phase-specific request handling; raises ``RequestError`` to reject."""
         raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase accepts no requests")
 
+    async def coalesced_batch_start(self, members) -> None:
+        """Hook: a coalesced micro-batch is about to be processed
+        member-wise (the update phase batch-prevalidates device wire
+        updates here — one device round-trip for the whole group)."""
+
     async def coalesced_batch_done(self, n: int) -> None:
         """Hook: a coalesced micro-batch of ``n`` members was just processed
         (the update phase flushes its staged fold here)."""
@@ -218,6 +223,7 @@ class PhaseState:
             # protocol semantics are per UPDATE, not per envelope), then the
             # phase gets one batch-done hook for the stacked fold dispatch
             try:
+                await self.coalesced_batch_start(env.request.members)
                 for member_env in env.request.envelopes(env.request_id):
                     await self._process_single(member_env, counter)
                 await self.coalesced_batch_done(len(env.request))

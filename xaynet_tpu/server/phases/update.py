@@ -37,12 +37,17 @@ class UpdatePhase(PhaseState):
             device=settings.aggregation.device,
             batch_size=settings.aggregation.batch_size,
             kernel=settings.aggregation.kernel,
+            dispatch_ahead=settings.aggregation.dispatch_ahead,
+            staging_buffers=settings.aggregation.staging_buffers,
         )
         self._seed_dict = None
 
     async def process(self) -> None:
         await self.process_requests(self.shared.settings.pet.update)
-        self.aggregator.flush()
+        # phase transition: drain the streaming pipeline — every submitted
+        # fold completes and the deferred acceptance sync runs, off the
+        # event loop (this is the one blocking synchronization point)
+        await asyncio.get_running_loop().run_in_executor(None, self.aggregator.drain)
         self._seed_dict = await self.shared.store.coordinator.seed_dict()
         if not self._seed_dict:
             raise PhaseError("NoSeedDict", "seed dictionary missing after update phase")
@@ -80,9 +85,23 @@ class UpdatePhase(PhaseState):
             # large folds; handle_request awaits it, so folds serialize
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
 
+    async def coalesced_batch_start(self, members) -> None:
+        """Batch prevalidation: when device wire ingest is on, the whole
+        micro-batch's unpack + element-validity runs as ONE device dispatch
+        + ONE acceptance fetch (``prevalidate_wire_batch``) instead of a
+        blocking round-trip per member; ``handle_request`` then consumes
+        the cached per-member verdicts in order, so validation still
+        precedes each member's seed-dict insert exactly as before."""
+        masked = [m.masked_model for m in members if isinstance(m, UpdateRequest)]
+        if len(masked) > 1:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.aggregator.prevalidate_wire_batch, masked
+            )
+
     async def coalesced_batch_done(self, n: int) -> None:
         """One stacked fold per coalesced micro-batch: the whole batch of
-        staged updates goes to the aggregator as a single ``masked_add``
-        dispatch, amortizing host->HBM transfer and kernel launch."""
+        staged updates is SUBMITTED to the streaming aggregation pipeline
+        as a single ``masked_add`` dispatch — staging of the next batch
+        overlaps the in-flight fold; the pipeline drains at phase end."""
         if self.aggregator.pending:
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)

@@ -152,9 +152,18 @@ class LoggingSettings:
 class AggregationSettings:
     device: bool = False  # fold updates on the TPU mesh instead of host numpy
     batch_size: int = 64  # staged updates per device fold
-    # fold kernel when device=True: auto (calibrate XLA vs Pallas on the
-    # first flush), xla, pallas, or pallas-interpret (CI oracle path)
+    # fold kernel when device=True: auto (calibrate on the first flush —
+    # XLA vs Pallas on accelerators, XLA vs the native host u64 fold on
+    # CPU), xla, pallas, pallas-interpret (CI oracle path), or native-u64
+    # (host C++ single-pass fold; falls back to xla when unavailable)
     kernel: str = "auto"
+    # streaming pipeline (device=True): how many submitted fold batches may
+    # be in flight behind the fold worker before flush() backpressures
+    dispatch_ahead: int = 2
+    # pre-allocated host staging buffers (each batch_size x model-sized);
+    # batch N+1 stages into one while batch N folds — >= dispatch_ahead + 1
+    # for full overlap, minimum 2
+    staging_buffers: int = 3
     # device wire ingest (requires device=true): Update masked models are
     # parsed LAZILY (raw element block kept), and unpack + per-update
     # element validity + fold all run on the accelerator — the coordinator
@@ -232,6 +241,10 @@ class Settings:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
             raise SettingsError("aggregation.batch_size must be >= 1")
+        if self.aggregation.dispatch_ahead < 1:
+            raise SettingsError("aggregation.dispatch_ahead must be >= 1")
+        if self.aggregation.staging_buffers < 2:
+            raise SettingsError("aggregation.staging_buffers must be >= 2")
         if self.aggregation.kernel not in FOLD_KERNELS:
             raise SettingsError(
                 "aggregation.kernel must be one of: " + " | ".join(FOLD_KERNELS)
@@ -365,6 +378,12 @@ class Settings:
                 device=bool(agg_raw.get("device", False)),
                 batch_size=int(agg_raw.get("batch_size", base.aggregation.batch_size)),
                 kernel=str(agg_raw.get("kernel", base.aggregation.kernel)),
+                dispatch_ahead=int(
+                    agg_raw.get("dispatch_ahead", base.aggregation.dispatch_ahead)
+                ),
+                staging_buffers=int(
+                    agg_raw.get("staging_buffers", base.aggregation.staging_buffers)
+                ),
                 wire_ingest=bool(agg_raw.get("wire_ingest", base.aggregation.wire_ingest)),
             ),
             ingest=IngestSettings(

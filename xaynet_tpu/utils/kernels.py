@@ -3,6 +3,12 @@
 Single source of truth for the aggregation fold kernel names, shared by
 ``parallel.aggregator`` (which executes them) and ``server.settings`` (which
 validates configs without importing jax).
+
+``native-u64`` is the host C++ single-pass fold (``utils.native`` /
+``native/xaynet_native.cpp``): threaded over the element axis, it beats the
+XLA CPU fold ~2.5x at the 25M-param bench shape, so ``auto`` races it
+against XLA on CPU backends (single-device mesh, <= 2-limb orders). It
+degrades to ``xla`` cleanly when the shared library won't build.
 """
 
-FOLD_KERNELS = ("auto", "xla", "pallas", "pallas-interpret")
+FOLD_KERNELS = ("auto", "xla", "pallas", "pallas-interpret", "native-u64")
