@@ -122,3 +122,56 @@ def test_device_put_allowlisted_and_out_of_tree_pass(tmp_path, monkeypatch):
         "import jax\nx = jax.device_put(batch)\n",
     )
     assert not any("device_put" in p for p in elsewhere)
+
+
+# --- silent broad-exception swallow rule (server/ + storage/) --------------
+
+
+def test_silent_swallow_rejected_in_server_tree(tmp_path, monkeypatch):
+    source = (
+        "try:\n"
+        "    x = 1\n"
+        "except Exception:\n"
+        "    pass\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/server/foo.py", source)
+    assert any("silent broad-exception swallow" in p for p in problems)
+
+
+def test_silent_swallow_rejected_in_storage_tree_tuple_and_continue(tmp_path, monkeypatch):
+    source = (
+        "for i in range(3):\n"
+        "    try:\n"
+        "        x = 1\n"
+        "    except (ValueError, BaseException):\n"
+        "        continue\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/storage/foo.py", source)
+    assert any("silent broad-exception swallow" in p for p in problems)
+
+
+def test_narrow_logged_and_allowlisted_swallows_pass(tmp_path, monkeypatch):
+    source = (
+        "import logging\n"
+        "try:\n"
+        "    x = 1\n"
+        "except ValueError:\n"  # narrow: allowed
+        "    pass\n"
+        "try:\n"
+        "    x = 2\n"
+        "except Exception as e:\n"  # handled: allowed
+        "    logging.warning('boom %s', e)\n"
+        "try:\n"
+        "    x = 3\n"
+        "except Exception:  # lint: swallow-ok\n"  # annotated: allowed
+        "    pass\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/server/foo.py", source)
+    assert not any("swallow" in p for p in problems)
+
+
+def test_swallow_rule_scoped_to_server_and_storage(tmp_path, monkeypatch):
+    source = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    for rel in ("xaynet_tpu/parallel/foo.py", "tools/foo.py", "xaynet_tpu/ingest/foo.py"):
+        problems = _check(tmp_path, monkeypatch, rel, source)
+        assert not any("swallow" in p for p in problems), rel

@@ -521,12 +521,13 @@ def test_storage_fault_routes_to_failure_and_recovers():
                 raise StorageError("backend down")
 
     async def run():
-        from xaynet_tpu.server.phases import failure as failure_mod
-
-        failure_mod.STORE_READY_RETRY_SECONDS = 0.05
         flaky = FlakyStorage()
         store = Store(flaky, InMemoryModelStorage(), NoOpTrustAnchor())
         settings = _settings(5.0)
+        # keep the Failure phase's readiness backoff snappy for the test
+        # (the probe cadence comes from [resilience] retry settings now)
+        settings.resilience.retry_base_ms = 5.0
+        settings.resilience.retry_max_ms = 50.0
         machine, tx, events = await StateMachineInitializer(settings, store).init()
         handler = PetMessageHandler(events, tx)
         machine_task = asyncio.create_task(machine.run())

@@ -33,6 +33,14 @@ Checks:
     allocations stay dead. Annotate a deliberate exception (tiny
     non-update tensors) with ``# lint: device-put-ok`` on the offending
     line.
+  - silent broad-exception swallows (``except Exception: pass`` and
+    friends) under ``xaynet_tpu/server`` and ``xaynet_tpu/storage``: a
+    coordinator-side failure must be logged, metered, retried or
+    re-raised — silently dropping it hides outages (the unmask-phase
+    pointer update did exactly this until a metric made it visible).
+    Narrow handlers (``except ValueError: pass``) are allowed; a
+    deliberate broad swallow (best-effort socket teardown) must carry
+    ``# lint: swallow-ok`` on the ``except`` line.
 
 Usage: python tools/lint.py [paths...]   (default: the repo tree)
 """
@@ -160,6 +168,34 @@ def _is_unbounded_queue(node: ast.Call) -> bool:
     return False
 
 
+def _is_silent_broad_swallow(node: ast.ExceptHandler) -> bool:
+    """True for a handler that (a) catches Exception/BaseException —
+    directly or inside a tuple — and (b) whose body does nothing but
+    ``pass``/``...``/``continue``. Narrow handlers and handlers that log,
+    meter, assign or re-raise are fine."""
+
+    def names(t) -> list:
+        if t is None:
+            return []
+        if isinstance(t, ast.Tuple):
+            return [n for elt in t.elts for n in names(elt)]
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, ast.Attribute):
+            return [t.attr]
+        return []
+
+    if not any(n in ("Exception", "BaseException") for n in names(node.type)):
+        return False
+    for stmt in node.body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        return False
+    return True
+
+
 def _is_device_put(node: ast.Call) -> bool:
     """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
     rule is syntactic, like the queue rule: any spelling that resolves to
@@ -226,6 +262,9 @@ def check_file(path: Path) -> list[str]:
     hot_path = str(rel).startswith(("xaynet_tpu/parallel", "xaynet_tpu/server"))
     # coordinator queue trees: unbounded queues defeat admission control
     bounded_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/ingest"))
+    # coordinator/storage trees: silent broad swallows hide infrastructure
+    # failures from the resilience layer and the operator
+    no_swallow_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/storage"))
     src_lines = text.splitlines()
 
     def line_of(node: ast.AST) -> str:
@@ -260,6 +299,18 @@ def check_file(path: Path) -> list[str]:
                     "tree (stage update batches through the streaming pipeline's "
                     "buffer ring — parallel.streaming — or annotate a deliberate "
                     "non-update-tensor upload with '# lint: device-put-ok')"
+                )
+        if (
+            no_swallow_tree
+            and isinstance(node, ast.ExceptHandler)
+            and _is_silent_broad_swallow(node)
+        ):
+            if "lint: swallow-ok" not in line_of(node):
+                problems.append(
+                    f"{rel}:{node.lineno}: silent broad-exception swallow in the "
+                    "coordinator/storage tree (log, meter, retry or re-raise — or "
+                    "annotate a deliberate best-effort cleanup with "
+                    "'# lint: swallow-ok')"
                 )
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in list(node.args.defaults) + [

@@ -153,9 +153,46 @@ def main() -> None:
         "(aggregation.wire_ingest=true) — leak-checks the production "
         "device-ingest mode over many rounds",
     )
+    ap.add_argument(
+        "--faults",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help="chaos soak: replay a seeded FaultPlan against the live "
+        "coordinator (transient storage errors + latency across all "
+        "components); rounds must still complete because the resilience "
+        "layer retries them in place",
+    )
+    ap.add_argument(
+        "--fault-spec",
+        default=None,
+        help="override the generated plan ('seed=' is prepended from --faults); "
+        "see xaynet_tpu.resilience.faults for the grammar",
+    )
     args = ap.parse_args()
     if args.wire_ingest and not args.device_kernel:
         ap.error("--wire-ingest requires --device-kernel")
+    if args.fault_spec is not None and args.faults is None:
+        ap.error("--fault-spec requires --faults")
+    if args.fault_spec is not None and "seed=" in args.fault_spec:
+        # FaultPlan.parse lets a later seed= clause win, which would
+        # silently override --faults and defeat a seed sweep
+        ap.error("--fault-spec must not contain 'seed=' (use --faults)")
+
+    fault_plan = None
+    if args.faults is not None:
+        spec = args.fault_spec or (
+            # steady trickle of transient faults + latency over every
+            # storage component; bounded so the tail of the soak runs clean
+            "storage.coordinator.*:error,rate=0.02,max=50;"
+            "storage.models.*:error,rate=0.02,max=20;"
+            "storage.*:latency,rate=0.02,delay=0.02,max=100"
+        )
+        fault_plan = f"seed={args.faults};{spec}"
+        # fail fast on a bad spec before booting a coordinator around it
+        from xaynet_tpu.resilience.faults import FaultPlan
+
+        FaultPlan.parse(fault_plan)
 
     with tempfile.TemporaryDirectory() as tmp:
         cfg_path = os.path.join(tmp, "config.toml")
@@ -175,6 +212,8 @@ def main() -> None:
                 )
             )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if fault_plan is not None:
+            env["XAYNET_FAULT_PLAN"] = fault_plan
         if args.device_kernel:
             flags = env.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
@@ -235,6 +274,7 @@ def main() -> None:
                     ),
                     "kernel_requested": args.device_kernel,
                     "kernel_resolved": resolved,
+                    "fault_plan": fault_plan,
                 }
             )
             print(json.dumps(result))

@@ -25,16 +25,30 @@ import logging
 
 from ..core.crypto.encrypt import SEALBYTES
 from ..core.message.message import HEADER_LENGTH
+from ..resilience.faults import maybe_fail_async
 from ..server.events import PhaseName
 from ..server.requests import RequestError, RequestSender, UpdateRequest, request_from_message
 from ..server.services import PetMessageHandler, ServiceError
 from ..server.settings import IngestSettings
+from ..telemetry.registry import get_registry
 from ..utils import tracing
 from .admission import BATCH_SIZE_HIST, Admission, AdmissionController
 from .coalescer import UpdateCoalescer
 from .intake import ShardedIntake, ShardFull
 
 logger = logging.getLogger("xaynet.ingest")
+
+WORKER_RESTARTS = get_registry().counter(
+    "xaynet_ingest_worker_restarts_total",
+    "Ingest decrypt workers restarted by the supervisor after dying "
+    "unexpectedly, by shard.",
+    ("shard",),
+)
+
+# backoff between restarts of a crash-looping worker: capped doubling, so a
+# deterministic crash (bad build) cannot busy-spin the event loop
+_RESTART_BACKOFF_BASE_S = 0.05
+_RESTART_BACKOFF_MAX_S = 5.0
 
 # phases whose tag can appear in a valid ciphertext; anything else is shed
 # before we even pay for the sealed-box open
@@ -82,7 +96,9 @@ class IngestPipeline:
         if self._workers:
             return
         self._workers = [
-            asyncio.create_task(self._worker(shard), name=f"ingest-worker-{shard.index}")
+            asyncio.create_task(
+                self._supervise(shard), name=f"ingest-worker-{shard.index}"
+            )
             for shard in self.intake.shards
         ]
         logger.info(
@@ -132,8 +148,33 @@ class IngestPipeline:
 
     # --- drain ------------------------------------------------------------
 
+    async def _supervise(self, shard) -> None:
+        """Keep the shard's decrypt worker alive: a worker that dies on an
+        unexpected error (not a single poisoned batch — those are absorbed
+        inside ``_worker``) is restarted with capped-doubling backoff, so
+        one crash never silently halves the coordinator's intake capacity
+        for the rest of the process."""
+        backoff = _RESTART_BACKOFF_BASE_S
+        while True:
+            try:
+                await self._worker(shard)
+                return  # _worker only returns on cancellation paths
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                WORKER_RESTARTS.labels(shard=str(shard.index)).inc()
+                logger.exception(
+                    "ingest worker %d died; restarting in %.2fs", shard.index, backoff
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, _RESTART_BACKOFF_MAX_S)
+
     async def _worker(self, shard) -> None:
         while True:
+            # deterministic chaos: a fault plan can kill this worker here
+            # (before any message is claimed, so nothing in flight is lost);
+            # the supervisor restarts it
+            await maybe_fail_async(f"ingest.worker.{shard.index}")
             batch = await shard.get_batch(
                 self.settings.max_batch, self.settings.linger_ms / 1000.0
             )

@@ -28,6 +28,20 @@ Fold order is FIFO (single worker), and the lazy-carry fold is an exact
 modular sum, so the aggregate is byte-identical to sequential
 ``add_batch``/``add_wire_batch`` calls over the same updates regardless of
 how far the pipeline runs ahead.
+
+**Degradation ladder (streaming -> sync -> fail).** A fold failure in the
+worker does NOT immediately poison the round: the accumulator is only
+reassigned after a fold returns, so the failed batch is retried once
+*synchronously*; on success the pipeline switches to the synchronous fold
+path for the rest of the round (submits fold on the caller's thread,
+logged + ``xaynet_streaming_degraded``) — the round completes with the
+exact same aggregate, just without overlap. Only when the synchronous
+retry ALSO fails is the pipeline poisoned — permanently, because the
+batch's updates are lost and the accumulator no longer corresponds to any
+consistent update set. Every poisoned-pipeline error names the poisoning
+batch index and the original exception. Failures surfacing at ``drain()``
+(XLA's asynchronous dispatch) skip the retry: the accumulator may already
+reference the failed computation, so no consistent retry exists.
 """
 
 from __future__ import annotations
@@ -41,6 +55,7 @@ import weakref
 import numpy as np
 
 from ..ops.fold_jax import MAX_LAZY_BATCH
+from ..resilience.faults import maybe_fail
 from ..telemetry.registry import get_registry
 from .aggregator import ShardedAggregator
 
@@ -67,12 +82,34 @@ BATCHES_TOTAL = _registry.counter(
     "folded = fold completed).",
     ("stage",),
 )
+DEGRADED = _registry.gauge(
+    "xaynet_streaming_degraded",
+    "1 while the streaming pipeline has degraded to the synchronous fold "
+    "path after a fold failure (resets with the next pipeline).",
+)
+DEGRADATIONS = _registry.counter(
+    "xaynet_streaming_degradations_total",
+    "Times a streaming pipeline degraded to the synchronous fold path.",
+)
 
 _SHUTDOWN = object()
 
 
 class StreamingError(RuntimeError):
-    """The fold worker died; the pipeline result is unusable."""
+    """The fold pipeline failed; the aggregate is unusable."""
+
+
+class _UnsafeFoldError(Exception):
+    """A fold failed at a point where the accumulator may already have been
+    reassigned (post-dispatch transfer wait / acceptance fetch): no
+    consistent synchronous retry exists, the pipeline must poison.
+    ``__cause__`` is the real failure. ``settled`` is True when the batch's
+    in-flight count was already handed off (planar ``_credit`` ran) so the
+    poison handler must not subtract it again."""
+
+    def __init__(self, settled: bool = False):
+        super().__init__()
+        self.settled = settled
 
 
 class StreamTicket:
@@ -80,7 +117,7 @@ class StreamTicket:
 
     ``accepted`` resolves at the next ``drain()``: a ``bool[K]`` per-member
     acceptance vector for wire batches, all-True for pre-validated planar
-    batches.
+    batches. (In degraded/sync mode it resolves at submit time.)
     """
 
     __slots__ = ("k", "accepted", "_ok")
@@ -172,9 +209,16 @@ class StreamingAggregator:
         self._pending: list[StreamTicket] = []  # wire tickets awaiting ok sync
         self._in_flight_models = 0  # submitted, not yet folded (upper bound)
         self._error: BaseException | None = None
+        self._poison_seq: int | None = None  # batch index that poisoned us
+        self._degraded = False  # sync fold path for the rest of the round
+        self._batch_seq = 0  # submit-order index (poisoning diagnostics)
         self._worker: threading.Thread | None = None
         self._closed = False
         self._lock = threading.Lock()  # worker-shared counters/pending
+        # a fresh pipeline is never degraded — reset the gauge here, not
+        # only in close(): a degraded pipeline abandoned on phase failure
+        # must not leave the gauge stuck at 1 for later healthy rounds
+        DEGRADED.set(0)
         # overlap accounting, reset per drain window
         self._stage_seconds = 0.0
         self._fold_seconds = 0.0
@@ -206,6 +250,8 @@ class StreamingAggregator:
         except StreamingError:
             logger.warning("closing poisoned streaming pipeline")
         self._closed = True
+        if self._degraded:
+            DEGRADED.set(0)
         if self._worker is not None and self._worker.is_alive():
             self._queue.put(_SHUTDOWN)
             self._worker.join(timeout=60.0)
@@ -227,6 +273,12 @@ class StreamingAggregator:
         with self._lock:
             return self._in_flight_models + self.agg.nb_models
 
+    @property
+    def degraded(self) -> bool:
+        """True once a fold failure switched the pipeline to the
+        synchronous fold path (the round still completes)."""
+        return self._degraded
+
     def _ring(self, kind: str) -> _StagingRing:
         ring = self._rings.get(kind)
         if ring is None:
@@ -240,23 +292,69 @@ class StreamingAggregator:
             ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
         return ring
 
+    def _poison_error(self) -> StreamingError:
+        """The sticky error, always naming the poisoning batch and cause."""
+        cause = self._error
+        seq = self._poison_seq
+        where = f"batch {seq}" if seq is not None else "deferred sync"
+        return StreamingError(
+            f"streaming pipeline poisoned at {where}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+
     def _check(self, k: int) -> None:
         if self._closed:
             raise StreamingError("pipeline is closed")
         if self._error is not None:
-            raise StreamingError("fold worker failed") from self._error
+            raise self._poison_error() from self._error
         if k > self.max_batch:
             raise ValueError(f"batch of {k} exceeds max_batch={self.max_batch}")
         if self._window_start is None:
             self._window_start = time.monotonic()
 
-    def _enqueue(self, item: tuple) -> None:
-        self._ensure_worker()
+    def _dispatch(self, item: tuple) -> None:
+        """Queue to the fold worker — or, once degraded, fold synchronously
+        on the caller's thread (same math, no overlap)."""
+        buf, payload, kind, k, ticket, seq = item
         with self._lock:
-            self._in_flight_models += item[3]
-        INFLIGHT_FOLDS.inc()
+            self._in_flight_models += k
         BATCHES_TOTAL.labels(stage="staged").inc()
-        self._queue.put(item)
+        if not self._degraded:
+            self._ensure_worker()
+            INFLIGHT_FOLDS.inc()
+            self._queue.put(item)
+            return
+        t0 = time.monotonic()
+        try:
+            # serialize with the worker: batches queued BEFORE degradation
+            # (including the retry that flipped the flag) must finish before
+            # a caller-thread fold touches agg.acc — two unsynchronized
+            # mutators would lose updates
+            self._queue.join()
+            if self._error is not None:
+                raise self._poison_error() from self._error
+            self._fold_payload(payload, kind, k, ticket, defer_ok=False)
+        except StreamingError:
+            # already-poisoned pipeline: this batch just leaves flight
+            with self._lock:
+                self._in_flight_models -= k
+            BATCHES_TOTAL.labels(stage="failed").inc()
+            raise
+        except BaseException as e:
+            unsafe = isinstance(e, _UnsafeFoldError)
+            cause = (e.__cause__ or e) if unsafe else e
+            with self._lock:
+                self._error = cause
+                self._poison_seq = seq
+                if not (unsafe and e.settled):
+                    self._in_flight_models -= k
+            BATCHES_TOTAL.labels(stage="failed").inc()
+            raise self._poison_error() from cause
+        finally:
+            self._ring(kind).release(buf)
+            with self._lock:
+                self._fold_seconds += time.monotonic() - t0
+        BATCHES_TOTAL.labels(stage="folded").inc()
 
     def submit_batch(self, stack: np.ndarray) -> StreamTicket:
         """Stage + stream-fold wire-layout ``uint32[K, model_len, L]``
@@ -279,7 +377,8 @@ class StreamingAggregator:
             view[:, :, self.agg.model_length :] = 0
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
-        self._enqueue((buf, view, "planar", k, ticket))
+        self._batch_seq += 1
+        self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
         return ticket
 
     def fold_planar_rows_now(self, rows: list) -> None:
@@ -300,7 +399,7 @@ class StreamingAggregator:
             return
         self._queue.join()
         if self._error is not None:
-            raise StreamingError("fold worker failed") from self._error
+            raise self._poison_error() from self._error
         if self._closed:
             raise StreamingError("pipeline is closed")
         import jax
@@ -331,7 +430,8 @@ class StreamingAggregator:
             np.copyto(view[i], row)
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
-        self._enqueue((buf, view, "planar", k, ticket))
+        self._batch_seq += 1
+        self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
         return ticket
 
     def submit_wire_batch(self, raw: np.ndarray) -> StreamTicket:
@@ -354,7 +454,8 @@ class StreamingAggregator:
             view[:, raw.shape[1] :] = 0  # zero bytes decode to zero elements
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
-        self._enqueue((buf, view, "wire", k, ticket))
+        self._batch_seq += 1
+        self._dispatch((buf, view, "wire", k, ticket, self._batch_seq))
         return ticket
 
     # -- fold worker -------------------------------------------------------
@@ -371,57 +472,134 @@ class StreamingAggregator:
             agg.nb_models += k
             self._in_flight_models -= k
 
-    def _process(self, item: tuple) -> None:
-        buf, payload, kind, k, ticket = item
-        agg = self.agg
-        t0 = time.monotonic()
-        ok = False
-        # updates whose count this worker still owes a handoff for: credited
-        # chunks subtract as they land, a wire ticket hands its whole count
-        # to drain(); whatever remains on error leaves flight uncredited
-        remaining = k
-        try:
-            import jax
+    def _fold_payload(self, payload, kind: str, k: int, ticket, defer_ok: bool) -> None:
+        """Fold one staged batch. ``defer_ok=True`` (worker path) leaves a
+        wire batch's acceptance vector in flight for drain's single sync;
+        ``defer_ok=False`` (degraded sync path) resolves it immediately.
 
-            if kind == "wire":
-                staged = jax.device_put(payload, agg._batch_bytes_sharding)
-                ticket._ok = agg.dispatch_staged_bytes(staged)
+        Failure classes matter here: the accumulator is reassigned only
+        when a fold call RETURNS, so an exception raised before/inside the
+        fold leaves ``agg.acc`` consistent (the degrade path may retry the
+        batch). Failures after that point — the ring-buffer transfer wait
+        and the acceptance fetch — are wrapped in ``_UnsafeFoldError``:
+        retrying them would double-fold the batch."""
+        import jax
+
+        agg = self.agg
+        if kind == "wire":
+            staged = jax.device_put(payload, agg._batch_bytes_sharding)
+            ok = agg.dispatch_staged_bytes(staged)
+            # -- acc now references this batch: no retry beyond this line --
+            if defer_ok:
+                ticket._ok = ok
                 with self._lock:
                     self._pending.append(ticket)
-                remaining = 0  # stays in flight until the drain credit
-                # the transfer out of the ring buffer must complete before
-                # reuse; the fold itself stays in flight behind it
-                jax.block_until_ready(staged)
-            else:
-                agg._resolve_kernel_cheap(k)
-                if agg.kernel_used == "native-u64":
-                    # host fold reads the ring buffer directly (synchronous)
-                    # — no device staging at all
-                    self._credit(payload, k)
-                else:
-                    staged = jax.device_put(payload, agg._batch_sharding)
-                    self._credit(staged, k)
-                    jax.block_until_ready(staged)  # host buffer free to reuse
-                remaining = 0
-                ticket.accepted = np.ones(k, dtype=bool)
-            ok = True
-        except BaseException as e:
+                try:
+                    # the transfer out of the ring buffer must complete
+                    # before reuse; the fold itself stays in flight behind it
+                    jax.block_until_ready(staged)
+                except BaseException as e:
+                    with self._lock:
+                        if ticket in self._pending:
+                            self._pending.remove(ticket)
+                    ticket._ok = None
+                    raise _UnsafeFoldError() from e
+                return
+            try:
+                ok_host = np.asarray(ok)  # acceptance sync (and fold barrier)
+            except BaseException as e:
+                raise _UnsafeFoldError() from e
+            ticket.accepted = ok_host
             with self._lock:
-                self._error = e
-            logger.exception("streaming fold worker failed")
+                agg.nb_models += int(ok_host.sum())
+                self._in_flight_models -= k
+            return
+        agg._resolve_kernel_cheap(k)
+        if agg.kernel_used == "native-u64":
+            # host fold reads the ring buffer directly (synchronous)
+            # — no device staging at all
+            self._credit(payload, k)
+        else:
+            staged = jax.device_put(payload, agg._batch_sharding)
+            self._credit(staged, k)
+            try:
+                jax.block_until_ready(staged)  # host buffer free to reuse
+            except BaseException as e:
+                # _credit already handed the count off: settled
+                raise _UnsafeFoldError(settled=True) from e
+        ticket.accepted = np.ones(k, dtype=bool)
+
+    def _degrade_and_retry(self, payload, kind: str, k: int, ticket, seq: int,
+                           first: BaseException) -> str:
+        """First fold failure with a consistent accumulator: switch the
+        pipeline to the synchronous path and retry the batch once. Returns
+        the outcome label; a second failure poisons permanently."""
+        logger.warning(
+            "streaming fold failed at batch %d (%s: %s); retrying on the "
+            "synchronous path and degrading the pipeline",
+            seq,
+            type(first).__name__,
+            first,
+        )
+        with self._lock:
+            self._degraded = True
+        DEGRADED.set(1)
+        DEGRADATIONS.inc()
+        try:
+            self._fold_payload(payload, kind, k, ticket, defer_ok=False)
+            return "folded-degraded"
+        except BaseException as second:
+            # the batch is lost: the accumulator no longer matches any
+            # consistent update set — poison permanently, with the batch
+            # index and root cause on every later error
+            unsafe = isinstance(second, _UnsafeFoldError)
+            cause = (second.__cause__ or second) if unsafe else second
+            cause.__context__ = first
+            with self._lock:
+                self._error = cause
+                self._poison_seq = seq
+                if not (unsafe and second.settled):
+                    self._in_flight_models -= k
+            logger.exception("streaming fold batch %d lost; pipeline poisoned", seq)
+            return "failed"
+
+    def _process(self, item: tuple) -> None:
+        """Worker-side fold with the degradation ladder: streaming fold ->
+        one synchronous retry (switching the pipeline to sync mode) ->
+        sticky poison naming the batch and the original exception."""
+        buf, payload, kind, k, ticket, seq = item
+        agg_t0 = time.monotonic()
+        outcome = "folded"
+        try:
+            try:
+                maybe_fail("streaming.fold")
+                self._fold_payload(payload, kind, k, ticket, defer_ok=True)
+            except BaseException as first:
+                if isinstance(first, _UnsafeFoldError):
+                    # acc may already reference the batch: retrying would
+                    # double-fold it — poison straight away
+                    cause = first.__cause__ or first
+                    with self._lock:
+                        self._error = cause
+                        self._poison_seq = seq
+                        if not first.settled:
+                            self._in_flight_models -= k
+                    outcome = "failed"
+                    logger.exception(
+                        "streaming fold batch %d failed post-dispatch; pipeline poisoned",
+                        seq,
+                    )
+                else:
+                    outcome = self._degrade_and_retry(payload, kind, k, ticket, seq, first)
         finally:
             if buf is not None:
                 self._ring("wire" if kind == "wire" else "planar").release(buf)
             with self._lock:
-                if remaining:
-                    # a dead batch leaves flight without any credit (the
-                    # error surfaces at the next submit/drain)
-                    self._in_flight_models -= remaining
-                self._fold_seconds += time.monotonic() - t0
+                self._fold_seconds += time.monotonic() - agg_t0
             INFLIGHT_FOLDS.dec()
             # a failed fold is NOT folded: dashboards comparing staged vs
             # folded must be able to see the loss
-            BATCHES_TOTAL.labels(stage="folded" if ok else "failed").inc()
+            BATCHES_TOTAL.labels(stage=outcome).inc()
 
     # -- drain -------------------------------------------------------------
 
@@ -432,9 +610,9 @@ class StreamingAggregator:
         accepted from deferred wire batches in this window."""
         self._queue.join()
         if self._error is not None:
-            # the pipeline is poisoned — PERMANENTLY: once a fold has
-            # failed the accumulator no longer corresponds to any
-            # consistent update set, so every later drain (finalize,
+            # the pipeline is poisoned — PERMANENTLY: once the degraded
+            # retry has also failed the accumulator no longer corresponds
+            # to any consistent update set, so every later drain (finalize,
             # close) must keep failing rather than let a snapshot with
             # missing/uncounted updates escape as a valid round result.
             # The deferred state is discarded once (stale tickets must not
@@ -444,7 +622,7 @@ class StreamingAggregator:
                 self._in_flight_models -= sum(t.k for t in stale)
             for ticket in stale:
                 ticket._ok = None
-            raise StreamingError("fold worker failed") from self._error
+            raise self._poison_error() from self._error
         with self._lock:
             pending, self._pending = self._pending, []
         accepted = 0
@@ -463,14 +641,16 @@ class StreamingAggregator:
             jax.block_until_ready(self.agg.acc)
         except Exception as e:
             # an asynchronously-dispatched fold failed (e.g. device OOM):
-            # poison exactly like a worker failure — drop the deferred
-            # counts and keep every later drain failing
+            # the accumulator may already reference the failed computation,
+            # so no consistent synchronous retry exists — poison exactly
+            # like an exhausted worker retry (drop the deferred counts and
+            # keep every later drain failing)
             with self._lock:
                 self._error = e
                 self._in_flight_models -= sum(t.k for t in pending)
             for ticket in pending:
                 ticket._ok = None
-            raise StreamingError("deferred fold/acceptance sync failed") from e
+            raise self._poison_error() from e
         if pending:
             # the ONE deferred credit: the accepted count lands and the
             # optimistic in-flight count drops in the same locked step, so

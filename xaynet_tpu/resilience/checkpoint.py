@@ -1,0 +1,280 @@
+"""Durable mid-round aggregate checkpoints.
+
+The update phase periodically persists the in-flight aggregate so a
+coordinator restart (or a phase failure) can RESUME the round instead of
+restarting it at Idle and discarding every accepted masked update. A
+checkpoint is consistent exactly when its ``nb_models`` equals the number
+of update participants whose seed dicts are in the store — the PET unmask
+step subtracts the mask sum over ALL seeds in the seed dictionary, so an
+aggregate missing any seeded update (or containing an unseeded one) would
+unmask to garbage. ``validate`` enforces that invariant plus the identity
+of the round (id, seed, mask config, model length) before any resume.
+
+Wire format: ``XNCKPT1`` magic, u32-le JSON-header length, JSON header,
+then the raw vector-accumulator bytes (uint32-le wire layout
+``[model_len, L]``) and unit-accumulator bytes (uint32-le ``[L_unit]``).
+The header carries sha256 digests of both payloads — a torn write must
+fail validation, never resume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..telemetry.registry import get_registry
+
+logger = logging.getLogger("xaynet.resilience")
+
+_registry = get_registry()
+CHECKPOINTS = _registry.counter(
+    "xaynet_resilience_checkpoints_total",
+    "Mid-round aggregate checkpoints written, by outcome.",
+    ("outcome",),
+)
+CHECKPOINT_SECONDS = _registry.histogram(
+    "xaynet_resilience_checkpoint_seconds",
+    "Wall time of one checkpoint write (drain + snapshot + store).",
+)
+RESUMES = _registry.counter(
+    "xaynet_resilience_round_resumes_total",
+    "Round resume attempts from a mid-round checkpoint, by outcome.",
+    ("outcome",),
+)
+
+MAGIC = b"XNCKPT1"
+
+
+class CheckpointError(ValueError):
+    """Corrupt or inconsistent checkpoint blob."""
+
+
+@dataclass
+class RoundCheckpoint:
+    """Everything needed to re-enter Update with the aggregate restored."""
+
+    round_id: int
+    phase: str  # always "update" today; versioned for later phases
+    round_seed: bytes
+    mask_config: list  # [vect enums..., unit enums...] by name
+    model_length: int
+    nb_models: int
+    seed_watermark: int  # distinct update pks in the seed dict at snapshot
+    vect: np.ndarray  # uint32 wire layout [model_len, L]
+    unit: np.ndarray  # uint32 [L_unit]
+
+    # -- serialization -----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        vect = np.ascontiguousarray(self.vect, dtype=np.uint32)
+        unit = np.ascontiguousarray(self.unit, dtype=np.uint32)
+        vect_raw = vect.tobytes()
+        unit_raw = unit.tobytes()
+        header = json.dumps(
+            {
+                "round_id": self.round_id,
+                "phase": self.phase,
+                "round_seed": self.round_seed.hex(),
+                "mask_config": self.mask_config,
+                "model_length": self.model_length,
+                "nb_models": self.nb_models,
+                "seed_watermark": self.seed_watermark,
+                "vect_shape": list(vect.shape),
+                "unit_shape": list(unit.shape),
+                "vect_sha256": hashlib.sha256(vect_raw).hexdigest(),
+                "unit_sha256": hashlib.sha256(unit_raw).hexdigest(),
+            }
+        ).encode()
+        return MAGIC + struct.pack("<I", len(header)) + header + vect_raw + unit_raw
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "RoundCheckpoint":
+        if len(blob) < len(MAGIC) + 4 or blob[: len(MAGIC)] != MAGIC:
+            raise CheckpointError("bad checkpoint magic")
+        off = len(MAGIC)
+        (hlen,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        try:
+            header = json.loads(blob[off : off + hlen].decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise CheckpointError(f"bad checkpoint header: {e}") from e
+        off += hlen
+        vect_shape = tuple(header["vect_shape"])
+        unit_shape = tuple(header["unit_shape"])
+        vect_len = int(np.prod(vect_shape)) * 4 if vect_shape else 4
+        unit_len = int(np.prod(unit_shape)) * 4 if unit_shape else 4
+        if len(blob) != off + vect_len + unit_len:
+            raise CheckpointError("truncated checkpoint payload")
+        vect_raw = blob[off : off + vect_len]
+        unit_raw = blob[off + vect_len :]
+        if hashlib.sha256(vect_raw).hexdigest() != header["vect_sha256"]:
+            raise CheckpointError("vector accumulator digest mismatch")
+        if hashlib.sha256(unit_raw).hexdigest() != header["unit_sha256"]:
+            raise CheckpointError("unit accumulator digest mismatch")
+        return cls(
+            round_id=int(header["round_id"]),
+            phase=str(header["phase"]),
+            round_seed=bytes.fromhex(header["round_seed"]),
+            mask_config=list(header["mask_config"]),
+            model_length=int(header["model_length"]),
+            nb_models=int(header["nb_models"]),
+            seed_watermark=int(header["seed_watermark"]),
+            vect=np.frombuffer(vect_raw, dtype=np.uint32).reshape(vect_shape),
+            unit=np.frombuffer(unit_raw, dtype=np.uint32).reshape(unit_shape),
+        )
+
+
+def mask_config_names(config_pair) -> list:
+    """Stable identity of a ``MaskConfigPair`` for checkpoint validation."""
+    out = []
+    for cfg in (config_pair.vect, config_pair.unit):
+        out.append(
+            [cfg.group_type.name, cfg.data_type.name, cfg.bound_type.name, cfg.model_type.name]
+        )
+    return out
+
+
+def seed_dict_watermark(seed_dict) -> int:
+    """Distinct update participants present in a (possibly None) seed dict."""
+    if not seed_dict:
+        return 0
+    pks: set = set()
+    for inner in seed_dict.values():
+        pks.update(inner.keys())
+    return len(pks)
+
+
+async def validate(ckpt: "RoundCheckpoint", state, store) -> Optional[str]:
+    """None when the checkpoint may be resumed; else the rejection reason.
+
+    ``state`` is the restored ``CoordinatorState``; ``store`` the Store the
+    round dictionaries live in. The watermark check is the consistency
+    linchpin (see module docstring).
+    """
+    if ckpt.phase != "update":
+        return f"unsupported checkpoint phase {ckpt.phase!r}"
+    if ckpt.round_id != state.round_id:
+        return f"checkpoint round {ckpt.round_id} != state round {state.round_id}"
+    if ckpt.round_seed != state.round_params.seed.as_bytes():
+        return "checkpoint round seed != state round seed"
+    if ckpt.mask_config != mask_config_names(state.round_params.mask_config):
+        return "checkpoint mask config != state mask config"
+    if ckpt.model_length != state.round_params.model_length:
+        return (
+            f"checkpoint model length {ckpt.model_length} != configured "
+            f"{state.round_params.model_length}"
+        )
+    if ckpt.vect.ndim != 2 or ckpt.vect.shape[0] != ckpt.model_length:
+        return f"checkpoint vector shape {ckpt.vect.shape} inconsistent"
+    watermark = seed_dict_watermark(await store.coordinator.seed_dict())
+    if watermark != ckpt.seed_watermark or ckpt.nb_models != ckpt.seed_watermark:
+        return (
+            f"seed-dict watermark {watermark} != checkpoint "
+            f"{ckpt.seed_watermark} (nb_models {ckpt.nb_models}): updates were "
+            "accepted after the last checkpoint; their masked models are lost"
+        )
+    return None
+
+
+async def load(store) -> Optional["RoundCheckpoint"]:
+    """Read + parse the persisted checkpoint; None when absent or corrupt
+    (a corrupt checkpoint must degrade to a round restart, never crash the
+    initializer)."""
+    try:
+        blob = await store.coordinator.round_checkpoint()
+    except Exception as e:
+        logger.warning("checkpoint read failed: %s", e)
+        return None
+    if blob is None:
+        return None
+    try:
+        return RoundCheckpoint.from_bytes(blob)
+    except CheckpointError as e:
+        logger.warning("discarding corrupt round checkpoint: %s", e)
+        return None
+
+
+class CheckpointManager:
+    """Save-cadence policy for the update phase.
+
+    ``maybe_save`` is called after every fold batch; it persists when
+    ``every_batches`` batches have accumulated since the last save or
+    ``every_s`` seconds have elapsed — whichever comes first. Saving is a
+    synchronization point (the streaming pipeline drains so the snapshot is
+    exact); the cadence bounds how much device work one checkpoint costs.
+    A failed save is logged + metered and the round continues — losing a
+    checkpoint must never fail the phase it exists to protect.
+    """
+
+    def __init__(self, shared, aggregator, every_batches: int, every_s: float):
+        self.shared = shared
+        self.aggregator = aggregator
+        self.every_batches = max(1, int(every_batches))
+        self.every_s = float(every_s)
+        self._batches_since = 0
+        self._last_save = None  # monotonic; set on first batch
+        self.saves = 0
+
+    async def maybe_save(self) -> bool:
+        import time
+
+        now = time.monotonic()
+        if self._last_save is None:
+            self._last_save = now
+        self._batches_since += 1
+        due = self._batches_since >= self.every_batches or (
+            self.every_s > 0 and now - self._last_save >= self.every_s
+        )
+        if not due:
+            return False
+        return await self._save(now)
+
+    async def _save(self, now: float) -> bool:
+        import asyncio
+
+        self._batches_since = 0
+        self._last_save = now
+        try:
+            with CHECKPOINT_SECONDS.time():
+                loop = asyncio.get_running_loop()
+                # drain + snapshot off the event loop: the drain blocks on
+                # in-flight device folds
+                vect, unit, nb = await loop.run_in_executor(
+                    None, self.aggregator.snapshot_state
+                )
+                seed_dict = await self.shared.store.coordinator.seed_dict()
+                state = self.shared.state
+                ckpt = RoundCheckpoint(
+                    round_id=self.shared.round_id,
+                    phase="update",
+                    round_seed=state.round_params.seed.as_bytes(),
+                    mask_config=mask_config_names(state.round_params.mask_config),
+                    model_length=state.round_params.model_length,
+                    nb_models=nb,
+                    seed_watermark=seed_dict_watermark(seed_dict),
+                    vect=vect,
+                    unit=unit,
+                )
+                # serialization sha256-hashes the model-sized aggregate —
+                # CPU work that must not stall the loop serving the API
+                blob = await loop.run_in_executor(None, ckpt.to_bytes)
+                await self.shared.store.coordinator.set_round_checkpoint(blob)
+        except Exception as e:
+            logger.warning("round %d: checkpoint save failed: %s", self.shared.round_id, e)
+            CHECKPOINTS.labels(outcome="failed").inc()
+            return False
+        self.saves += 1
+        CHECKPOINTS.labels(outcome="saved").inc()
+        logger.info(
+            "round %d: checkpointed update aggregate (%d models, watermark %d)",
+            self.shared.round_id,
+            ckpt.nb_models,
+            ckpt.seed_watermark,
+        )
+        return True

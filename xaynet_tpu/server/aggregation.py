@@ -272,6 +272,37 @@ class StagedAggregator:
         if self._stream is not None:
             self._stream.drain()
 
+    def snapshot_state(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """Exact host copy of the aggregate for a mid-round checkpoint.
+
+        Drains first — the streaming pipeline's in-flight folds must land
+        before the accumulator is read — then returns ``(vect wire
+        uint32[model_len, L], unit uint32[L_unit], nb_models)``.
+        """
+        self.drain()
+        if self._device is not None:
+            return self._device.snapshot(), np.array(self._unit_acc), self._device.nb_models
+        return (
+            np.array(self._host.object.vect.data),
+            np.array(self._host.object.unit.data),
+            self._host.nb_models,
+        )
+
+    def restore_state(self, vect: np.ndarray, unit: np.ndarray, nb_models: int) -> None:
+        """Restore a checkpoint snapshot into an EMPTY aggregator (resume)."""
+        if self._count or self.nb_models:
+            raise RuntimeError("restore_state requires an empty aggregator")
+        vect = np.ascontiguousarray(vect, dtype=np.uint32)
+        unit = np.ascontiguousarray(unit, dtype=np.uint32)
+        if self._device is not None:
+            self._device.restore(vect, nb_models)
+            self._unit_acc = unit
+        else:
+            self._host.object = MaskObject(
+                MaskVect(self.config.vect, vect), MaskUnit(self.config.unit, unit)
+            )
+            self._host.nb_models = nb_models
+
     def finalize(self) -> Aggregation:
         """Materialize the protocol-level ``Aggregation`` (for Unmask)."""
         self.drain()

@@ -63,6 +63,10 @@ class Shared:
     store: Store
     settings: Settings
     metrics: Optional[object] = None
+    # Failure-phase round-resume budget for the CURRENT round (reset by
+    # Idle); bounds how often one round may re-enter Update from its
+    # checkpoint before falling back to a restart
+    resume_attempts: int = 0
 
     def set_round_id(self, round_id: int) -> None:
         self.state.round_id = round_id
@@ -159,7 +163,7 @@ class PhaseState:
         from .failure import Failure
 
         logger.warning("round %d: %s phase failed: %s", self.shared.round_id, self.NAME.value, err)
-        return Failure(self.shared, err)
+        return Failure(self.shared, err, failed_phase=self.NAME)
 
     async def purge_outdated_requests(self) -> None:
         """Reject every request still queued from this phase (phase.rs:183-192)."""

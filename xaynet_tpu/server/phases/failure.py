@@ -1,9 +1,20 @@
-"""Failure phase: error capture and round restart.
+"""Failure phase: error capture, store readiness, and round recovery.
 
 Reference behavior
 (rust/xaynet-server/src/state_machine/phases/failure.rs:30-106): a broken
 request channel shuts the coordinator down; any other phase error waits for
 storage readiness and restarts the round at Idle.
+
+Resilience extensions (docs/DESIGN.md §9):
+
+- the readiness wait uses the capped-exponential + jitter backoff policy
+  instead of a fixed 1 s sleep, and the time spent waiting is metered
+  (``xaynet_store_unready_seconds_total``) instead of log-only;
+- when a valid mid-round checkpoint exists for the CURRENT round, the
+  phase prefers **round resume** (re-entering Update with the aggregate
+  restored) over a round restart — bounded by
+  ``resilience.max_resume_attempts`` per round so a deterministically
+  failing resume cannot loop forever.
 """
 
 from __future__ import annotations
@@ -11,21 +22,33 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ...resilience import checkpoint as ckpt_mod
+from ...resilience.policy import RetryPolicy
+from ...telemetry.registry import get_registry
 from ..events import PhaseName
 from ..requests import ChannelClosed
 from .base import PhaseState
 
 logger = logging.getLogger("xaynet.coordinator")
 
-STORE_READY_RETRY_SECONDS = 1.0
+STORE_UNREADY_SECONDS = get_registry().counter(
+    "xaynet_store_unready_seconds_total",
+    "Seconds the Failure phase spent waiting for storage readiness.",
+)
+STORE_READY_CHECKS = get_registry().counter(
+    "xaynet_store_ready_checks_total",
+    "Failure-phase storage readiness probes, by outcome.",
+    ("outcome",),
+)
 
 
 class Failure(PhaseState):
     NAME = PhaseName.FAILURE
 
-    def __init__(self, shared, error: Exception):
+    def __init__(self, shared, error: Exception, failed_phase: PhaseName | None = None):
         super().__init__(shared)
         self.error = error
+        self.failed_phase = failed_phase
 
     async def process(self) -> None:
         logger.warning("round %d failed: %s", self.shared.round_id, self.error)
@@ -40,15 +63,97 @@ class Failure(PhaseState):
 
             return Shutdown(self.shared)
         await self._wait_for_store_readiness()
+        resumed = await self._try_resume()
+        if resumed is not None:
+            return resumed
         from .idle import Idle
 
         return Idle(self.shared)
 
     async def _wait_for_store_readiness(self) -> None:
-        while True:
+        """Block until the store answers, backing off with jitter.
+
+        Readiness has no give-up — the coordinator is useless without its
+        store — so once the policy's ramp-up schedule is exhausted the
+        probe cadence SETTLES at the cap (it must not saw-tooth back to
+        the base delay and hammer a dead backend forever).
+        """
+        res = self.shared.settings.resilience
+        policy = RetryPolicy(
+            max_attempts=max(res.retry_max_attempts, 2),
+            base_delay_s=max(res.retry_base_ms / 1000.0, 0.05),
+            max_delay_s=max(res.retry_max_ms / 1000.0, 1.0),
+            deadline_s=res.retry_deadline_s,
+        )
+
+        def delays():
+            yield from policy.delays()
+            while True:
+                yield policy.max_delay_s
+
+        for delay in delays():
             try:
                 await self.shared.store.is_ready()
+                STORE_READY_CHECKS.labels(outcome="ready").inc()
                 return
             except Exception as err:
-                logger.warning("store not ready: %s; retrying", err)
-                await asyncio.sleep(STORE_READY_RETRY_SECONDS)
+                STORE_READY_CHECKS.labels(outcome="unready").inc()
+                STORE_UNREADY_SECONDS.inc(delay)
+                logger.warning(
+                    "store not ready: %s; retrying in %.2fs", err, delay
+                )
+                await asyncio.sleep(delay)
+
+    async def _try_resume(self):
+        """Re-enter Update from a valid checkpoint instead of restarting.
+
+        Returns the resumed phase or None. Every code path is fail-soft: a
+        broken checkpoint read/validation degrades to the Idle restart the
+        pre-resilience coordinator always did.
+        """
+        res = self.shared.settings.resilience
+        if not res.checkpoint_enabled:
+            return None
+        if self.failed_phase is not None and self.failed_phase != PhaseName.UPDATE:
+            # the checkpoint can only resume the update phase; a later
+            # phase's failure restarts the round (its participants would
+            # never resend, so a resume just times out). Update deletes the
+            # checkpoint when it completes — this guard covers the window
+            # where that deletion itself failed.
+            return None
+        attempts = self.shared.resume_attempts
+        if attempts >= res.max_resume_attempts:
+            logger.warning(
+                "round %d: resume budget exhausted (%d); restarting round",
+                self.shared.round_id,
+                attempts,
+            )
+            ckpt_mod.RESUMES.labels(outcome="budget_exhausted").inc()
+            return None
+        ckpt = await ckpt_mod.load(self.shared.store)
+        if ckpt is None:
+            return None
+        try:
+            reason = await ckpt_mod.validate(ckpt, self.shared.state, self.shared.store)
+        except Exception as err:
+            reason = f"validation failed: {err}"
+        if reason is not None:
+            logger.warning(
+                "round %d: checkpoint not resumable (%s); restarting round",
+                self.shared.round_id,
+                reason,
+            )
+            ckpt_mod.RESUMES.labels(outcome="invalid").inc()
+            return None
+        self.shared.resume_attempts = attempts + 1
+        ckpt_mod.RESUMES.labels(outcome="resumed").inc()
+        logger.info(
+            "round %d: resuming update phase from checkpoint (%d models, attempt %d/%d)",
+            self.shared.round_id,
+            ckpt.nb_models,
+            attempts + 1,
+            res.max_resume_attempts,
+        )
+        from .update import UpdatePhase
+
+        return UpdatePhase(self.shared, resume_from=ckpt)

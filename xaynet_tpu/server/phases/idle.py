@@ -32,6 +32,10 @@ class Idle(PhaseState):
 
     async def process(self) -> None:
         await self.shared.store.coordinator.delete_dicts()
+        # the previous round's mid-round checkpoint (and its resume budget)
+        # cannot outlive the dictionaries it is consistent with
+        await self.shared.store.coordinator.delete_round_checkpoint()
+        self.shared.resume_attempts = 0
         self._gen_round_keypair()
         self._update_round_probabilities()
         self._update_round_seed()

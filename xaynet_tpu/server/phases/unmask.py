@@ -14,13 +14,25 @@ decode uses the double-double fast path for f32 configs
 
 from __future__ import annotations
 
+import asyncio
+import logging
+
 import numpy as np
 
 from ...core.mask.masking import Aggregation, UnmaskingError
 from ...core.mask.object import MaskObject
 from ...telemetry import profiling
+from ...telemetry.registry import get_registry
 from ..events import ModelUpdate, PhaseName
 from .base import PhaseError, PhaseState
+
+logger = logging.getLogger("xaynet.coordinator")
+
+POINTER_UPDATE_FAILURES = get_registry().counter(
+    "xaynet_model_pointer_update_failures_total",
+    "latest_global_model_id pointer updates abandoned after retries "
+    "(the model blob IS stored; only the latest-pointer is stale).",
+)
 
 
 class Unmask(PhaseState):
@@ -81,14 +93,21 @@ class Unmask(PhaseState):
             self.shared.state.round_params.seed.as_bytes(),
             data,
         )
+        # best-effort per the reference (unmask.rs:191-198) — the retry
+        # itself lives in the ResilientStore layer every storage call flows
+        # through (stacking a second schedule here would retry up to
+        # attempts² times against a backend the breaker already declared
+        # dead). What this phase adds is the COUNT: a permanently broken
+        # pointer must be visible on /metrics, not buried in a warning log.
+        # The phase still completes either way (clients fall back to
+        # fetching the model by explicit id).
         try:
             await self.shared.store.coordinator.set_latest_global_model_id(model_id)
-        except Exception as err:  # pointer update is best-effort (unmask.rs:191-198)
-            import logging
-
-            logging.getLogger("xaynet.coordinator").warning(
-                "failed to update latest global model id: %s", err
-            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            POINTER_UPDATE_FAILURES.inc()
+            logger.warning("failed to update latest global model id: %s", err)
 
     async def _publish_proof(self) -> None:
         if self.shared.store.trust_anchor is None:

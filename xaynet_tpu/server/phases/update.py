@@ -12,23 +12,36 @@ TPU-native difference: accepted updates are *staged* and folded in batches
 by the ``StagedAggregator`` (host numpy kernels or the sharded device fold)
 instead of a per-update big-int loop; validation and seed-dict ordering are
 per-update exactly as in the reference.
+
+Resilience: when ``[resilience] checkpoint_enabled`` is on, the phase
+periodically persists the drained aggregate through the store
+(``CheckpointManager``), and the phase can be constructed with
+``resume_from`` — a validated :class:`RoundCheckpoint` — to re-enter the
+round with the aggregate restored instead of restarting at Idle
+(docs/DESIGN.md §9). A resumed phase's count window is reduced by the
+restored updates, so an already-satisfied round drains straight through.
 """
 
 from __future__ import annotations
 
 import asyncio
+import dataclasses
+import logging
 
 from ...core.mask.masking import AggregationError
+from ...resilience.checkpoint import CheckpointManager, RoundCheckpoint
 from ..aggregation import StagedAggregator
 from ..events import DictionaryUpdate, PhaseName
 from ..requests import RequestError, StateMachineRequest, UpdateRequest
 from .base import PhaseError, PhaseState
 
+logger = logging.getLogger("xaynet.coordinator")
+
 
 class UpdatePhase(PhaseState):
     NAME = PhaseName.UPDATE
 
-    def __init__(self, shared):
+    def __init__(self, shared, resume_from: RoundCheckpoint | None = None):
         super().__init__(shared)
         settings = shared.settings
         self.aggregator = StagedAggregator(
@@ -41,9 +54,47 @@ class UpdatePhase(PhaseState):
             staging_buffers=settings.aggregation.staging_buffers,
         )
         self._seed_dict = None
+        self._resumed_models = 0
+        if resume_from is not None:
+            self.aggregator.restore_state(
+                resume_from.vect, resume_from.unit, resume_from.nb_models
+            )
+            self._resumed_models = resume_from.nb_models
+            logger.info(
+                "round %d: update phase RESUMED from checkpoint (%d models restored)",
+                shared.round_id,
+                resume_from.nb_models,
+            )
+        resilience = settings.resilience
+        self._ckpt = (
+            CheckpointManager(
+                shared,
+                self.aggregator,
+                every_batches=resilience.checkpoint_every_batches,
+                every_s=resilience.checkpoint_every_s,
+            )
+            if resilience.checkpoint_enabled
+            else None
+        )
 
     async def process(self) -> None:
-        await self.process_requests(self.shared.settings.pet.update)
+        params = self.shared.settings.pet.update
+        if self._resumed_models:
+            # the restored updates already satisfied part of the window; a
+            # fully-satisfied resume drains straight through to sum2 (the
+            # participants who submitted them will not resend)
+            count = dataclasses.replace(
+                params.count,
+                min=max(params.count.min - self._resumed_models, 0),
+                max=max(params.count.max - self._resumed_models, 0),
+            )
+            params = dataclasses.replace(params, count=count)
+            # sum participants contacting a restarted coordinator need the
+            # sum dictionary re-broadcast to build their seed dicts
+            sum_dict = await self.shared.store.coordinator.sum_dict()
+            if sum_dict:
+                self.shared.events.broadcast_sum_dict(DictionaryUpdate.new(sum_dict))
+        await self.process_requests(params)
         # phase transition: drain the streaming pipeline — every submitted
         # fold completes and the deferred acceptance sync runs, off the
         # event loop (this is the one blocking synchronization point)
@@ -51,6 +102,13 @@ class UpdatePhase(PhaseState):
         self._seed_dict = await self.shared.store.coordinator.seed_dict()
         if not self._seed_dict:
             raise PhaseError("NoSeedDict", "seed dictionary missing after update phase")
+        if self._ckpt is not None:
+            # the checkpoint's useful lifetime IS the update phase: once the
+            # round moves to sum2, re-entering Update from it cannot help
+            # (sum2 masks would never be resent) — delete it so a later
+            # phase's failure restarts the round immediately instead of
+            # burning resume attempts on a deterministic timeout
+            await self.shared.store.coordinator.delete_round_checkpoint()
 
     def broadcast(self) -> None:
         self.shared.events.broadcast_seed_dict(DictionaryUpdate.new(self._seed_dict))
@@ -84,6 +142,8 @@ class UpdatePhase(PhaseState):
             # fold off the event loop so the API stays responsive during
             # large folds; handle_request awaits it, so folds serialize
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
+            if self._ckpt is not None:
+                await self._ckpt.maybe_save()
 
     async def coalesced_batch_start(self, members) -> None:
         """Batch prevalidation: when device wire ingest is on, the whole
@@ -105,3 +165,5 @@ class UpdatePhase(PhaseState):
         overlaps the in-flight fold; the pipeline drains at phase end."""
         if self.aggregator.pending:
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
+            if self._ckpt is not None:
+                await self._ckpt.maybe_save()

@@ -129,7 +129,7 @@ class RestServer:
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:
+            except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
 
     async def _route(self, method: str, target: str, body: bytes):

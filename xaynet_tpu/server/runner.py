@@ -114,6 +114,18 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
 
         if isinstance(store.models, S3ModelStorage):
             await store.models.create_bucket()
+    # deterministic chaos: a configured fault plan installs process-wide
+    # BEFORE the resilient wrapper, so storage/ingest/streaming sites all
+    # see the same seeded schedule (tools/soak.py --faults drives this)
+    if settings.resilience.fault_plan:
+        from ..resilience import FaultPlan, install_plan
+
+        install_plan(FaultPlan.parse(settings.resilience.fault_plan))
+        logger.warning("fault plan installed: %s", settings.resilience.fault_plan)
+    # every storage call flows through retry + circuit breaker from here on
+    from ..resilience import wrap_store
+
+    store = wrap_store(store, settings.resilience)
     # registry-first telemetry: the configured sink (if any) and the
     # per-round JSON reporter both consume the bridge's measurements
     reporter = (

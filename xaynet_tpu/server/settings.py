@@ -221,6 +221,69 @@ class IngestSettings:
 
 
 @dataclass
+class ResilienceSettings:
+    """Retry/breaker policy for storage calls, mid-round checkpoints, and
+    fault injection (``xaynet_tpu.resilience``).
+
+    Defaults are safe for every deployment: transient storage faults retry
+    in place with bounded backoff, the breaker stops retry pile-ups during
+    a real outage, and checkpointing/fault-injection stay off until
+    explicitly enabled.
+    """
+
+    enabled: bool = True  # wrap the store in retry + circuit breaker
+    # retry policy (decorrelated jitter): attempts counts calls, so 1 = no
+    # retry; the deadline caps total in-place blocking per storage call
+    retry_max_attempts: int = 4
+    retry_base_ms: float = 25.0
+    retry_max_ms: float = 2000.0
+    retry_deadline_s: float = 30.0
+    # circuit breaker: consecutive failures before fail-fast, seconds until
+    # the half-open probe window, concurrent half-open probes allowed
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 10.0
+    breaker_half_open_max: int = 1
+    # durable mid-round aggregate checkpoints (update phase): persist every
+    # N fold batches or T seconds, whichever comes first; 0 disables the
+    # time trigger
+    checkpoint_enabled: bool = False
+    checkpoint_every_batches: int = 8
+    checkpoint_every_s: float = 30.0
+    # Failure-phase round resume: how many times one round may re-enter
+    # Update from its checkpoint before falling back to a round restart
+    max_resume_attempts: int = 2
+    # deterministic fault plan spec ("" = off); see resilience.faults
+    fault_plan: str = ""
+
+    def validate(self) -> None:
+        if self.retry_max_attempts < 1:
+            raise SettingsError("resilience.retry_max_attempts must be >= 1")
+        if self.retry_base_ms <= 0 or self.retry_max_ms < self.retry_base_ms:
+            raise SettingsError("resilience retry delays need 0 < base <= max")
+        if self.retry_deadline_s <= 0:
+            raise SettingsError("resilience.retry_deadline_s must be > 0")
+        if self.breaker_threshold < 1:
+            raise SettingsError("resilience.breaker_threshold must be >= 1")
+        if self.breaker_reset_s <= 0:
+            raise SettingsError("resilience.breaker_reset_s must be > 0")
+        if self.breaker_half_open_max < 1:
+            raise SettingsError("resilience.breaker_half_open_max must be >= 1")
+        if self.checkpoint_every_batches < 1:
+            raise SettingsError("resilience.checkpoint_every_batches must be >= 1")
+        if self.checkpoint_every_s < 0:
+            raise SettingsError("resilience.checkpoint_every_s must be >= 0")
+        if self.max_resume_attempts < 0:
+            raise SettingsError("resilience.max_resume_attempts must be >= 0")
+        if self.fault_plan:
+            from ..resilience.faults import FaultPlan
+
+            try:
+                FaultPlan.parse(self.fault_plan)
+            except ValueError as e:
+                raise SettingsError(f"resilience.fault_plan: {e}") from e
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -232,11 +295,13 @@ class Settings:
     log: LoggingSettings = field(default_factory=LoggingSettings)
     aggregation: AggregationSettings = field(default_factory=AggregationSettings)
     ingest: IngestSettings = field(default_factory=IngestSettings)
+    resilience: ResilienceSettings = field(default_factory=ResilienceSettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
         self.ingest.validate()
+        self.resilience.validate()
         if self.model.length < 1:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
@@ -329,6 +394,8 @@ class Settings:
         log_raw = raw.get("log", {})
         agg_raw = raw.get("aggregation", {})
         ingest_raw = raw.get("ingest", {})
+        res_raw = raw.get("resilience", {})
+        res_base = base.resilience
 
         return cls(
             pet=PetSettings(
@@ -408,6 +475,39 @@ class Settings:
                 retry_after_seconds=float(
                     ingest_raw.get("retry_after_seconds", base.ingest.retry_after_seconds)
                 ),
+            ),
+            resilience=ResilienceSettings(
+                enabled=bool(res_raw.get("enabled", res_base.enabled)),
+                retry_max_attempts=int(
+                    res_raw.get("retry_max_attempts", res_base.retry_max_attempts)
+                ),
+                retry_base_ms=float(res_raw.get("retry_base_ms", res_base.retry_base_ms)),
+                retry_max_ms=float(res_raw.get("retry_max_ms", res_base.retry_max_ms)),
+                retry_deadline_s=float(
+                    res_raw.get("retry_deadline_s", res_base.retry_deadline_s)
+                ),
+                breaker_threshold=int(
+                    res_raw.get("breaker_threshold", res_base.breaker_threshold)
+                ),
+                breaker_reset_s=float(
+                    res_raw.get("breaker_reset_s", res_base.breaker_reset_s)
+                ),
+                breaker_half_open_max=int(
+                    res_raw.get("breaker_half_open_max", res_base.breaker_half_open_max)
+                ),
+                checkpoint_enabled=bool(
+                    res_raw.get("checkpoint_enabled", res_base.checkpoint_enabled)
+                ),
+                checkpoint_every_batches=int(
+                    res_raw.get("checkpoint_every_batches", res_base.checkpoint_every_batches)
+                ),
+                checkpoint_every_s=float(
+                    res_raw.get("checkpoint_every_s", res_base.checkpoint_every_s)
+                ),
+                max_resume_attempts=int(
+                    res_raw.get("max_resume_attempts", res_base.max_resume_attempts)
+                ),
+                fault_plan=str(res_raw.get("fault_plan", res_base.fault_plan)),
             ),
         )
 

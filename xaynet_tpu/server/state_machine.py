@@ -12,6 +12,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..resilience import checkpoint as ckpt_mod
 from ..storage.traits import Store
 from ..telemetry.bridge import BridgedMetrics
 from .coordinator import CoordinatorState
@@ -98,9 +99,49 @@ class StateMachineInitializer:
                     f"{state.round_params.model_length}"
                 )
             model_update = ModelUpdate.new(model)
-        return self._assemble(state, model_update)
+        resume = await self._try_resume_round(state)
+        return self._assemble(state, model_update, initial_factory=resume)
 
-    def _assemble(self, state: CoordinatorState, model_update: ModelUpdate):
+    async def _try_resume_round(self, state: CoordinatorState):
+        """Resume path for a coordinator killed MID-ROUND: when a valid
+        update-phase checkpoint exists for the restored round, the machine
+        starts in Update with the aggregate restored instead of at Idle —
+        previously accepted masked updates survive the restart
+        (docs/DESIGN.md §9). Returns a phase factory or None."""
+        if not self.settings.resilience.checkpoint_enabled:
+            return None
+        ckpt = await ckpt_mod.load(self.store)
+        if ckpt is None:
+            return None
+        try:
+            reason = await ckpt_mod.validate(ckpt, state, self.store)
+        except Exception as err:
+            reason = f"validation failed: {err}"
+        if reason is not None:
+            logger.warning("mid-round checkpoint not resumable (%s); starting at Idle", reason)
+            ckpt_mod.RESUMES.labels(outcome="invalid").inc()
+            return None
+        ckpt_mod.RESUMES.labels(outcome="resumed").inc()
+        logger.info(
+            "resuming round %d update phase from checkpoint (%d models restored)",
+            state.round_id,
+            ckpt.nb_models,
+        )
+
+        def factory(shared: Shared) -> PhaseState:
+            from .phases.update import UpdatePhase
+
+            shared.resume_attempts += 1
+            return UpdatePhase(shared, resume_from=ckpt)
+
+        return factory
+
+    def _assemble(
+        self,
+        state: CoordinatorState,
+        model_update: ModelUpdate,
+        initial_factory=None,
+    ):
         events = EventPublisher(
             round_id=state.round_id,
             keys=state.keys,
@@ -117,5 +158,6 @@ class StateMachineInitializer:
             settings=self.settings,
             metrics=self.metrics,
         )
-        machine = StateMachine(Idle(shared))
+        initial = initial_factory(shared) if initial_factory is not None else Idle(shared)
+        machine = StateMachine(initial)
         return machine, request_rx.sender(), events.subscribe()

@@ -101,6 +101,7 @@ class InMemoryCoordinatorStorage(CoordinatorStorage):
     async def delete_coordinator_data(self) -> None:
         self._state = None
         self._latest_global_model_id = None
+        await self.delete_round_checkpoint()
         await self.delete_dicts()
 
     async def delete_dicts(self) -> None:
@@ -253,3 +254,47 @@ class FileCoordinatorStorage(InMemoryCoordinatorStorage):
     async def delete_coordinator_data(self) -> None:
         await super().delete_coordinator_data()
         self._persist()
+
+    # --- mid-round checkpoint: binary sibling file (the aggregate snapshot
+    # can be model-sized; it does not belong hex-encoded inside the JSON) --
+
+    def _ckpt_path(self) -> str:
+        return self.path + ".ckpt"
+
+    async def set_round_checkpoint(self, data: bytes) -> None:
+        import asyncio
+
+        # model-sized blob: the file write goes through the executor so the
+        # event loop keeps serving the API during a checkpoint
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write_ckpt, data
+        )
+
+    def _write_ckpt(self, data: bytes) -> None:
+        import os
+
+        tmp = self._ckpt_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._ckpt_path())
+
+    async def round_checkpoint(self) -> Optional[bytes]:
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(None, self._read_ckpt)
+
+    def _read_ckpt(self) -> Optional[bytes]:
+        import os
+
+        if not os.path.exists(self._ckpt_path()):
+            return None
+        with open(self._ckpt_path(), "rb") as f:
+            return f.read()
+
+    async def delete_round_checkpoint(self) -> None:
+        import os
+
+        try:
+            os.remove(self._ckpt_path())
+        except FileNotFoundError:
+            pass

@@ -28,6 +28,7 @@ from .traits import (
     MaskScoreIncrError,
     StorageError,
     SumPartAddError,
+    TransientStorageError,
 )
 
 # --- RESP2 client ----------------------------------------------------------
@@ -71,7 +72,7 @@ class RespClient:
             self._writer.close()
             try:
                 await self._writer.wait_closed()
-            except Exception:
+            except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
         self._reader = self._writer = None
 
@@ -109,12 +110,19 @@ class RespClient:
                     last = e
                     self._drop_connection()
                     if sent and not replay_safe:
-                        raise StorageError(
+                        # the command MAY have executed server-side: mark the
+                        # error permanent so the resilience layer never
+                        # retries it — a replayed conditional insert would
+                        # surface ALREADY_* for our own landed write and
+                        # desync the seed dict from the model aggregate
+                        err = StorageError(
                             f"redis connection lost mid-command (not replayed): {e}"
-                        ) from e
+                        )
+                        err.transient = False
+                        raise err from e
                     if attempt + 1 < self.RETRY_ATTEMPTS:
                         await asyncio.sleep(self.RETRY_BASE_DELAY * (2**attempt))
-            raise StorageError(
+            raise TransientStorageError(
                 f"redis unreachable after {self.RETRY_ATTEMPTS} attempts: {last}"
             )
 
@@ -122,7 +130,7 @@ class RespClient:
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:
+            except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
         self._reader = self._writer = None
 
@@ -223,6 +231,7 @@ _K_UPDATE_SET = b"update_participants"
 _K_MASK_SUBMITTED = b"mask_submitted"
 _K_MASK_DICT = b"mask_dict"
 _K_LATEST_MODEL = b"latest_global_model_id"
+_K_ROUND_CKPT = b"round_checkpoint"
 
 
 class RedisCoordinatorStorage(CoordinatorStorage):
@@ -329,6 +338,15 @@ class RedisCoordinatorStorage(CoordinatorStorage):
     async def latest_global_model_id(self) -> Optional[str]:
         v = await self.client.command(b"GET", _K_LATEST_MODEL)
         return v.decode() if v is not None else None
+
+    async def set_round_checkpoint(self, data: bytes) -> None:
+        await self.client.command(b"SET", _K_ROUND_CKPT, data)
+
+    async def round_checkpoint(self):
+        return await self.client.command(b"GET", _K_ROUND_CKPT)
+
+    async def delete_round_checkpoint(self) -> None:
+        await self.client.command(b"DEL", _K_ROUND_CKPT)
 
     async def is_ready(self) -> None:
         pong = await self.client.command(b"PING")

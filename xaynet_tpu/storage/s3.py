@@ -22,7 +22,7 @@ import ssl as ssl_module
 from typing import Optional
 from urllib.parse import quote, urlsplit
 
-from .traits import ModelStorage, StorageError
+from .traits import ModelStorage, StorageError, TransientStorageError
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
@@ -154,7 +154,7 @@ async def _http_request(
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:
+            except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
 
     try:
@@ -162,11 +162,11 @@ async def _http_request(
     except StorageError:
         raise
     except asyncio.TimeoutError as e:
-        raise StorageError(f"object store timeout after {timeout}s") from e
+        raise TransientStorageError(f"object store timeout after {timeout}s") from e
     except (OSError, asyncio.IncompleteReadError) as e:
         # IncompleteReadError is an EOFError, not an OSError: a connection
         # severed mid-body must still surface as the typed storage failure
-        raise StorageError(f"object store unreachable: {e}") from e
+        raise TransientStorageError(f"object store unreachable: {e}") from e
     except ValueError as e:  # malformed lengths/framing from a broken proxy
         raise StorageError(f"object store sent a malformed response: {e}") from e
 

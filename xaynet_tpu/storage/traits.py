@@ -22,7 +22,28 @@ from ..core.mask.object import MaskObject
 
 
 class StorageError(RuntimeError):
-    """Infrastructure failure (connection lost, serialization bug, ...)."""
+    """Infrastructure failure (connection lost, serialization bug, ...).
+
+    ``transient`` drives the resilience layer's retry decision: ``True``
+    means retry in place, ``False`` means fail immediately, ``None`` (the
+    default) defers to ``resilience.policy.is_transient``'s heuristics.
+    """
+
+    transient: Optional[bool] = None
+
+
+class TransientStorageError(StorageError):
+    """A storage failure the backend knows is retryable (connection drop,
+    timeout, throttling) — the resilience layer retries these in place.
+
+    CONTRACT: transient means the operation was guaranteed NOT executed
+    (or the operation is idempotent). A failure where the command may have
+    executed server-side (reply lost mid-command) must be marked
+    ``transient = False`` — replaying a conditional insert whose first
+    attempt landed surfaces its dedup verdict and desyncs the seed
+    dictionary from the model aggregate."""
+
+    transient = True
 
 
 class SumPartAddError(Enum):
@@ -102,6 +123,24 @@ class CoordinatorStorage(ABC):
     @abstractmethod
     async def is_ready(self) -> None:
         """Raises ``StorageError`` when the backend is unreachable."""
+
+    # --- mid-round checkpoint (resilience) --------------------------------
+    # Concrete defaults: the checkpoint is round-volatile state with the
+    # same lifetime as the dictionaries, so an in-process fallback is
+    # correct for every backend; durable backends (file, redis) override
+    # to persist it alongside the coordinator state.
+
+    async def set_round_checkpoint(self, data: bytes) -> None:
+        """Persist the serialized mid-round aggregate checkpoint."""
+        self._round_checkpoint_mem = bytes(data)
+
+    async def round_checkpoint(self) -> Optional[bytes]:
+        """The last persisted checkpoint, or None."""
+        return getattr(self, "_round_checkpoint_mem", None)
+
+    async def delete_round_checkpoint(self) -> None:
+        """Drop the checkpoint (new round, or invalidated resume)."""
+        self._round_checkpoint_mem = None
 
 
 class ModelStorage(ABC):
