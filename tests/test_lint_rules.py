@@ -175,3 +175,49 @@ def test_swallow_rule_scoped_to_server_and_storage(tmp_path, monkeypatch):
     for rel in ("xaynet_tpu/parallel/foo.py", "tools/foo.py", "xaynet_tpu/ingest/foo.py"):
         problems = _check(tmp_path, monkeypatch, rel, source)
         assert not any("swallow" in p for p in problems), rel
+
+
+# --- the raw-HTTP/socket SDK transport rule ----------------------------------
+
+
+def test_raw_http_rejected_in_sdk_tree(tmp_path, monkeypatch):
+    source = (
+        "import asyncio\n"
+        "import socket\n"
+        "import urllib.request\n"
+        "async def a():\n"
+        "    r, w = await asyncio.open_connection('h', 80)\n"
+        "def b():\n"
+        "    urllib.request.urlopen('http://h')\n"
+        "def c():\n"
+        "    socket.create_connection(('h', 80))\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/sdk/foo.py", source)
+    assert sum("resilient client wrapper" in p for p in problems) == 3
+
+
+def test_raw_http_allowlisted_and_out_of_tree_pass(tmp_path, monkeypatch):
+    annotated = (
+        "import asyncio\n"
+        "async def a():\n"
+        "    r, w = await asyncio.open_connection('h', 80)  # lint: raw-http-ok\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/sdk/foo.py", annotated)
+    assert not any("resilient client wrapper" in p for p in problems)
+
+    bare = (
+        "import socket\n"
+        "def c():\n"
+        "    socket.create_connection(('h', 80))\n"
+    )
+    for rel in ("xaynet_tpu/server/foo.py", "tools/foo.py", "tests/foo.py"):
+        problems = _check(tmp_path, monkeypatch, rel, bare)
+        assert not any("resilient client wrapper" in p for p in problems), rel
+
+
+def test_sdk_tree_is_clean_under_raw_http_rule():
+    target = REPO / "xaynet_tpu" / "sdk"
+    problems = []
+    for path in sorted(target.rglob("*.py")):
+        problems.extend(xn_lint.check_file(path))
+    assert problems == []

@@ -1,0 +1,842 @@
+"""Round liveness under participant churn (docs/DESIGN.md §10).
+
+Pins the PR-5 contracts:
+
+1. **quorum completion** — a request window stalled at/above
+   ``count.quorum`` closes DEGRADED after the stall grace instead of
+   timing out; below quorum the window still fails, and the
+   ``PhaseTimeout`` carries the full accepted/min/quorum diagnostics;
+2. **chaos round** — ``flood`` with 30% dropout + a straggler mid-update
+   completes the round at quorum with a global model BYTE-identical to a
+   fault-free run over the same surviving participant set;
+3. **adaptive windows** — the ``RoundController`` shrinks a mis-sized
+   ``count.min`` to the offered load within the hysteresis budget, regrows
+   it when load returns, and respects floor/ceiling bounds throughout —
+   unit-level and against a live coordinator;
+4. **purge accounting** — phase-end purges land on the ``purged`` metric
+   outcome, not the in-window ``rejected`` bucket.
+"""
+
+import asyncio
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.sdk.client import InProcessClient
+from xaynet_tpu.sdk.simulation import flood, keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.events import EventPublisher, PhaseName
+from xaynet_tpu.server.metrics import Metrics
+from xaynet_tpu.server.phases.base import (
+    PHASE_OUTCOMES,
+    PhaseState,
+    PhaseTimeout,
+    Shared,
+)
+from xaynet_tpu.server.requests import RequestError, RequestReceiver, SumRequest
+from xaynet_tpu.server.round_controller import RoundController
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    SettingsError,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+
+
+def _mem_store() -> Store:
+    return Store(InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor())
+
+
+def _settings(
+    n_sum=1,
+    n_update=3,
+    update_max=None,
+    quorum=None,
+    model_len=13,
+    stall_grace=0.3,
+    update_tmax=20.0,
+) -> Settings:
+    s = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(
+                prob=0.4,
+                count=CountSettings(min=n_sum, max=n_sum),
+                time=TimeSettings(min=0.0, max=20.0),
+            ),
+            update=PhaseSettings(
+                prob=0.5,
+                count=CountSettings(
+                    min=n_update, max=update_max or n_update, quorum=quorum
+                ),
+                time=TimeSettings(min=0.0, max=update_tmax),
+            ),
+            sum2=Sum2Settings(
+                count=CountSettings(min=n_sum, max=n_sum),
+                time=TimeSettings(min=0.0, max=20.0),
+            ),
+        )
+    )
+    s.model.length = model_len
+    s.liveness.stall_grace_s = stall_grace
+    return s
+
+
+# --------------------------------------------------------------------------
+# Window-level quorum semantics
+# --------------------------------------------------------------------------
+
+
+class _AcceptAll(PhaseState):
+    NAME = PhaseName.SUM
+
+    async def handle_request(self, req):
+        if getattr(req, "participant_pk", b"") == b"reject":
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "test")
+
+
+class _SpyMetrics(Metrics):
+    """No-op sink that records purge/reject calls and free-form events."""
+
+    def __init__(self):
+        self.purged = []
+        self.rejected = []
+        self.events = []
+
+    def message_purged(self, round_id, phase):
+        self.purged.append(phase)
+
+    def message_rejected(self, round_id, phase):
+        self.rejected.append(phase)
+
+    def event(self, round_id, kind, detail=""):
+        self.events.append((kind, detail))
+
+
+def _shared(settings=None, metrics=None):
+    class _State:
+        round_id = 1
+
+    events = EventPublisher(1, None, None, PhaseName.SUM)
+    return Shared(
+        state=_State(),
+        request_rx=RequestReceiver(),
+        events=events,
+        store=None,
+        settings=settings or Settings.default(),
+        metrics=metrics,
+    )
+
+
+def _params(cmin, cmax, tmin, tmax, quorum=None):
+    return PhaseSettings(
+        prob=0.5,
+        count=CountSettings(cmin, cmax, quorum=quorum),
+        time=TimeSettings(tmin, tmax),
+    )
+
+
+def test_window_degraded_close_at_quorum_on_stall():
+    """2 of 5 arrive, quorum 2: the window closes degraded a stall-grace
+    after the last acceptance instead of burning the full time.max."""
+
+    async def run():
+        import time as time_mod
+
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 0.25
+        shared = _shared(settings)
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        tasks = [
+            asyncio.create_task(sender.request(SumRequest(bytes([i]) * 4, b"e")))
+            for i in range(2)
+        ]
+        before = PHASE_OUTCOMES.labels(phase="sum", outcome="degraded").value
+        t0 = time_mod.monotonic()
+        outcome = await phase.process_requests(_params(5, 10, 0.0, 30.0, quorum=2))
+        elapsed = time_mod.monotonic() - t0
+        assert outcome == "degraded"
+        assert elapsed < 5.0  # stalled close, nowhere near time.max = 30
+        assert PHASE_OUTCOMES.labels(phase="sum", outcome="degraded").value == before + 1
+        await asyncio.gather(*tasks)
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_window_full_close_reports_full_outcome():
+    async def run():
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 5.0
+        shared = _shared(settings)
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        tasks = [
+            asyncio.create_task(sender.request(SumRequest(bytes([i]) * 4, b"e")))
+            for i in range(3)
+        ]
+        before = PHASE_OUTCOMES.labels(phase="sum", outcome="full").value
+        outcome = await phase.process_requests(_params(3, 10, 0.0, 20.0, quorum=2))
+        assert outcome == "full"
+        assert PHASE_OUTCOMES.labels(phase="sum", outcome="full").value == before + 1
+        await asyncio.gather(*tasks)
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_window_timeout_below_quorum_rich_diagnostics():
+    """1 accepted + 1 rejected below quorum 2: PhaseTimeout names the
+    accepted/min/quorum/rejected counts and the seconds in phase."""
+
+    async def run():
+        shared = _shared()
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        ok = asyncio.create_task(sender.request(SumRequest(b"good", b"e")))
+        bad = asyncio.create_task(sender.request(SumRequest(b"reject", b"e")))
+        before = PHASE_OUTCOMES.labels(phase="sum", outcome="timeout").value
+        with pytest.raises(PhaseTimeout) as ei:
+            await phase.process_requests(_params(4, 10, 0.0, 0.5, quorum=2))
+        err = ei.value
+        assert err.accepted == 1 and err.count_min == 4 and err.quorum == 2
+        assert err.rejected == 1
+        assert err.seconds >= 0.5
+        msg = str(err)
+        assert "1 accepted / min 4 / quorum 2" in msg and "1 rejected" in msg
+        assert PHASE_OUTCOMES.labels(phase="sum", outcome="timeout").value == before + 1
+        await ok
+        with pytest.raises(RequestError):
+            await bad
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_stall_close_drains_queued_requests_first():
+    """Slow PROCESSING must not masquerade as an arrival stall: a valid
+    request that arrived in time but sat queued behind a slow reject is
+    still handled when the stall clock runs out — not purged."""
+
+    async def run():
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 0.15
+
+        class _SlowReject(_AcceptAll):
+            async def handle_request(self, req):
+                if req.participant_pk == b"reject":
+                    # burns > stall_grace without resetting the stall clock
+                    await asyncio.sleep(0.3)
+                await super().handle_request(req)
+
+        shared = _shared(settings)
+        phase = _SlowReject(shared)
+        sender = shared.request_rx.sender()
+        good1 = asyncio.create_task(sender.request(SumRequest(b"gd01", b"e")))
+        bad = asyncio.create_task(sender.request(SumRequest(b"reject", b"e")))
+        good2 = asyncio.create_task(sender.request(SumRequest(b"gd02", b"e")))
+        outcome = await phase.process_requests(_params(2, 10, 0.0, 20.0, quorum=1))
+        # the queued good2 was drained at stall time and completed the
+        # window FULL; a purge would have rejected it and closed degraded
+        assert outcome == "full"
+        await good1
+        await good2
+        with pytest.raises(RequestError):
+            await bad
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_deadline_close_never_cancels_inflight_request():
+    """``time.max`` expiring while a request is mid-handle must let the
+    handler run to completion before the degraded close is declared: a
+    cancellation between an update's seed-dict insert and its fold would
+    strand a seeded-but-never-staged update and break the
+    nb_models == seed-watermark unmask invariant (DESIGN §10)."""
+
+    async def run():
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 10.0  # only the deadline closes
+        done = []
+
+        class _SlowAccept(_AcceptAll):
+            async def handle_request(self, req):
+                if req.participant_pk == b"slow":
+                    # a two-step "atomic" handler straddling the deadline
+                    await asyncio.sleep(0.7)
+                    done.append(req.participant_pk)
+
+        shared = _shared(settings)
+        phase = _SlowAccept(shared)
+        sender = shared.request_rx.sender()
+        fast = asyncio.create_task(sender.request(SumRequest(b"fast", b"e")))
+        slow = asyncio.create_task(sender.request(SumRequest(b"slow", b"e")))
+        outcome = await phase.process_requests(_params(3, 10, 0.0, 0.3, quorum=1))
+        assert outcome == "degraded"
+        assert done == [b"slow"], "in-flight request was cancelled at time.max"
+        await asyncio.gather(fast, slow)
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_deadline_drains_queued_quorum_completing_request():
+    """A request that arrived IN time but sat queued behind slow
+    processing must still be handled when ``time.max`` expires below
+    quorum — it may lift the phase to quorum (degraded close) instead of
+    being purged by an immediate PhaseTimeout."""
+
+    async def run():
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 10.0
+        shared = _shared(settings)
+
+        class _SlowFirst(_AcceptAll):
+            async def handle_request(self, req):
+                if req.participant_pk == b"slow":
+                    await asyncio.sleep(0.5)  # overruns time.max = 0.3
+                await super().handle_request(req)
+
+        phase = _SlowFirst(shared)
+        sender = shared.request_rx.sender()
+        slow = asyncio.create_task(sender.request(SumRequest(b"slow", b"e")))
+        queued = asyncio.create_task(sender.request(SumRequest(b"qd01", b"e")))
+        outcome = await phase.process_requests(_params(3, 10, 0.0, 0.3, quorum=2))
+        # slow accepted (1) + queued drained at the deadline (2) == quorum
+        assert outcome == "degraded"
+        await asyncio.gather(slow, queued)
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_rejections_do_not_reset_stall_clock():
+    """A trickle of rejected stragglers must not keep a quorum'd window
+    open forever: only ACCEPTED messages reset the stall clock."""
+
+    async def run():
+        import time as time_mod
+
+        settings = Settings.default()
+        settings.liveness.stall_grace_s = 0.4
+        shared = _shared(settings)
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        ok = asyncio.create_task(sender.request(SumRequest(b"good", b"e")))
+
+        async def reject_trickle():
+            outcomes = []
+            for _ in range(6):
+                await asyncio.sleep(0.15)  # spaced closer than the grace
+                try:
+                    await sender.request(SumRequest(b"reject", b"e"))
+                    outcomes.append("ok")
+                except RequestError:
+                    outcomes.append("rejected")
+            return outcomes
+
+        trickle = asyncio.create_task(reject_trickle())
+        t0 = time_mod.monotonic()
+        outcome = await phase.process_requests(_params(5, 10, 0.0, 30.0, quorum=1))
+        elapsed = time_mod.monotonic() - t0
+        assert outcome == "degraded"
+        # closed ~one grace after the single acceptance, despite the trickle
+        assert elapsed < 2.0
+        await ok
+        trickle.cancel()
+        try:
+            await trickle
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_purge_counts_as_purged_not_rejected():
+    """Requests left queued at phase end land on message_purged — the
+    degraded-close straggler burst must not pollute reject dashboards."""
+
+    async def run():
+        spy = _SpyMetrics()
+        shared = _shared(metrics=spy)
+        phase = _AcceptAll(shared)
+        sender = shared.request_rx.sender()
+        ok = asyncio.create_task(sender.request(SumRequest(b"good", b"e")))
+        await phase.process_requests(_params(1, 1, 0.0, 10.0))
+        late = asyncio.create_task(sender.request(SumRequest(b"late", b"e")))
+        await asyncio.sleep(0)  # let the straggler enqueue
+        await phase.purge_outdated_requests()
+        assert spy.purged == ["sum"]
+        assert spy.rejected == []  # in-window rejects only
+        await ok
+        with pytest.raises(RequestError):
+            await late
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_quorum_validation():
+    with pytest.raises(SettingsError):
+        _settings(n_update=5, quorum=6).validate()  # quorum > min
+    with pytest.raises(SettingsError):
+        _settings(n_update=5, quorum=2).validate()  # below UPDATE floor (3)
+    _settings(n_update=5, quorum=3).validate()
+
+
+# --------------------------------------------------------------------------
+# RoundController (unit)
+# --------------------------------------------------------------------------
+
+
+def _adaptive_settings(update_min=10, update_max=20, tmax=30.0) -> Settings:
+    s = _settings(n_update=update_min, update_max=update_max, update_tmax=tmax)
+    s.liveness.adaptive = True
+    s.liveness.shrink_after = 2
+    s.liveness.grow_after = 2
+    return s
+
+
+def test_round_controller_shrinks_to_offered_load_and_regrows():
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    update = s.pet.update
+
+    # offered load is 4 << count.min 10: two failed rounds trigger a shrink
+    for _ in range(2):
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+    assert update.count.min == 4  # clamped to the observed arrivals
+    assert update.time.max == pytest.approx(45.0)  # relaxed 30 * 1.5
+
+    # load returns (12 arrivals, full rounds): regrow toward the configured
+    # ceiling, never past it, time.max decays back to the configured value
+    seen = [update.count.min]
+    for _ in range(10):
+        ctl.observe_phase("update", 12, "full", 2.0)
+        ctl.observe_phase("update", 12, "full", 2.0)
+        ctl.round_completed()
+        ctl.round_completed()
+        seen.append(update.count.min)
+    assert update.count.min == 10  # back at the configured ceiling
+    assert max(seen) == 10  # never overshot it
+    assert all(b >= a for a, b in zip(seen, seen[1:]))  # monotone regrowth
+    assert update.time.max == pytest.approx(30.0)
+
+
+def test_round_controller_shrinks_despite_healthy_history():
+    """A load DROP after a healthy era must still shrink within
+    shrink_after rounds: the stale at-min readings in the history window
+    must not mask the starved phase."""
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    update = s.pet.update
+    for _ in range(3):  # healthy era: full rounds right at count.min
+        ctl.observe_phase("update", 10, "full", 2.0)
+        ctl.round_completed()
+    for _ in range(2):  # load drops to 4: exactly shrink_after failures
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+    assert update.count.min == 4  # shrunk immediately, not `window` later
+    assert update.time.max == pytest.approx(45.0)
+
+
+def test_round_controller_regrows_past_censored_observations():
+    """Live windows close the moment ``count.min`` is reached (time.min is
+    usually 0), so full-round arrival observations are censored AT min; the
+    controller must still probe back toward the configured ceiling instead
+    of ratcheting a shrunk window down forever."""
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    update = s.pet.update
+    for _ in range(2):
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+    assert update.count.min == 4
+
+    seen = [update.count.min]
+    for _ in range(10):
+        for _ in range(2):
+            # exactly count.min accepted: what a real full window reports
+            ctl.observe_phase("update", update.count.min, "full", 2.0)
+            ctl.round_completed()
+        seen.append(update.count.min)
+    assert update.count.min == 10  # back at the configured ceiling
+    assert max(seen) == 10
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+    assert update.time.max == pytest.approx(30.0)
+
+
+def test_round_controller_time_decay_floored_by_observed_duration():
+    """time.max decays back after full rounds, but never below the window
+    durations those rounds actually took — cutting under them would
+    re-induce the timeouts the relax was for."""
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    update = s.pet.update
+    for _ in range(2):
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+    assert update.time.max == pytest.approx(45.0)  # relaxed 30 * 1.5
+    for _ in range(2):
+        # full rounds, but the windows genuinely ran 40s
+        ctl.observe_phase("update", 12, "full", 40.0)
+        ctl.round_completed()
+    assert update.time.max == pytest.approx(40.0)  # floored, not 30
+
+
+def test_round_controller_ceiling_burning_degraded_excluded_from_latency_floor():
+    """A degraded close that only fired because the (relaxed) time.max
+    expired measures the CEILING, not demand — it must not floor the
+    time.max decay once load recovers."""
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    update = s.pet.update
+    for _ in range(2):
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+    assert update.time.max == pytest.approx(45.0)  # relaxed
+    # a degraded round that burned the whole relaxed window at quorum
+    ctl.observe_phase("update", 4, "degraded", 45.0)
+    ctl.round_completed()
+    # load recovers: full rounds closing early regrow and decay time.max
+    for _ in range(2):
+        ctl.observe_phase("update", 12, "full", 2.0)
+        ctl.round_completed()
+    assert update.time.max == pytest.approx(30.0)  # decayed, not stuck at 45
+
+
+def test_resumed_window_reports_offset_arrivals_to_controller():
+    """A checkpoint-resumed update phase runs a REDUCED window; the
+    restored models were real arrivals and must be included in what the
+    adaptive controller observes, or a resumed 100-participant round looks
+    like a 5-participant deployment to the shrink clamp."""
+
+    async def run():
+        class _CtlSpy:
+            def __init__(self):
+                self.seen = []
+
+            def observe_phase(self, phase, accepted, outcome, seconds):
+                self.seen.append((phase, accepted, outcome))
+
+        ctl = _CtlSpy()
+        shared = _shared()
+        shared.round_ctl = ctl
+        phase = _AcceptAll(shared)
+        phase.arrivals_offset = 95  # what UpdatePhase sets on resume
+        sender = shared.request_rx.sender()
+        tasks = [
+            asyncio.create_task(sender.request(SumRequest(bytes([i]) * 4, b"e")))
+            for i in range(5)
+        ]
+        outcome = await phase.process_requests(_params(5, 10, 0.0, 20.0))
+        assert outcome == "full"
+        assert ctl.seen == [("sum", 100, "full")]
+        await asyncio.gather(*tasks)
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_round_controller_hysteresis_resists_alternation():
+    """full/failed alternation never reaches either streak threshold: the
+    windows must not move."""
+    s = _adaptive_settings()
+    ctl = RoundController(s)
+    for _ in range(6):
+        ctl.observe_phase("update", 4, "timeout", 30.0)
+        ctl.round_failed()
+        ctl.observe_phase("update", 12, "full", 2.0)
+        ctl.round_completed()
+    assert s.pet.update.count.min == 10
+    assert s.pet.update.time.max == pytest.approx(30.0)
+
+
+def test_round_controller_floor_and_untouched_phases():
+    """Shrinks bottom out at the protocol floor (or quorum) and never touch
+    phases that met their window or never ran."""
+    s = _adaptive_settings(update_min=4, update_max=20)
+    s.pet.sum.count.min = 1  # sum meets its window every round
+    s.liveness.shrink_after = 1
+    ctl = RoundController(s)
+    for _ in range(6):
+        ctl.observe_phase("sum", 1, "full", 0.5)
+        ctl.observe_phase("update", 0, "timeout", 30.0)
+        ctl.round_failed()
+    assert s.pet.update.count.min == 3  # UPDATE_COUNT_MIN floor
+    assert s.pet.sum.count.min == 1  # full phase untouched
+    assert s.pet.sum2.count.min == 1  # never observed -> untouched
+
+    # with a configured quorum the floor is the quorum, not the protocol min
+    s2 = _adaptive_settings(update_min=8, update_max=20)
+    s2.pet.update.count.quorum = 5
+    s2.liveness.shrink_after = 1
+    ctl2 = RoundController(s2)
+    for _ in range(6):
+        ctl2.observe_phase("update", 0, "timeout", 30.0)
+        ctl2.round_failed()
+    assert s2.pet.update.count.min == 5
+
+
+# --------------------------------------------------------------------------
+# Adaptive controller against a live coordinator
+# --------------------------------------------------------------------------
+
+
+class _ArrayModelStore(ModelStore):
+    def __init__(self, model):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+def test_adaptive_controller_converges_live():
+    """count.min = 5 but only 3 updaters exist: round 1 times out, the
+    controller shrinks the window to the offered load, and the next round
+    completes — the acceptance scenario for a mis-sized deployment."""
+
+    async def run():
+        offered = 3
+        settings = _settings(
+            n_update=5, update_max=10, model_len=7, update_tmax=1.2
+        )
+        settings.liveness.adaptive = True
+        settings.liveness.shrink_after = 1
+        store = _mem_store()
+        machine, request_tx, events = await StateMachineInitializer(settings, store).init()
+        handler = PetMessageHandler(events, request_tx)
+        fetcher = Fetcher(events)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            model = None
+            for _round in range(4):
+                while fetcher.phase().value != "sum":
+                    await asyncio.sleep(0.01)
+                params = fetcher.round_params()
+                seed = params.seed.as_bytes()
+                participants = [
+                    ParticipantSM(
+                        PetSettings(
+                            keys=keys_for_task(seed, params.sum, params.update, "sum")
+                        ),
+                        InProcessClient(fetcher, handler),
+                        _ArrayModelStore(None),
+                    )
+                ]
+                rng = np.random.default_rng(_round)
+                for i in range(offered):
+                    participants.append(
+                        ParticipantSM(
+                            PetSettings(
+                                keys=keys_for_task(
+                                    seed, params.sum, params.update, "update",
+                                    start=(10 + i) * 1000,
+                                ),
+                                scalar=Fraction(1, offered),
+                            ),
+                            InProcessClient(fetcher, handler),
+                            _ArrayModelStore(
+                                rng.uniform(-1, 1, 7).astype(np.float32)
+                            ),
+                        )
+                    )
+
+                async def drive(sm):
+                    for _ in range(600):
+                        try:
+                            await sm.transition()
+                        except Exception:
+                            pass
+                        if fetcher.model() is not None:
+                            return
+                        if fetcher.round_params().seed.as_bytes() != seed:
+                            return  # round failed; next loop builds anew
+                        await asyncio.sleep(0.01)
+
+                await asyncio.gather(*(drive(p) for p in participants))
+                if fetcher.model() is not None:
+                    model = np.asarray(fetcher.model())
+                    break
+            assert model is not None, "no round ever completed"
+            # the controller converged onto the offered load
+            assert settings.pet.update.count.min == offered
+            return model
+        finally:
+            machine_task.cancel()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+# --------------------------------------------------------------------------
+# Seeded chaos: dropout + stragglers mid-update, quorum completion
+# --------------------------------------------------------------------------
+
+N_FLOOD = 5
+DROPOUT = 0.3  # 2 of 5 withheld -> 3 survivors
+SCALAR = Fraction(1, N_FLOOD)
+
+
+def _flood_models(model_len: int) -> list:
+    rng = np.random.default_rng(99)
+    return [rng.uniform(-1, 1, model_len).astype(np.float32) for _ in range(N_FLOOD)]
+
+
+async def _drive_flood_round(settings, store, models, metrics=None, **flood_kwargs):
+    """Sum leg via the SDK FSM, update leg via ``flood``; returns
+    (global model or None, flood stats)."""
+    init = StateMachineInitializer(settings, store, metrics=metrics)
+    machine, request_tx, events = await init.init()
+    handler = PetMessageHandler(events, request_tx)
+    fetcher = Fetcher(events)
+    machine_task = asyncio.create_task(machine.run())
+    try:
+        while fetcher.phase().value != "sum":
+            await asyncio.sleep(0.01)
+        params = fetcher.round_params()
+        seed = params.seed.as_bytes()
+        summer = ParticipantSM(
+            PetSettings(keys=keys_for_task(seed, params.sum, params.update, "sum")),
+            InProcessClient(fetcher, handler),
+            _ArrayModelStore(None),
+        )
+        # drive the summer through Sum so the sum dictionary broadcasts
+        for _ in range(100):
+            await summer.transition()
+            if fetcher.sum_dict():
+                break
+            await asyncio.sleep(0.01)
+        sum_dict = fetcher.sum_dict()
+        assert sum_dict, "sum dictionary never appeared"
+        while fetcher.phase().value != "update":
+            await asyncio.sleep(0.01)
+        stats = await flood(
+            handler,
+            params,
+            sum_dict,
+            len(models),
+            models=models,
+            scalar=SCALAR,
+            **flood_kwargs,
+        )
+        # the summer completes sum2 (or the round fails); either way the
+        # machine leaves the current round
+        for _ in range(800):
+            await summer.transition()
+            if fetcher.model() is not None:
+                return np.asarray(fetcher.model()), stats
+            if fetcher.round_params().seed.as_bytes() != seed:
+                return None, stats  # round failed and restarted
+            await asyncio.sleep(0.01)
+        raise AssertionError("round neither completed nor failed")
+    finally:
+        machine_task.cancel()
+        try:
+            await machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+def test_chaos_dropout_round_completes_degraded_at_quorum_byte_identical():
+    model_len = 13
+    models = _flood_models(model_len)
+
+    # chaos run: count.min demands all 5, quorum allows the 3 survivors;
+    # one survivor straggles (still inside the stall grace)
+    chaos_settings = _settings(
+        n_update=N_FLOOD, quorum=3, model_len=model_len, stall_grace=0.4
+    )
+    degraded_before = PHASE_OUTCOMES.labels(phase="update", outcome="degraded").value
+    chaos_model, stats = asyncio.run(
+        asyncio.wait_for(
+            _drive_flood_round(
+                chaos_settings,
+                _mem_store(),
+                models,
+                dropout_rate=DROPOUT,
+                stragglers=1,
+                straggle_delay_s=0.05,
+                churn_seed=7,
+            ),
+            timeout=90,
+        )
+    )
+    assert chaos_model is not None, "chaos round failed instead of degrading"
+    assert stats.dropped == 2 and stats.straggled == 1
+    assert stats.accepted == 3  # exactly the survivors landed
+    assert (
+        PHASE_OUTCOMES.labels(phase="update", outcome="degraded").value
+        == degraded_before + 1
+    )
+
+    # control run: the SAME surviving models (same scalar), no faults, a
+    # window sized to them — byte-identical unmasked global model
+    survivors = [m for i, m in enumerate(models) if i not in stats.dropped_indices]
+    assert len(survivors) == 3
+    control_settings = _settings(n_update=3, model_len=model_len)
+    control_model, control_stats = asyncio.run(
+        asyncio.wait_for(
+            _drive_flood_round(control_settings, _mem_store(), survivors),
+            timeout=90,
+        )
+    )
+    assert control_model is not None and control_stats.accepted == 3
+    assert chaos_model.tobytes() == control_model.tobytes()
+
+    # and the float content is the scalar-weighted mean over the survivors
+    # (unmask normalizes by the aggregated scalar sum: 3 x 1/5 here)
+    expected = sum(m.astype(np.float64) for m in survivors) / len(survivors)
+    np.testing.assert_allclose(chaos_model, expected, atol=1e-6)
+
+
+def test_chaos_below_quorum_still_fails_with_diagnostics():
+    """4 of 5 dropped -> 1 survivor < quorum 3: the round must FAIL (no
+    silent quorum bypass), and the failure event carries the enriched
+    PhaseTimeout diagnostics."""
+    model_len = 13
+    models = _flood_models(model_len)
+    settings = _settings(
+        n_update=N_FLOOD,
+        quorum=3,
+        model_len=model_len,
+        stall_grace=0.2,
+        update_tmax=1.5,
+    )
+    spy = _SpyMetrics()
+    timeout_before = PHASE_OUTCOMES.labels(phase="update", outcome="timeout").value
+    model, stats = asyncio.run(
+        asyncio.wait_for(
+            _drive_flood_round(
+                settings,
+                _mem_store(),
+                models,
+                metrics=spy,
+                dropout_rate=0.8,  # 4 of 5 withheld
+                churn_seed=7,
+            ),
+            timeout=90,
+        )
+    )
+    assert model is None, "below-quorum round must not produce a model"
+    assert stats.accepted == 1
+    assert (
+        PHASE_OUTCOMES.labels(phase="update", outcome="timeout").value
+        == timeout_before + 1
+    )
+    errors = [d for k, d in spy.events if k == "phase_error"]
+    assert any(
+        "1 accepted / min 5 / quorum 3" in d and "s in phase" in d for d in errors
+    ), f"enriched diagnostics missing from failure events: {errors}"
+
+    asyncio.run(asyncio.sleep(0))  # drain any lingering loop callbacks

@@ -1,0 +1,104 @@
+"""Shutdown phase: in-flight requests are drained/rejected, the channel
+closes exactly once, and a second close is a harmless no-op."""
+
+import asyncio
+
+import pytest
+
+from xaynet_tpu.server.events import EventPublisher, PhaseName
+from xaynet_tpu.server.phases.base import Shared
+from xaynet_tpu.server.phases.shutdown import Shutdown
+from xaynet_tpu.server.requests import (
+    CoalescedUpdates,
+    RequestError,
+    RequestReceiver,
+    SumRequest,
+    UpdateRequest,
+)
+from xaynet_tpu.server.settings import Settings
+
+
+def _shared(rx: RequestReceiver) -> Shared:
+    class _State:
+        round_id = 3
+
+    events = EventPublisher(3, None, None, PhaseName.SUM)
+    return Shared(
+        state=_State(), request_rx=rx, events=events, store=None,
+        settings=Settings.default(),
+    )
+
+
+def test_shutdown_drains_inflight_and_closes_channel_exactly_once():
+    async def run():
+        rx = RequestReceiver()
+        shared = _shared(rx)
+        sender = rx.sender()
+        loop = asyncio.get_running_loop()
+
+        # three queued singles + one coalesced micro-batch, all in flight
+        singles = [
+            asyncio.create_task(sender.request(SumRequest(bytes([i]) * 4, b"e")))
+            for i in range(3)
+        ]
+        members = [
+            UpdateRequest(b"u1" * 16, {}, None),
+            UpdateRequest(b"u2" * 16, {}, None),
+        ]
+        member_futs = [loop.create_future() for _ in members]
+        batch = asyncio.create_task(
+            sender.request(CoalescedUpdates(members=members, responses=member_futs))
+        )
+        await asyncio.sleep(0)  # let every request enqueue
+
+        close_calls = []
+        orig_close = rx.close
+
+        def counting_close():
+            close_calls.append(1)
+            orig_close()
+
+        rx.close = counting_close
+
+        result = await Shutdown(shared).run_phase()
+        assert result is None  # the machine terminates after Shutdown
+
+        # the phase closed the channel exactly once
+        assert close_calls == [1]
+
+        # every queued request was rejected, none left hanging
+        for task in singles + [batch]:
+            with pytest.raises(RequestError) as ei:
+                await task
+            assert ei.value.kind == RequestError.Kind.INTERNAL
+        for fut in member_futs:
+            assert fut.done()
+            assert isinstance(fut.exception(), RequestError)
+
+        # the drain consumed the shutdown sentinel and left nothing queued
+        assert rx.try_recv() is None
+
+        # second close: idempotent no-op (no double sentinel, no error)
+        rx.close()
+        assert close_calls == [1, 1]
+        assert rx.try_recv() is None
+
+        # post-shutdown submissions fail fast instead of hanging
+        with pytest.raises(RequestError) as ei:
+            await sender.request(SumRequest(b"late" * 8, b"e"))
+        assert ei.value.kind == RequestError.Kind.INTERNAL
+
+    asyncio.run(asyncio.wait_for(run(), 20))
+
+
+def test_shutdown_on_empty_channel_is_clean():
+    async def run():
+        rx = RequestReceiver()
+        shared = _shared(rx)
+        assert await Shutdown(shared).run_phase() is None
+        # sentinel consumed, queue empty, channel refuses new work
+        assert rx.try_recv() is None
+        with pytest.raises(RequestError):
+            await rx.sender().request(SumRequest(b"x" * 32, b"e"))
+
+    asyncio.run(asyncio.wait_for(run(), 20))

@@ -33,6 +33,13 @@ Checks:
     allocations stay dead. Annotate a deliberate exception (tiny
     non-update tensors) with ``# lint: device-put-ok`` on the offending
     line.
+  - raw HTTP/socket transport calls under ``xaynet_tpu/sdk``
+    (``urllib.request.urlopen``, ``socket.create_connection``,
+    ``asyncio.open_connection``, bare ``socket()``): every coordinator
+    conversation must flow through the client layer so the resilient
+    wrapper's retry/Retry-After/typed-error semantics apply. The one
+    legitimate transport (``HttpClient._request``) is annotated with
+    ``# lint: raw-http-ok``.
   - silent broad-exception swallows (``except Exception: pass`` and
     friends) under ``xaynet_tpu/server`` and ``xaynet_tpu/storage``: a
     coordinator-side failure must be logged, metered, retried or
@@ -196,6 +203,23 @@ def _is_silent_broad_swallow(node: ast.ExceptHandler) -> bool:
     return True
 
 
+# transport entry points that bypass the resilient client wrapper when
+# called directly from SDK code
+_RAW_HTTP_CALLEES = frozenset(
+    {"urlopen", "urlretrieve", "open_connection", "create_connection", "socket"}
+)
+
+
+def _is_raw_http_call(node: ast.Call) -> bool:
+    """True for direct transport constructions (urllib/socket/asyncio
+    streams) — syntactic, like the queue rule: any spelling that resolves
+    to one of the raw entry points counts."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _RAW_HTTP_CALLEES
+    return isinstance(func, ast.Name) and func.id in _RAW_HTTP_CALLEES
+
+
 def _is_device_put(node: ast.Call) -> bool:
     """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
     rule is syntactic, like the queue rule: any spelling that resolves to
@@ -265,6 +289,8 @@ def check_file(path: Path) -> list[str]:
     # coordinator/storage trees: silent broad swallows hide infrastructure
     # failures from the resilience layer and the operator
     no_swallow_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/storage"))
+    # SDK tree: raw transports bypass the resilient client wrapper
+    sdk_tree = str(rel).startswith("xaynet_tpu/sdk")
     src_lines = text.splitlines()
 
     def line_of(node: ast.AST) -> str:
@@ -291,6 +317,14 @@ def check_file(path: Path) -> list[str]:
                     f"{rel}:{node.lineno}: unbounded asyncio.Queue() in the "
                     "coordinator tree (pass a maxsize, or annotate a deliberate "
                     "sentinel/upstream-bounded channel with '# lint: unbounded-ok')"
+                )
+        if sdk_tree and isinstance(node, ast.Call) and _is_raw_http_call(node):
+            if "lint: raw-http-ok" not in line_of(node):
+                problems.append(
+                    f"{rel}:{node.lineno}: raw HTTP/socket call in the SDK tree "
+                    "bypasses the resilient client wrapper (route coordinator "
+                    "traffic through sdk.client.HttpClient/ResilientClient, or "
+                    "annotate the transport itself with '# lint: raw-http-ok')"
                 )
         if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
             if "lint: device-put-ok" not in line_of(node):

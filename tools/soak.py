@@ -50,11 +50,15 @@ max = 20.0
 [pet.update]
 prob = 0.9
 [pet.update.count]
-min = 3
-max = 3
+min = {update_min}
+max = {update_max}
+{update_quorum_line}
 [pet.update.time]
 min = 0.0
 max = 20.0
+
+[liveness]
+stall_grace_s = {stall_grace}
 
 [pet.sum2.count]
 min = 1
@@ -80,6 +84,113 @@ model_dir = "{model_dir}"
 # info: the soak artifact reads the aggregator's "kernel resolved" line
 filter = "info"
 """
+
+
+N_CHAOS_UPDATERS = 6
+
+
+def run_chaos_soak_sync(
+    port: int, rounds: int, model_len: int, dropout: float, stragglers: int
+) -> dict:
+    """Churn soak: the sum leg runs a real Participant; the update leg is
+    driven by ``flood`` with the dropout/straggler knobs, so every round
+    exercises the quorum-completion (degraded close) path end to end over
+    the REST socket. Returns per-run churn totals alongside the round
+    count."""
+    from fractions import Fraction
+
+    import numpy as np
+
+    from xaynet_tpu.sdk.client import HttpClient, ResilientClient
+    from xaynet_tpu.sdk.participant import Participant
+    from xaynet_tpu.sdk.simulation import flood, keys_for_task
+
+    url = f"http://127.0.0.1:{port}"
+
+    def _client():
+        # a multi-hundred-round soak must survive the transient blips it
+        # exists to exercise: one connection reset on a bare HttpClient
+        # would abort the whole run (the sum leg already retries — the
+        # Participant wraps its client in ResilientClient by default)
+        return ResilientClient(HttpClient(url))
+
+    def fetch_params():
+        return asyncio.run(_client().get_round_params())
+
+    completed = 0
+    dropped_total = straggled_total = accepted_total = 0
+    last_seed = None
+    t0 = time.perf_counter()
+    while completed < rounds:
+        params = fetch_params()
+        if params.seed.as_bytes() == last_seed:
+            time.sleep(0.01)
+            continue
+        last_seed = params.seed.as_bytes()
+        seed = last_seed
+        summer = Participant(
+            url,
+            keys=keys_for_task(seed, params.sum, params.update, "sum"),
+            scalar=Fraction(1, N_CHAOS_UPDATERS),
+        )
+        # drive the summer through Sum so the sum dictionary exists
+        for _ in range(200):
+            summer.tick()
+            sum_dict = asyncio.run(_client().get_sums())
+            if sum_dict:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(f"round {completed + 1}: sum dictionary never appeared")
+
+        async def flood_updates():
+            client = _client()
+
+            async def submit(blob: bytes) -> None:
+                await client.send_message(blob)
+
+            rng = np.random.default_rng(completed + 1)
+            return await flood(
+                submit,
+                params,
+                sum_dict,
+                N_CHAOS_UPDATERS,
+                models=[
+                    rng.uniform(-1, 1, model_len).astype(np.float32)
+                    for _ in range(N_CHAOS_UPDATERS)
+                ],
+                scalar=Fraction(1, N_CHAOS_UPDATERS),
+                key_spacing=100_000,
+                dropout_rate=dropout,
+                stragglers=stragglers,
+                straggle_delay_s=0.3,
+                churn_seed=completed + 1,
+            )
+
+        stats = asyncio.run(flood_updates())
+        dropped_total += stats.dropped
+        straggled_total += stats.straggled
+        accepted_total += stats.accepted
+        # the summer finishes sum2 and the round closes (degraded when the
+        # dropouts left the window below count.min)
+        try:
+            for _ in range(400):
+                summer.tick()
+                if fetch_params().seed.as_bytes() != seed:
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"round {completed + 1} did not complete")
+        finally:
+            summer.close()
+        completed += 1
+    return {
+        "rounds": completed,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "updates_accepted": accepted_total,
+        "updates_dropped": dropped_total,
+        "updates_straggled": straggled_total,
+    }
 
 
 def run_soak_sync(port: int, rounds: int, model_len: int) -> dict:
@@ -154,6 +265,23 @@ def main() -> None:
         "device-ingest mode over many rounds",
     )
     ap.add_argument(
+        "--dropout",
+        type=float,
+        default=None,
+        metavar="RATE",
+        help="churn soak: drive updates through flood() with this dropout "
+        "fraction; the coordinator runs with a quorum'd update window and "
+        "closes those rounds DEGRADED instead of timing out",
+    )
+    ap.add_argument(
+        "--stragglers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="churn soak: delay N of the surviving update uploads per round "
+        "(they still land inside the stall grace window)",
+    )
+    ap.add_argument(
         "--faults",
         type=int,
         default=None,
@@ -172,6 +300,20 @@ def main() -> None:
     args = ap.parse_args()
     if args.wire_ingest and not args.device_kernel:
         ap.error("--wire-ingest requires --device-kernel")
+    chaos = args.dropout is not None or args.stragglers is not None
+    dropout = args.dropout or 0.0
+    stragglers = args.stragglers or 0
+    if chaos:
+        if not (0.0 <= dropout < 1.0):
+            ap.error("--dropout must be in [0, 1)")
+        survivors = N_CHAOS_UPDATERS - int(round(N_CHAOS_UPDATERS * dropout))
+        if survivors < 3:  # UPDATE_COUNT_MIN: below this no quorum can help
+            ap.error(
+                f"--dropout {dropout} leaves {survivors} of {N_CHAOS_UPDATERS} "
+                "updaters; the PET update floor is 3"
+            )
+        if stragglers < 0 or stragglers > survivors:
+            ap.error("--stragglers must be in [0, survivors]")
     if args.fault_spec is not None and args.faults is None:
         ap.error("--fault-spec requires --faults")
     if args.fault_spec is not None and "seed=" in args.fault_spec:
@@ -209,6 +351,13 @@ def main() -> None:
                     # the device path so every round actually flushes
                     agg_batch=2 if args.device_kernel else 64,
                     agg_kernel=args.device_kernel or "auto",
+                    # churn soak: full updater fan-in as the window, quorum
+                    # at the floor so dropped-out rounds close degraded
+                    update_min=N_CHAOS_UPDATERS if chaos else 3,
+                    update_max=N_CHAOS_UPDATERS if chaos else 3,
+                    update_quorum_line="quorum = 3" if chaos else "",
+                    # stragglers delay 0.3s: inside the grace, so they count
+                    stall_grace=1.0,
                 )
             )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -248,9 +397,17 @@ def main() -> None:
             # per-round growth; the steady-state rate is what a leak looks
             # like (same split the bench_round RSS gate uses)
             warmup_rounds = min(20, max(1, args.rounds // 10))
-            run_soak_sync(args.port, warmup_rounds, args.model_len)
+
+            def run_block(n_rounds: int) -> dict:
+                if chaos:
+                    return run_chaos_soak_sync(
+                        args.port, n_rounds, args.model_len, dropout, stragglers
+                    )
+                return run_soak_sync(args.port, n_rounds, args.model_len)
+
+            run_block(warmup_rounds)
             rss_warm = _rss_kb(proc.pid)
-            result = run_soak_sync(args.port, args.rounds, args.model_len)
+            result = run_block(args.rounds)
             rss_end = _rss_kb(proc.pid)
             resolved = None
             if args.device_kernel:
@@ -275,6 +432,8 @@ def main() -> None:
                     "kernel_requested": args.device_kernel,
                     "kernel_resolved": resolved,
                     "fault_plan": fault_plan,
+                    "dropout": dropout if chaos else None,
+                    "stragglers": stragglers if chaos else None,
                 }
             )
             print(json.dumps(result))

@@ -2,7 +2,10 @@
 
 A :class:`FaultPlan` is a seeded schedule of faults keyed by *site* — a
 dotted string naming an injection point (``storage.coordinator.seed_dict``,
-``ingest.worker.0``, ``streaming.fold``). Sites consult the plan on every
+``ingest.worker.0``, ``streaming.fold``; participant side:
+``sdk.send`` fails a send attempt, ``sdk.drop`` silently loses the message
+on the wire, ``sdk.straggle`` delays it — see
+``sdk.client.ResilientClient``). Sites consult the plan on every
 call; whether the Nth call at a site faults depends only on the plan's
 seed, its rules and N — never on wall clock, thread timing or hash
 randomization — so a chaos scenario that fails in CI replays byte-for-byte

@@ -117,6 +117,7 @@ class RetryPolicy:
         site: str = "unnamed",
         classify: Callable[[BaseException], bool] = is_transient,
         no_retry: tuple = (),
+        delay_floor: Optional[Callable[[BaseException], Optional[float]]] = None,
         **kwargs,
     ):
         """Run ``fn(*args, **kwargs)``, retrying transient failures.
@@ -125,6 +126,9 @@ class RetryPolicy:
         the first failure. When the policy is exhausted the LAST transient
         error propagates (not a wrapper): callers keep their existing
         except clauses, and the giveup is recorded on the metrics instead.
+        ``delay_floor(err)`` may return a minimum for the next sleep — the
+        SDK client feeds a server-sent ``Retry-After`` through it so a
+        shedding coordinator is never hammered faster than it asked.
         """
         t0 = time.monotonic()
         attempts = 0
@@ -143,6 +147,10 @@ class RetryPolicy:
                 if not classify(err):
                     raise
                 delay = next(schedule, None)
+                if delay is not None and delay_floor is not None:
+                    floor = delay_floor(err)
+                    if floor:
+                        delay = max(delay, float(floor))
                 elapsed = time.monotonic() - t0
                 if delay is None or elapsed + delay > self.deadline_s:
                     GIVEUPS.labels(site=site).inc()

@@ -12,7 +12,15 @@ from .api import (
     spawn_async_participant,
     spawn_participant,
 )
-from .client import HttpClient, InProcessClient
+from .client import (
+    ClientError,
+    ClientPermanentError,
+    ClientShedError,
+    ClientTransientError,
+    HttpClient,
+    InProcessClient,
+    ResilientClient,
+)
 from .participant import Participant
 from .state_machine import PetSettings, PhaseKind, StateMachine, Task, TransitionOutcome
 from .traits import ModelStore, Notify, XaynetClient
@@ -23,8 +31,13 @@ __all__ = [
     "ParticipantABC",
     "spawn_async_participant",
     "spawn_participant",
+    "ClientError",
+    "ClientPermanentError",
+    "ClientShedError",
+    "ClientTransientError",
     "HttpClient",
     "InProcessClient",
+    "ResilientClient",
     "Participant",
     "PetSettings",
     "PhaseKind",

@@ -1,22 +1,90 @@
-"""Coordinator clients: in-process (simulation/tests) and HTTP.
+"""Coordinator clients: in-process (simulation/tests), HTTP, and the
+retrying :class:`ResilientClient` wrapper.
 
 Reference surface: rust/xaynet-sdk/src/client.rs:59-213 (five endpoints:
 params / sums / seeds / model / message). The in-process client talks
 directly to a coordinator's fetcher and message handler — the reference
 proves the whole protocol is testable without a network
 (SURVEY §4: in-process multi-node).
+
+Error taxonomy (docs/DESIGN.md §10): every HTTP failure surfaces as a
+typed :class:`ClientError` instead of a bare ``RuntimeError`` —
+``ClientShedError`` for a 429 from the admission controller (carrying the
+server's ``Retry-After``), ``ClientTransientError`` for connection-level
+faults and retryable statuses, ``ClientPermanentError`` for everything a
+retry cannot fix — so the retry wrapper and the participant state machine
+classify without string-matching. ``ResilientClient`` wraps any
+``XaynetClient`` with the resilience layer's decorrelated-jitter
+``RetryPolicy``, honoring ``Retry-After`` as a backoff floor.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import logging
 from typing import Optional
 
 import numpy as np
 
 from ..core.common import RoundParameters, UpdateSeedDict
+from ..resilience.policy import RetryPolicy
+from ..telemetry.registry import get_registry
 from .traits import XaynetClient
+
+logger = logging.getLogger("xaynet.participant")
+
+_registry = get_registry()
+CLIENT_DROPS = _registry.counter(
+    "xaynet_sdk_client_injected_drops_total",
+    "SDK sends silently dropped by the installed fault plan (sdk.drop).",
+)
+
+
+class ClientError(Exception):
+    """A coordinator call failed; ``transient`` drives retry decisions."""
+
+    transient = False
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
+class ClientPermanentError(ClientError):
+    """Retrying cannot help (4xx protocol errors, malformed responses)."""
+
+
+class ClientTransientError(ClientError):
+    """Worth retrying in place: connection faults, timeouts, 5xx."""
+
+    transient = True
+
+
+class ClientShedError(ClientTransientError):
+    """HTTP 429 from the admission controller; ``retry_after`` is the
+    server-requested backoff floor in seconds."""
+
+
+# non-5xx statuses a retry can fix: request timeout and too-early
+_TRANSIENT_STATUSES = frozenset({408, 425})
+
+
+def classify_status(
+    status: int, retry_after: Optional[float], context: str
+) -> ClientError:
+    """Map an HTTP error status onto the typed hierarchy: any 5xx is
+    transient except 501 Not Implemented (that never heals) — proxies in
+    front of a coordinator emit plenty beyond the 502/503/504 gateway
+    family (507, 520-529, ...), and all of them mean "try again"."""
+    message = f"{context} -> {status}"
+    if status == 429:
+        return ClientShedError(message, status=status, retry_after=retry_after)
+    if status in _TRANSIENT_STATUSES or (500 <= status < 600 and status != 501):
+        return ClientTransientError(message, status=status, retry_after=retry_after)
+    return ClientPermanentError(message, status=status)
 
 
 class InProcessClient(XaynetClient):
@@ -54,7 +122,10 @@ class InProcessClient(XaynetClient):
 class HttpClient(XaynetClient):
     """HTTP client for a remote coordinator (REST API, rest.py).
 
-    Uses asyncio streams directly — no third-party HTTP dependency.
+    Uses asyncio streams directly — no third-party HTTP dependency. This
+    is the transport the resilient wrapper sits on; deployments should
+    construct ``ResilientClient(HttpClient(url))`` (what ``Participant``
+    does for URL arguments).
     """
 
     def __init__(self, base_url: str, timeout: float = 30.0, tls_context=None):
@@ -73,30 +144,31 @@ class HttpClient(XaynetClient):
 
     async def _request(
         self, method: str, path: str, body: bytes | None = None
-    ) -> tuple[int, bytes]:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port, ssl=self.tls), self.timeout
-        )
+    ) -> tuple[int, dict, bytes]:
+        """One request; returns (status, lowercased headers, payload).
+
+        Connection-level faults (refused, reset, timed out, truncated)
+        surface as ``ClientTransientError`` — the transport layer cannot
+        produce a permanent verdict, only a status line can.
+        """
         try:
-            head = (
-                f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                f"Content-Length: {len(body) if body else 0}\r\n"
-                "Connection: close\r\n\r\n"
-            ).encode()
-            writer.write(head + (body or b""))
-            await writer.drain()
-            status_line = await asyncio.wait_for(reader.readline(), self.timeout)
-            status = int(status_line.split()[1])
-            content_length = 0
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b""):
-                    break
-                name, _, value = line.decode().partition(":")
-                if name.strip().lower() == "content-length":
-                    content_length = int(value.strip())
-            payload = await reader.readexactly(content_length) if content_length else b""
-            return status, payload
+            reader, writer = await asyncio.wait_for(
+                # the SDK's one raw socket: this IS the wrapped transport
+                asyncio.open_connection(  # lint: raw-http-ok
+                    self.host, self.port, ssl=self.tls
+                ),
+                self.timeout,
+            )
+        except (OSError, asyncio.TimeoutError) as err:
+            raise ClientTransientError(
+                f"{method} {path}: connect failed: {err}"
+            ) from err
+        try:
+            return await self._exchange(reader, writer, method, path, body)
+        except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ValueError, IndexError) as err:
+            # ValueError/IndexError: garbled status line from a dying peer
+            raise ClientTransientError(f"{method} {path}: {err}") from err
         finally:
             writer.close()
             try:
@@ -104,41 +176,170 @@ class HttpClient(XaynetClient):
             except Exception:
                 pass
 
+    async def _exchange(
+        self, reader, writer, method: str, path: str, body: bytes | None
+    ) -> tuple[int, dict, bytes]:
+        # self.timeout bounds each individual read as an IDLE timeout, not
+        # the whole exchange: a peer that stalls mid-response fails fast
+        # (transient, the wrapper retries), while a large model download
+        # that keeps making progress on a slow link is never cut off
+        head = (
+            f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+            f"Content-Length: {len(body) if body else 0}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        writer.write(head + (body or b""))
+        await asyncio.wait_for(writer.drain(), self.timeout)
+        status_line = await asyncio.wait_for(reader.readline(), self.timeout)
+        status = int(status_line.split()[1])
+        headers: dict[str, str] = {}
+        content_length = 0
+        while True:
+            line = await asyncio.wait_for(reader.readline(), self.timeout)
+            if line in (b"\r\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            headers[name.strip().lower()] = value.strip()
+            if name.strip().lower() == "content-length":
+                content_length = int(value.strip())
+        chunks = []
+        remaining = content_length
+        while remaining > 0:
+            chunk = await asyncio.wait_for(
+                reader.read(min(remaining, 1 << 20)), self.timeout
+            )
+            if not chunk:  # peer closed mid-body
+                raise asyncio.IncompleteReadError(b"".join(chunks), content_length)
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return status, headers, b"".join(chunks)
+
+    @staticmethod
+    def _retry_after(headers: dict) -> Optional[float]:
+        value = headers.get("retry-after")
+        if value is None:
+            return None
+        try:
+            return max(0.0, float(value))
+        except ValueError:
+            return None  # HTTP-date flavor: ignore, the backoff still works
+
+    def _raise_for_status(self, status: int, headers: dict, context: str) -> None:
+        # anything outside 2xx fails: the client never follows redirects, so
+        # a 3xx "success" would silently lose the call behind a misconfigured
+        # proxy (the body would be an HTML redirect page, not protocol JSON)
+        if status < 300:
+            return
+        raise classify_status(status, self._retry_after(headers), context)
+
     async def get_round_params(self) -> RoundParameters:
-        status, body = await self._request("GET", "/params")
-        if status != 200:
-            raise RuntimeError(f"GET /params -> {status}")
+        status, headers, body = await self._request("GET", "/params")
+        self._raise_for_status(status, headers, "GET /params")
         return RoundParameters.from_dict(json.loads(body.decode()))
 
     async def get_sums(self) -> Optional[dict]:
-        status, body = await self._request("GET", "/sums")
+        status, headers, body = await self._request("GET", "/sums")
         if status == 204:
             return None
-        if status != 200:
-            raise RuntimeError(f"GET /sums -> {status}")
+        self._raise_for_status(status, headers, "GET /sums")
         raw = json.loads(body.decode())
         return {bytes.fromhex(k): bytes.fromhex(v) for k, v in raw.items()}
 
     async def get_seeds(self, pk: bytes) -> Optional[UpdateSeedDict]:
         from ..core.mask.seed import EncryptedMaskSeed
 
-        status, body = await self._request("GET", f"/seeds?pk={pk.hex()}")
+        status, headers, body = await self._request("GET", f"/seeds?pk={pk.hex()}")
         if status == 204:
             return None
-        if status != 200:
-            raise RuntimeError(f"GET /seeds -> {status}")
+        self._raise_for_status(status, headers, "GET /seeds")
         raw = json.loads(body.decode())
         return {bytes.fromhex(k): EncryptedMaskSeed(bytes.fromhex(v)) for k, v in raw.items()}
 
     async def get_model(self) -> Optional[np.ndarray]:
-        status, body = await self._request("GET", "/model")
+        status, headers, body = await self._request("GET", "/model")
         if status == 204:
             return None
-        if status != 200:
-            raise RuntimeError(f"GET /model -> {status}")
+        self._raise_for_status(status, headers, "GET /model")
         return np.frombuffer(body, dtype=np.float64)
 
     async def send_message(self, encrypted: bytes) -> None:
-        status, body = await self._request("POST", "/message", encrypted)
-        if status != 200:
-            raise RuntimeError(f"POST /message -> {status}: {body[:200]!r}")
+        status, headers, body = await self._request("POST", "/message", encrypted)
+        self._raise_for_status(status, headers, f"POST /message: {body[:200]!r}")
+
+
+def default_client_policy() -> RetryPolicy:
+    """Participant-side retry defaults: a handful of quick in-tick retries.
+
+    Deliberately shorter than the coordinator's storage policy — a
+    participant tick should resolve in seconds; anything longer is the
+    state machine's job (it stays in phase and re-polls on later ticks)."""
+    return RetryPolicy(
+        max_attempts=4, base_delay_s=0.05, max_delay_s=2.0, deadline_s=15.0
+    )
+
+
+class ResilientClient(XaynetClient):
+    """Retry wrapper around any ``XaynetClient``.
+
+    Transient failures (``ClientTransientError``, connection-ish builtins
+    per ``resilience.policy.is_transient``) retry in place on the policy's
+    decorrelated-jitter schedule; a server-sent ``Retry-After`` (429/503)
+    acts as a FLOOR under the drawn delay, so a shedding admission
+    controller is never hammered faster than it asked for. Permanent
+    errors propagate on the first attempt.
+
+    Fault-injection sites (chaos, ``resilience.faults``):
+
+    - ``sdk.straggle`` — latency rules delay a send (a straggling radio);
+    - ``sdk.drop`` — the send is silently DROPPED: the client believes it
+      succeeded, the coordinator never sees the message (a lost packet);
+    - ``sdk.send`` — error rules fail a send attempt (retried like any
+      transient fault; ``perm=1`` makes it permanent).
+    """
+
+    def __init__(self, inner: XaynetClient, policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy if policy is not None else default_client_policy()
+
+    async def _call(self, endpoint: str, fn, *args):
+        # the shared policy loop carries the per-site retry/giveup/backoff
+        # metrics (xaynet_resilience_*_total{site="sdk.<endpoint>"}); the
+        # server-sent Retry-After floors the drawn delay via the hook
+        return await self.policy.call_async(
+            fn,
+            *args,
+            site=f"sdk.{endpoint}",
+            delay_floor=lambda err: getattr(err, "retry_after", None),
+        )
+
+    async def get_round_params(self) -> RoundParameters:
+        return await self._call("params", self.inner.get_round_params)
+
+    async def get_sums(self) -> Optional[dict]:
+        return await self._call("sums", self.inner.get_sums)
+
+    async def get_seeds(self, pk: bytes) -> Optional[UpdateSeedDict]:
+        return await self._call("seeds", self.inner.get_seeds, pk)
+
+    async def get_model(self) -> Optional[np.ndarray]:
+        return await self._call("model", self.inner.get_model)
+
+    async def send_message(self, encrypted: bytes) -> None:
+        from ..resilience import faults
+
+        plan = faults.current_plan()
+        if plan is not None:
+            # participant-side chaos: straggle (delay) then maybe drop this
+            # send on the wire — both once per LOGICAL send, not per retry
+            await faults.maybe_fail_async("sdk.straggle")
+            if plan.decide("sdk.drop") is not None:
+                CLIENT_DROPS.inc()
+                logger.debug("sdk.drop: send silently dropped by fault plan")
+                return
+        await self._call("send", self._send_attempt, encrypted)
+
+    async def _send_attempt(self, encrypted: bytes) -> None:
+        from ..resilience import faults
+
+        await faults.maybe_fail_async("sdk.send")
+        await self.inner.send_message(encrypted)

@@ -20,7 +20,7 @@ from typing import Optional, Union
 import numpy as np
 
 from ..core.crypto.sign import SigningKeyPair
-from .client import HttpClient
+from .client import HttpClient, ResilientClient
 from .state_machine import PetSettings, StateMachine, Task, TransitionOutcome
 from .traits import ModelStore, Notify, XaynetClient
 
@@ -77,9 +77,15 @@ class Participant:
         # None = auto: the Sum2 device path turns on when JAX's default
         # backend is an accelerator (see PetSettings.device_sum2)
         device_sum2: Optional[bool] = None,
+        # wrap URL clients in the retrying ResilientClient (one flaky 429 or
+        # dropped connection must not turn a participant into a dropout);
+        # pass False to talk raw HTTP, or hand in a pre-built client
+        retries: bool = True,
     ):
         if isinstance(client, str):
             client = HttpClient(client)
+            if retries:
+                client = ResilientClient(client)
         self._loop = asyncio.new_event_loop()
         self._events = _Events()
         self._store = _SettableModelStore()

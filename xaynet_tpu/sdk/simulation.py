@@ -14,6 +14,7 @@ tests.
 from __future__ import annotations
 
 import asyncio
+import random
 from dataclasses import dataclass
 from fractions import Fraction
 from typing import Callable, Optional, Sequence
@@ -91,12 +92,38 @@ class FloodStats:
     the pipeline admitted it — admitted messages resolve asynchronously);
     ``rejected`` counts pipeline-stage/protocol drops surfaced at submit
     time; ``shed`` counts admission-control refusals (429 upstream).
+    Chaos knobs add ``dropped`` (participants whose upload was never sent)
+    and ``straggled`` (sent late); the index tuples name exactly who, so a
+    test can rebuild the surviving participant set.
     """
 
     sent: int = 0
     accepted: int = 0
     rejected: int = 0
     shed: int = 0
+    dropped: int = 0
+    straggled: int = 0
+    dropped_indices: tuple = ()
+    straggled_indices: tuple = ()
+
+
+def plan_churn(
+    n: int, dropout_rate: float, stragglers: int, seed: int
+) -> tuple[frozenset, frozenset]:
+    """Deterministic churn assignment for ``flood``: which of the ``n``
+    participants drop out entirely and which straggle. Seeded — a chaos
+    test and its byte-identity control run agree on the survivor set."""
+    if not (0.0 <= dropout_rate < 1.0):
+        raise ValueError("dropout_rate must be in [0, 1)")
+    rng = random.Random(seed)
+    n_drop = int(round(n * dropout_rate))
+    dropped = frozenset(rng.sample(range(n), n_drop)) if n_drop else frozenset()
+    remaining = sorted(set(range(n)) - dropped)
+    n_straggle = min(max(0, stragglers), len(remaining))
+    straggled = (
+        frozenset(rng.sample(remaining, n_straggle)) if n_straggle else frozenset()
+    )
+    return dropped, straggled
 
 
 async def flood(
@@ -111,6 +138,10 @@ async def flood(
     key_spacing: int = 1000,
     concurrency: int = 64,
     build: Optional[Callable[[int], bytes]] = None,
+    dropout_rate: float = 0.0,
+    stragglers: int = 0,
+    straggle_delay_s: float = 0.2,
+    churn_seed: Optional[int] = None,
 ) -> FloodStats:
     """Drive ``n`` concurrent valid update uploads against ``target``.
 
@@ -121,6 +152,14 @@ async def flood(
     the same round collide on purpose (duplicate-participant rejections)
     and distinct ``key_start`` ranges never do. ``build`` overrides message
     construction (e.g. pre-sealed garbage for decrypt-path floods).
+
+    Churn knobs (chaos scenarios, docs/DESIGN.md §10): ``dropout_rate``
+    silently withholds that fraction of the uploads (the participants
+    trained, then vanished — the quorum-completion target), ``stragglers``
+    delays that many of the surviving uploads by ``straggle_delay_s``.
+    Assignment is deterministic per ``churn_seed`` (``plan_churn``), and
+    the stats name the affected indices so a control run can rebuild the
+    exact survivor set.
     """
     if models is None:
         rng = np.random.default_rng(key_start or 7)
@@ -137,20 +176,36 @@ async def flood(
         return build_update_message(params, keys, sum_dict, models[i % len(models)], scalar)
 
     build = build or default_build
+    # seed 0 is a valid explicit choice — only None falls back to key_start
+    # (a control run on a different key range must reuse the chaos run's
+    # churn_seed and get the identical survivor set)
+    if churn_seed is None:
+        churn_seed = key_start or 7
+    dropped, straggled = plan_churn(n, dropout_rate, stragglers, churn_seed)
     # sealing is CPU-bound and deterministic: do it before the clock starts
-    sealed = [build(i) for i in range(n)]
+    # (dropouts never sent anything — don't pay for sealing them either)
+    sealed = {i: build(i) for i in range(n) if i not in dropped}
 
     submit = _submitter(target)
-    stats = FloodStats()
+    stats = FloodStats(
+        dropped=len(dropped),
+        straggled=len(straggled),
+        dropped_indices=tuple(sorted(dropped)),
+        straggled_indices=tuple(sorted(straggled)),
+    )
     gate = asyncio.Semaphore(max(1, concurrency))
 
-    async def one(blob: bytes) -> None:
+    async def one(i: int, blob: bytes) -> None:
+        if i in straggled:
+            # outside the gate: a straggler must not hold a concurrency slot
+            # while it sleeps
+            await asyncio.sleep(straggle_delay_s)
         async with gate:
             stats.sent += 1
             outcome = await submit(blob)
             setattr(stats, outcome, getattr(stats, outcome) + 1)
 
-    await asyncio.gather(*(one(blob) for blob in sealed))
+    await asyncio.gather(*(one(i, blob) for i, blob in sealed.items()))
     return stats
 
 

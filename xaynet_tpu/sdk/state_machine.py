@@ -14,6 +14,7 @@ at any point.
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import enum
 import json
@@ -35,6 +36,21 @@ from ..core.message.encoder import DEFAULT_MAX_MESSAGE_SIZE, MIN_MESSAGE_SIZE, M
 from .traits import ModelStore, Notify, XaynetClient
 
 logger = logging.getLogger("xaynet.participant")
+
+
+def _is_transient_client_error(err: BaseException) -> bool:
+    """Worth retrying within the same round? Typed markers win
+    (``ClientError.transient``); unmarked connection/timeout builtins are
+    transient too (a custom ``XaynetClient`` raising raw socket errors).
+    Deliberately NARROWER than ``resilience.policy.is_transient``: a
+    generic ``OSError`` here is more likely a local fault (a model store's
+    ``FileNotFoundError``) than a network one — treating it as transient
+    would spin the participant on PENDING forever, so it propagates."""
+    marker = getattr(err, "transient", None)
+    if marker is not None:
+        return bool(marker)
+    return isinstance(err, (ConnectionError, TimeoutError, asyncio.TimeoutError))
+
 
 _ACCEL_DEFAULT: Optional[bool] = None
 
@@ -164,10 +180,25 @@ class StateMachine:
     # --- driving ----------------------------------------------------------
 
     async def transition(self) -> TransitionOutcome:
-        """One step; checks round freshness first (phase.rs:160-200)."""
+        """One step; checks round freshness first (phase.rs:160-200).
+
+        A TRANSIENT client failure inside a phase step (a dropped
+        connection, a 429/503 the retry wrapper gave up on) does NOT abort
+        the round: the machine stays in its phase and reports PENDING — the
+        next tick re-polls the round params and, while the round is
+        unchanged, resumes exactly where it left off (signatures, ephemeral
+        keys and the send cursor are all kept). Only permanent errors
+        propagate to the caller."""
         try:
             fresh = await self.client.get_round_params()
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
+            if getattr(e, "transient", None) is False:
+                # typed PERMANENT client error (404 from a wrong URL, ...):
+                # re-polling cannot heal it — surface the misconfiguration
+                # instead of ticking PENDING forever
+                raise
             logger.debug("round params unavailable: %s", e)
             return TransitionOutcome.PENDING
         if self.round_params is None or fresh != self.round_params:
@@ -186,7 +217,20 @@ class StateMachine:
             PhaseKind.UPDATE: self._step_update,
             PhaseKind.SUM2: self._step_sum2,
         }[self.phase]
-        return await handler()
+        try:
+            return await handler()
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            if _is_transient_client_error(err):
+                logger.info(
+                    "transient client failure in %s (%s); staying in phase "
+                    "and retrying on a later tick",
+                    self.phase.value,
+                    err,
+                )
+                return TransitionOutcome.PENDING
+            raise
 
     def _reset_round_state(self) -> None:
         self.task = Task.NONE
@@ -379,7 +423,25 @@ class StateMachine:
             sealed = pending.sealed_part(pending.next_index)
             try:
                 await self.client.send_message(sealed)
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
+                if not _is_transient_client_error(e):
+                    # a permanent rejection (4xx) will never succeed on a
+                    # resend of the SAME bytes: abandon this round's send and
+                    # wait for the next round instead of retrying forever
+                    logger.warning(
+                        "chunk send permanently rejected (part %d/%d): %s; "
+                        "abandoning this round's upload",
+                        pending.next_index + 1,
+                        pending.encoder.n_parts,
+                        e,
+                    )
+                    self._pending = None
+                    self._after_send_phase = None
+                    self.phase = PhaseKind.AWAITING
+                    self.notify.idle()
+                    return TransitionOutcome.COMPLETE
                 logger.info(
                     "chunk send failed (part %d/%d); retrying on a later tick: %s",
                     pending.next_index + 1,

@@ -53,6 +53,12 @@ class Metrics:
     def message_discarded(self, round_id: int, phase: str) -> None:
         self._emit("message_discarded", 1, round_id, phase)
 
+    def message_purged(self, round_id: int, phase: str) -> None:
+        """A queued request rejected by the phase-end purge — NOT an
+        in-window protocol reject (degraded closes purge every straggler;
+        dashboards must be able to tell the two apart)."""
+        self._emit("message_purged", 1, round_id, phase)
+
     def masks_total(self, round_id: int, count: int) -> None:
         self._emit("masks_total_number", count, round_id)
 

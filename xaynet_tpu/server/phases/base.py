@@ -12,6 +12,16 @@ handler.rs:96-202):
   bounded by ``time.max`` — too few accepted requests is a
   ``PhaseTimeout``. Requests beyond ``count.max`` are *discarded*; requests
   that fail protocol checks are *rejected*.
+
+Liveness extension (docs/DESIGN.md §10): a phase may carry a
+``count.quorum`` (quorum <= min <= max). Once ``time.min`` has elapsed and
+arrivals stall — no accepted message for ``liveness.stall_grace_s`` — a
+phase with ``accepted >= quorum`` closes successfully in DEGRADED mode
+instead of waiting out ``time.max`` for a ``count.min`` that churned-out
+participants will never deliver; the same fallback applies when
+``time.max`` expires at/above quorum. Every window completion is counted
+on ``xaynet_phase_outcome_total{phase,outcome=full|degraded|timeout}``
+and reported to the round controller when one is installed.
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
 from ...storage.traits import Store
+from ...telemetry.registry import get_registry
 from ...utils import tracing
 from ..events import EventPublisher, PhaseName
 from ..requests import (
@@ -39,6 +50,13 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger("xaynet.coordinator")
 
+PHASE_OUTCOMES = get_registry().counter(
+    "xaynet_phase_outcome_total",
+    "Request-window phase completions, by phase and outcome "
+    "(full | degraded | timeout).",
+    ("phase", "outcome"),
+)
+
 
 class PhaseError(Exception):
     """A phase failed; drives the transition into Failure."""
@@ -49,8 +67,34 @@ class PhaseError(Exception):
 
 
 class PhaseTimeout(PhaseError):
-    def __init__(self):
-        super().__init__("PhaseTimeout", "not enough messages received within the time window")
+    """The window closed below quorum; carries the full window diagnostics
+    (who arrived, what the thresholds were, how long the phase ran) so the
+    Failure-phase log line and the phase_error metric event name the gap
+    instead of a static string."""
+
+    def __init__(
+        self,
+        accepted: Optional[int] = None,
+        count_min: int = 0,
+        quorum: int = 0,
+        rejected: int = 0,
+        discarded: int = 0,
+        seconds: float = 0.0,
+    ):
+        detail = "not enough messages received within the time window"
+        if accepted is not None:
+            detail += (
+                f" ({accepted} accepted / min {count_min} / quorum {quorum}; "
+                f"{rejected} rejected, {discarded} discarded; "
+                f"{seconds:.1f}s in phase)"
+            )
+        super().__init__("PhaseTimeout", detail)
+        self.accepted = accepted
+        self.count_min = count_min
+        self.quorum = quorum
+        self.rejected = rejected
+        self.discarded = discarded
+        self.seconds = seconds
 
 
 @dataclass
@@ -67,6 +111,9 @@ class Shared:
     # Idle); bounds how often one round may re-enter Update from its
     # checkpoint before falling back to a restart
     resume_attempts: int = 0
+    # adaptive count-window controller ([liveness] adaptive = true); phases
+    # report window outcomes here, Unmask/Failure report round outcomes
+    round_ctl: Optional[object] = None
 
     def set_round_id(self, round_id: int) -> None:
         self.state.round_id = round_id
@@ -78,11 +125,13 @@ class Shared:
 
 
 class _Counter:
-    """Accepted/rejected/discarded bookkeeping (handler.rs:28-89)."""
+    """Accepted/rejected/discarded bookkeeping (handler.rs:28-89), plus the
+    liveness quorum (quorum == min when no degraded completion is armed)."""
 
-    def __init__(self, count_min: int, count_max: int):
+    def __init__(self, count_min: int, count_max: int, quorum: Optional[int] = None):
         self.min = count_min
         self.max = count_max
+        self.quorum = count_min if quorum is None else min(quorum, count_min)
         self.accepted = 0
         self.rejected = 0
         self.discarded = 0
@@ -90,6 +139,10 @@ class _Counter:
     @property
     def has_enough(self) -> bool:
         return self.accepted >= self.min
+
+    @property
+    def has_quorum(self) -> bool:
+        return self.accepted >= self.quorum
 
     @property
     def has_overmuch(self) -> bool:
@@ -100,6 +153,12 @@ class PhaseState:
     """Base class for phases; subclasses set NAME and implement hooks."""
 
     NAME: PhaseName
+    # arrivals the round controller should count ON TOP of this window's
+    # accepted requests (a checkpoint-resumed update phase runs a reduced
+    # window: the restored models were real arrivals, and omitting them
+    # would make a resumed 100-participant round look like a 5-participant
+    # deployment to the adaptive shrink clamp)
+    arrivals_offset: int = 0
 
     def __init__(self, shared: Shared):
         self.shared = shared
@@ -166,42 +225,88 @@ class PhaseState:
         return Failure(self.shared, err, failed_phase=self.NAME)
 
     async def purge_outdated_requests(self) -> None:
-        """Reject every request still queued from this phase (phase.rs:183-192)."""
+        """Reject every request still queued from this phase (phase.rs:183-192).
+
+        Purges are counted separately from in-window rejects (``purged``
+        outcome): a degraded close rejects every straggler still queued, and
+        that burst must not pollute reject-rate dashboards."""
         while True:
             env = self.shared.request_rx.try_recv()
             if env is None:
                 return
             self._respond(env, RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase ended"))
-            if self.shared.metrics is not None:
-                self.shared.metrics.message_rejected(self.shared.round_id, self.NAME.value)
+            metrics = self.shared.metrics
+            if metrics is not None:
+                if hasattr(metrics, "message_purged"):
+                    metrics.message_purged(self.shared.round_id, self.NAME.value)
+                else:  # pre-purge recorders (test spies): keep the old bucket
+                    metrics.message_rejected(self.shared.round_id, self.NAME.value)
 
     # --- request windows --------------------------------------------------
 
-    async def process_requests(self, params: PhaseSettings | Sum2Settings) -> None:
-        counter = _Counter(params.count.min, params.count.max)
+    async def process_requests(self, params: PhaseSettings | Sum2Settings) -> str:
+        """Run the count/time request window; returns the outcome
+        (``"full"`` or ``"degraded"``) or raises :class:`PhaseTimeout`."""
+        # effective_quorum re-clamps quorum <= min after any adaptive
+        # controller adjustment to min (settings.CountSettings)
+        counter = _Counter(
+            params.count.min,
+            params.count.max,
+            getattr(params.count, "effective_quorum", None),
+        )
         logger.debug(
-            "processing requests for min %.1fs / max %.1fs (count %d..%d)",
+            "processing requests for min %.1fs / max %.1fs (count %d..%d, quorum %d)",
             params.time.min,
             params.time.max,
             params.count.min,
             params.count.max,
+            counter.quorum,
         )
+        t0 = time_mod.monotonic()
         await self._process_during(params.time.min, counter)
         time_left = max(params.time.max - params.time.min, 0.0)
         try:
-            await asyncio.wait_for(self._process_until_enough(counter), timeout=time_left)
+            await self._process_until_enough(counter, time_mod.monotonic() + time_left)
         except asyncio.TimeoutError:
-            raise PhaseTimeout() from None
-        logger.info(
-            "round %d %s: %d accepted (min %d, max %d), %d rejected, %d discarded",
+            # only raised below quorum: at/above quorum the deadline closes
+            # the window degraded by RETURNING between requests (never by
+            # cancelling one mid-flight — see _process_until_enough)
+            self._record_window_outcome(counter, "timeout", t0)
+            raise PhaseTimeout(
+                accepted=counter.accepted,
+                count_min=counter.min,
+                quorum=counter.quorum,
+                rejected=counter.rejected,
+                discarded=counter.discarded,
+                seconds=time_mod.monotonic() - t0,
+            ) from None
+        outcome = "full" if counter.has_enough else "degraded"
+        self._record_window_outcome(counter, outcome, t0)
+        logger.log(
+            logging.WARNING if outcome == "degraded" else logging.INFO,
+            "round %d %s: %s close — %d accepted (min %d, quorum %d, max %d), "
+            "%d rejected, %d discarded",
             self.shared.round_id,
             self.NAME.value,
+            outcome,
             counter.accepted,
             counter.min,
+            counter.quorum,
             counter.max,
             counter.rejected,
             counter.discarded,
         )
+        return outcome
+
+    def _record_window_outcome(self, counter: _Counter, outcome: str, t0: float) -> None:
+        PHASE_OUTCOMES.labels(phase=self.NAME.value, outcome=outcome).inc()
+        if self.shared.round_ctl is not None:
+            self.shared.round_ctl.observe_phase(
+                self.NAME.value,
+                counter.accepted + self.arrivals_offset,
+                outcome,
+                time_mod.monotonic() - t0,
+            )
 
     async def _process_during(self, duration: float, counter: _Counter) -> None:
         deadline = time_mod.monotonic() + duration
@@ -215,10 +320,54 @@ class PhaseState:
                 return
             await self._process_single(env, counter)
 
-    async def _process_until_enough(self, counter: _Counter) -> None:
+    async def _process_until_enough(self, counter: _Counter, deadline: float) -> None:
+        """Accept until ``count.min`` — or until the ``time.max`` deadline
+        or, with a quorum armed, until arrivals STALL at/above quorum: no
+        accepted message for ``liveness.stall_grace_s`` closes the window
+        degraded (returning normally; the caller decides full vs degraded
+        from the counter). A rejected/discarded straggler does not reset
+        the stall clock — only acceptances prove the phase is still making
+        progress.
+
+        The window boundary (deadline or stall) is only ever declared
+        BETWEEN requests: a request being handled always runs to
+        completion first, so a degraded close can never strand a
+        half-applied update (a seed-dict entry whose model was never
+        staged would break the nb_models == seed-watermark unmask
+        invariant). Below quorum the deadline raises ``TimeoutError``
+        between requests instead — the caller turns it into the diagnostic
+        :class:`PhaseTimeout`."""
+        quorum_armed = counter.quorum < counter.min
+        stall_grace = self.shared.settings.liveness.stall_grace_s
+        last_accept = time_mod.monotonic()
         while not counter.has_enough:
-            env = await self.shared.request_rx.next_request()
+            now = time_mod.monotonic()
+            time_left = deadline - now
+            at_quorum = quorum_armed and counter.has_quorum
+            if time_left <= 0 or (at_quorum and now - last_accept >= stall_grace):
+                # the window is closing — but a request that arrived IN
+                # time may still sit queued behind slow processing (it
+                # might even lift the phase to quorum or min); declaring
+                # the close without draining it would purge it
+                env = self.shared.request_rx.try_recv()
+                if env is None:
+                    if at_quorum:
+                        return  # degraded close (caller reads the counter)
+                    raise asyncio.TimeoutError  # time.max expired below quorum
+            else:
+                wait = time_left
+                if at_quorum:
+                    wait = min(wait, stall_grace - (now - last_accept))
+                try:
+                    env = await asyncio.wait_for(
+                        self.shared.request_rx.next_request(), wait
+                    )
+                except asyncio.TimeoutError:
+                    continue  # re-evaluate the deadline / stall clock
+            accepted_before = counter.accepted
             await self._process_single(env, counter)
+            if counter.accepted > accepted_before:
+                last_accept = time_mod.monotonic()
 
     async def _process_single(self, env, counter: _Counter) -> None:
         if isinstance(env.request, CoalescedUpdates):

@@ -66,6 +66,11 @@ class Failure(PhaseState):
         resumed = await self._try_resume()
         if resumed is not None:
             return resumed
+        if self.shared.round_ctl is not None:
+            # only a true round RESTART feeds the controller's shrink
+            # streak — a checkpoint resume keeps the round alive, and its
+            # eventual completion/failure is what gets counted
+            self.shared.round_ctl.round_failed()
         from .idle import Idle
 
         return Idle(self.shared)

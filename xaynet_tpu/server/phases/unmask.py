@@ -66,6 +66,10 @@ class Unmask(PhaseState):
         self.shared.events.broadcast_model(ModelUpdate.new(self.global_model))
 
     async def next(self):
+        if self.shared.round_ctl is not None:
+            # the round is complete: feed the controller's hysteresis (full
+            # vs degraded is derived from the per-phase window outcomes)
+            self.shared.round_ctl.round_completed()
         from .idle import Idle
 
         return Idle(self.shared)

@@ -60,6 +60,11 @@ class UpdatePhase(PhaseState):
                 resume_from.vect, resume_from.unit, resume_from.nb_models
             )
             self._resumed_models = resume_from.nb_models
+            # the restored updates count as arrivals for the liveness
+            # controller: the post-resume window is offset by them, and
+            # reporting only the remainder would poison the shrink clamp
+            # with a tiny "observed load" (base.PhaseState.arrivals_offset)
+            self.arrivals_offset = resume_from.nb_models
             logger.info(
                 "round %d: update phase RESUMED from checkpoint (%d models restored)",
                 shared.round_id,
@@ -87,6 +92,11 @@ class UpdatePhase(PhaseState):
                 params.count,
                 min=max(params.count.min - self._resumed_models, 0),
                 max=max(params.count.max - self._resumed_models, 0),
+                quorum=(
+                    None
+                    if params.count.quorum is None
+                    else max(params.count.quorum - self._resumed_models, 0)
+                ),
             )
             params = dataclasses.replace(params, count=count)
             # sum participants contacting a restarted coordinator need the

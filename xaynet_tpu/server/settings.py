@@ -32,6 +32,17 @@ class SettingsError(ValueError):
 class CountSettings:
     min: int
     max: int
+    # liveness quorum (quorum <= min <= max): once time.min has elapsed and
+    # arrivals stall, a phase with accepted >= quorum closes successfully in
+    # DEGRADED mode instead of waiting for count.min and timing out. None
+    # means quorum == min: no degraded completion for this phase.
+    quorum: Optional[int] = None
+
+    @property
+    def effective_quorum(self) -> int:
+        """The quorum actually enforced (clamped so quorum <= min always
+        holds even after an adaptive controller shrank ``min``)."""
+        return self.min if self.quorum is None else min(self.quorum, self.min)
 
 
 @dataclass
@@ -72,12 +83,23 @@ class PetSettings:
                 raise SettingsError(f"pet.{name}.count.max must be >= count.min")
             if phase.time.max < phase.time.min:
                 raise SettingsError(f"pet.{name}.time.max must be >= time.min")
+            self._validate_quorum(name, phase.count, floor)
         if self.sum2.count.min < SUM_COUNT_MIN:
             raise SettingsError("pet.sum2.count.min must be >= 1")
         if self.sum2.count.max < self.sum2.count.min:
             raise SettingsError("pet.sum2.count.max must be >= count.min")
         if self.sum2.time.max < self.sum2.time.min:
             raise SettingsError("pet.sum2.time.max must be >= time.min")
+        self._validate_quorum("sum2", self.sum2.count, SUM_COUNT_MIN)
+
+    @staticmethod
+    def _validate_quorum(name: str, count: CountSettings, floor: int) -> None:
+        if count.quorum is None:
+            return
+        if count.quorum < floor:
+            raise SettingsError(f"pet.{name}.count.quorum must be >= {floor}")
+        if count.quorum > count.min:
+            raise SettingsError(f"pet.{name}.count.quorum must be <= count.min")
 
 
 @dataclass
@@ -284,6 +306,52 @@ class ResilienceSettings:
 
 
 @dataclass
+class LivenessSettings:
+    """Round liveness under participant churn (docs/DESIGN.md §10).
+
+    Two independent mechanisms: quorum completion (a stalled phase with
+    ``accepted >= count.quorum`` closes DEGRADED instead of timing out —
+    armed per phase by setting ``pet.<phase>.count.quorum``), and the
+    adaptive :class:`~xaynet_tpu.server.round_controller.RoundController`
+    (off by default) that re-sizes ``count.min``/``time.max`` across rounds
+    with hysteresis when the offered participant load does not match the
+    configured window.
+    """
+
+    # quorum completion: after time.min, a phase at/above quorum closes
+    # degraded once no message has been ACCEPTED for this many seconds
+    stall_grace_s: float = 5.0
+    # adaptive count windows (RoundController)
+    adaptive: bool = False
+    shrink_after: int = 2  # consecutive degraded/failed rounds before a shrink
+    grow_after: int = 2  # consecutive full rounds before a regrow
+    shrink_factor: float = 0.5  # count.min multiplier on shrink (then clamped
+    # down to the arrivals actually observed, and up to the protocol floor)
+    grow_factor: float = 1.5  # count.min multiplier on regrow (capped at the
+    # configured min and the observed arrivals)
+    time_relax_factor: float = 1.5  # time.max multiplier on shrink; regrows
+    # decay it back toward the configured value
+    time_max_ceil_s: float = 3600.0  # absolute ceiling for relaxed time.max
+    window: int = 8  # rounds of per-phase arrival history kept
+
+    def validate(self) -> None:
+        if self.stall_grace_s <= 0:
+            raise SettingsError("liveness.stall_grace_s must be > 0")
+        if self.shrink_after < 1 or self.grow_after < 1:
+            raise SettingsError("liveness shrink_after/grow_after must be >= 1")
+        if not (0.0 < self.shrink_factor < 1.0):
+            raise SettingsError("liveness.shrink_factor must be in (0, 1)")
+        if self.grow_factor <= 1.0:
+            raise SettingsError("liveness.grow_factor must be > 1")
+        if self.time_relax_factor < 1.0:
+            raise SettingsError("liveness.time_relax_factor must be >= 1")
+        if self.time_max_ceil_s <= 0:
+            raise SettingsError("liveness.time_max_ceil_s must be > 0")
+        if self.window < 1:
+            raise SettingsError("liveness.window must be >= 1")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -296,12 +364,14 @@ class Settings:
     aggregation: AggregationSettings = field(default_factory=AggregationSettings)
     ingest: IngestSettings = field(default_factory=IngestSettings)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings)
+    liveness: LivenessSettings = field(default_factory=LivenessSettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
         self.ingest.validate()
         self.resilience.validate()
+        self.liveness.validate()
         if self.model.length < 1:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
@@ -371,10 +441,12 @@ class Settings:
             section = pet.get(name, {})
             count = section.get("count", {})
             time_ = section.get("time", {})
+            quorum = count.get("quorum", default.count.quorum)
             kwargs = dict(
                 count=CountSettings(
                     min=int(count.get("min", default.count.min)),
                     max=int(count.get("max", default.count.max)),
+                    quorum=None if quorum is None else int(quorum),
                 ),
                 time=TimeSettings(
                     min=float(time_.get("min", default.time.min)),
@@ -396,6 +468,8 @@ class Settings:
         ingest_raw = raw.get("ingest", {})
         res_raw = raw.get("resilience", {})
         res_base = base.resilience
+        live_raw = raw.get("liveness", {})
+        live_base = base.liveness
 
         return cls(
             pet=PetSettings(
@@ -508,6 +582,21 @@ class Settings:
                     res_raw.get("max_resume_attempts", res_base.max_resume_attempts)
                 ),
                 fault_plan=str(res_raw.get("fault_plan", res_base.fault_plan)),
+            ),
+            liveness=LivenessSettings(
+                stall_grace_s=float(live_raw.get("stall_grace_s", live_base.stall_grace_s)),
+                adaptive=bool(live_raw.get("adaptive", live_base.adaptive)),
+                shrink_after=int(live_raw.get("shrink_after", live_base.shrink_after)),
+                grow_after=int(live_raw.get("grow_after", live_base.grow_after)),
+                shrink_factor=float(live_raw.get("shrink_factor", live_base.shrink_factor)),
+                grow_factor=float(live_raw.get("grow_factor", live_base.grow_factor)),
+                time_relax_factor=float(
+                    live_raw.get("time_relax_factor", live_base.time_relax_factor)
+                ),
+                time_max_ceil_s=float(
+                    live_raw.get("time_max_ceil_s", live_base.time_max_ceil_s)
+                ),
+                window=int(live_raw.get("window", live_base.window)),
             ),
         )
 

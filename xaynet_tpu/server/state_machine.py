@@ -150,6 +150,11 @@ class StateMachineInitializer:
             model=model_update,
         )
         request_rx = RequestReceiver()
+        round_ctl = None
+        if self.settings.liveness.adaptive:
+            from .round_controller import RoundController
+
+            round_ctl = RoundController(self.settings)
         shared = Shared(
             state=state,
             request_rx=request_rx,
@@ -157,6 +162,7 @@ class StateMachineInitializer:
             store=self.store,
             settings=self.settings,
             metrics=self.metrics,
+            round_ctl=round_ctl,
         )
         initial = initial_factory(shared) if initial_factory is not None else Idle(shared)
         machine = StateMachine(initial)

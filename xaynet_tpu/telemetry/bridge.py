@@ -97,12 +97,19 @@ class BridgedMetrics:
     def message_discarded(self, round_id: int, phase: str) -> None:
         self._message(round_id, phase, "discarded")
 
+    def message_purged(self, round_id: int, phase: str) -> None:
+        """Phase-end purge (degraded-close stragglers included): its own
+        outcome label so purge bursts don't pollute reject-rate panels."""
+        self._message(round_id, phase, "purged")
+
     def _message(self, round_id: int, phase: str, outcome: str) -> None:
         self._messages.labels(phase=phase, outcome=outcome).inc()
         if self.reporter is not None:
             self.reporter.record_message(phase, outcome)
         if self.sink is not None:
-            getattr(self.sink, f"message_{outcome}")(round_id, phase)
+            # sinks predating the purged outcome fold purges into rejects
+            emit = getattr(self.sink, f"message_{outcome}", None) or self.sink.message_rejected
+            emit(round_id, phase)
 
     def masks_total(self, round_id: int, count: int) -> None:
         self._masks.set(count)
