@@ -1,0 +1,105 @@
+"""tools/bench_gate.py: the tier-2 bench regression gate (BENCH.md).
+
+Replays a BENCH_HISTORY-shaped JSONL and must exit 1 exactly when the
+latest headline round regresses more than the threshold vs the best PRIOR
+round of the SAME series — mixed metric variants, torn lines and alien
+records must neither crash the gate nor pollute the comparison.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("xn_bench_gate", REPO / "tools" / "bench_gate.py")
+bench_gate = importlib.util.module_from_spec(spec)
+sys.modules["xn_bench_gate"] = spec.loader.exec_module(bench_gate) or bench_gate
+
+HEADLINE = "masked-update aggregation throughput @25M params"
+
+
+def _write(tmp_path, records) -> str:
+    path = tmp_path / "history.jsonl"
+    lines = [json.dumps(r) if isinstance(r, dict) else r for r in records]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _run(path, *extra) -> int:
+    argv = sys.argv
+    sys.argv = ["bench_gate.py", "--history", path, *extra]
+    try:
+        return bench_gate.main()
+    finally:
+        sys.argv = argv
+
+
+def _rec(ts, value, metric=HEADLINE, unit="updates/s", nested=True):
+    if nested:
+        return {"ts": ts, "parsed": {"metric": metric, "value": value, "unit": unit}}
+    return {"ts": ts, "metric": metric, "value": value, "unit": unit}
+
+
+def test_gate_passes_when_latest_holds_the_line(tmp_path, capsys):
+    path = _write(
+        tmp_path,
+        [_rec(1, 20.0), _rec(2, 30.0, nested=False), _rec(3, 29.0)],
+    )
+    assert _run(path) == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["result"] == "ok"
+    assert verdict["best_prior"] == 30.0
+
+
+def test_gate_fails_on_regression_beyond_threshold(tmp_path, capsys):
+    path = _write(tmp_path, [_rec(1, 30.0), _rec(2, 31.0), _rec(3, 26.0)])
+    assert _run(path) == 1  # 26 < 31 * 0.9
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["result"] == "REGRESSION"
+
+
+def test_gate_threshold_is_configurable(tmp_path):
+    path = _write(tmp_path, [_rec(1, 31.0), _rec(2, 26.0)])
+    assert _run(path) == 1
+    assert _run(path, "--threshold", "0.2") == 0  # 26 > 31 * 0.8
+
+
+def test_gate_compares_within_one_exact_series(tmp_path):
+    """A @200k-params round must not set the bar for the @25M series."""
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 900.0, metric="masked-update aggregation throughput @200000 params"),
+            _rec(2, 30.0),
+            _rec(3, 31.0),
+        ],
+    )
+    assert _run(path) == 0
+
+
+def test_gate_survives_torn_lines_and_alien_records(tmp_path):
+    path = _write(
+        tmp_path,
+        [
+            '{"ts": 1, "parsed": {"metric": "',  # torn append
+            {"ts": 2, "note": "no metric at all"},
+            _rec(3, 30.0),
+            _rec(4, 5.0, unit="rounds/s"),  # different unit: not headline
+            _rec(5, 29.5),
+        ],
+    )
+    assert _run(path) == 0
+
+
+def test_gate_with_nothing_to_compare_is_a_soft_pass(tmp_path):
+    assert _run(_write(tmp_path, [_rec(1, 30.0)])) == 0
+    assert _run(_write(tmp_path, [{"ts": 1, "note": "empty"}])) == 0
+
+
+def test_gate_runs_clean_on_the_real_history():
+    """The repo's own BENCH_HISTORY must parse and currently pass."""
+    assert _run(str(REPO / "BENCH_HISTORY.jsonl")) == 0

@@ -1,0 +1,746 @@
+"""Edge pre-aggregation tier (docs/DESIGN.md §11).
+
+Covers the tentpole end to end:
+
+- envelope wire-format round-trip + corruption detection;
+- the partition-merge property: folding K random partitions of one update
+  set through edge partials is BYTE-IDENTICAL to the flat fold, and the
+  merged seed dicts are independent of merge order;
+- a two-tier in-process round (coordinator + real EdgeService processes on
+  the event loop): global model byte-identical to the flat single-tier run
+  with the same inputs, coordinator envelope count reduced by ~the edge
+  batch factor, per-edge watermark rejecting a replayed envelope whole;
+- an edge crash mid-window: participants fall back to uploading upstream
+  directly and the round still completes with the nb_models ==
+  seed-watermark invariant intact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from xaynet_tpu.core.crypto.encrypt import PublicEncryptKey
+from xaynet_tpu.core.crypto.sign import SigningKeyPair
+from xaynet_tpu.core.mask.masking import Aggregation, Masker
+from xaynet_tpu.core.mask.model import Scalar
+from xaynet_tpu.edge import (
+    EdgeAdmitError,
+    EdgeAggregator,
+    EdgeCoordinatorApi,
+    EdgeService,
+    EnvelopeError,
+    PartialAggregateEnvelope,
+)
+from xaynet_tpu.edge.rest import EdgeRestServer
+from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.simulation import build_update_message, keys_for_task
+from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
+from xaynet_tpu.sdk.traits import ModelStore
+from xaynet_tpu.server.requests import UpdateRequest
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+from xaynet_tpu.server.settings import (
+    CountSettings,
+    EdgeSettings,
+    PhaseSettings,
+    PetSettings as ServerPet,
+    Settings,
+    Sum2Settings,
+    TimeSettings,
+)
+from xaynet_tpu.server.state_machine import StateMachineInitializer
+from xaynet_tpu.storage.memory import (
+    InMemoryCoordinatorStorage,
+    InMemoryModelStorage,
+    NoOpTrustAnchor,
+)
+from xaynet_tpu.storage.traits import Store
+from xaynet_tpu.telemetry.registry import get_registry
+
+SUM_PROB, UPDATE_PROB = 0.4, 0.5
+MODEL_LEN = 7
+
+
+def _mask_config():
+    from xaynet_tpu.server.settings import MaskSettings
+
+    return MaskSettings().to_config().pair()
+
+
+def _settings(n_update: int, phase_max: float = 30.0) -> Settings:
+    settings = Settings(
+        pet=ServerPet(
+            sum=PhaseSettings(
+                prob=SUM_PROB, count=CountSettings(1, 1), time=TimeSettings(0, phase_max)
+            ),
+            update=PhaseSettings(
+                prob=UPDATE_PROB,
+                count=CountSettings(n_update, n_update),
+                time=TimeSettings(0, phase_max),
+            ),
+            sum2=Sum2Settings(
+                count=CountSettings(1, 1), time=TimeSettings(0, phase_max)
+            ),
+        )
+    )
+    settings.model.length = MODEL_LEN
+    settings.edge.enabled = True
+    return settings
+
+
+class _ArrayModelStore(ModelStore):
+    def __init__(self, model=None):
+        self.model = model
+
+    async def load_model(self):
+        return self.model
+
+
+class _Coordinator:
+    """In-process coordinator + REST server with the edge API enabled."""
+
+    def __init__(self, settings: Settings):
+        self.settings = settings
+
+    async def __aenter__(self):
+        self.store = Store(
+            InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor()
+        )
+        machine, request_tx, events = await StateMachineInitializer(
+            self.settings, self.store
+        ).init()
+        self.machine = machine
+        self.handler = PetMessageHandler(events, request_tx)
+        self.fetcher = Fetcher(events)
+        self.events = events
+        self.request_tx = request_tx
+        self.edge_api = EdgeCoordinatorApi(events, request_tx)
+        self.rest = RestServer(self.fetcher, self.handler, edge_api=self.edge_api)
+        self.host, self.port = await self.rest.start("127.0.0.1", 0)
+        self.machine_task = asyncio.create_task(machine.run())
+        return self
+
+    async def __aexit__(self, *exc):
+        self.machine_task.cancel()
+        await self.rest.stop()
+        try:
+            await self.machine_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def wait_phase(self, name: str) -> None:
+        while self.fetcher.phase().value != name:
+            await asyncio.sleep(0.01)
+
+
+class _Edge:
+    """One in-process edge (EdgeService + participant-facing REST)."""
+
+    def __init__(self, upstream_url: str, edge_id: str, max_members: int = 64,
+                 linger_s: float = 0.05):
+        settings = Settings.default()
+        settings.edge = EdgeSettings(
+            upstream_url=upstream_url,
+            edge_id=edge_id,
+            max_members=max_members,
+            linger_s=linger_s,
+            poll_s=0.02,
+        )
+        self.service = EdgeService(settings)
+        self.rest = EdgeRestServer(self.service)
+
+    async def __aenter__(self):
+        self.host, self.port = await self.rest.start("127.0.0.1", 0)
+        await self.service.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.rest.stop()
+        await self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def wait_update_phase(self) -> None:
+        while not self.service.accepting_updates:
+            await asyncio.sleep(0.01)
+
+
+def _build_update_requests(params, sum_dict, models, scalar, key_start=5000):
+    """Protocol-level UpdateRequests (no message layer): distinct pks, one
+    masked model + local seed dict each — the edge fold path's input."""
+    out = []
+    for i, model in enumerate(models):
+        keys = SigningKeyPair.derive_from_seed(
+            (key_start + i).to_bytes(32, "little")
+        )
+        masker = Masker(params.mask_config)
+        seed, masked = masker.mask(Scalar.from_fraction(scalar), np.asarray(model))
+        out.append(
+            UpdateRequest(
+                participant_pk=keys.public,
+                local_seed_dict={
+                    sum_pk: seed.encrypt(PublicEncryptKey(ephm_pk))
+                    for sum_pk, ephm_pk in sum_dict.items()
+                },
+                masked_model=masked,
+            )
+        )
+    return out
+
+
+async def _drive_round(
+    coord: _Coordinator, models, update_targets, before_updates=None
+) -> np.ndarray:
+    """One full PET round over REST; update uploads go to ``update_targets``
+    (HttpClients, round-robin) — the coordinator itself for the flat run,
+    edges for the two-tier run. ``before_updates`` (async) runs once the
+    update phase is open and the sum dictionary exists, before any upload —
+    the two-tier test waits for the edges to sync the phase there."""
+    probe = HttpClient(coord.url)
+    await coord.wait_phase("sum")
+    params = await probe.get_round_params()
+    seed = params.seed.as_bytes()
+    n = len(models)
+
+    sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=0)
+    summer = ParticipantSM(
+        PetSettings(keys=sum_keys), HttpClient(coord.url), _ArrayModelStore(None)
+    )
+
+    async def drive_summer():
+        for _ in range(4000):
+            try:
+                await summer.transition()
+            except Exception:
+                pass
+            model = await probe.get_model()
+            if model is not None and summer.phase.value == "awaiting":
+                return
+            await asyncio.sleep(0.01)
+
+    summer_task = asyncio.create_task(drive_summer())
+    try:
+        await coord.wait_phase("update")
+        sum_dict = None
+        while not sum_dict:
+            sum_dict = await probe.get_sums()
+            await asyncio.sleep(0.01)
+        if before_updates is not None:
+            await before_updates()
+        sealed = [
+            build_update_message(
+                params,
+                keys_for_task(seed, SUM_PROB, UPDATE_PROB, "update", start=(20 + i) * 1000),
+                sum_dict,
+                models[i],
+                Fraction(1, n),
+            )
+            for i in range(n)
+        ]
+        await asyncio.gather(
+            *(
+                update_targets[i % len(update_targets)].send_message(blob)
+                for i, blob in enumerate(sealed)
+            )
+        )
+        await asyncio.wait_for(summer_task, timeout=90)
+    finally:
+        if not summer_task.done():
+            summer_task.cancel()
+    model = await probe.get_model()
+    assert model is not None
+    return np.asarray(model)
+
+
+# --- envelope wire format ----------------------------------------------------
+
+
+def test_envelope_roundtrip_and_corruption():
+    config = _mask_config()
+    rng = np.random.default_rng(3)
+    models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(3)]
+    sum_dict = {b"\x01" * 32: b"\x02" * 32}
+    params = _FakeParams(config)
+    reqs = _build_update_requests(params, sum_dict, models, Fraction(1, 3))
+
+    agg = EdgeAggregator(config, MODEL_LEN, max_members=8)
+    for req in reqs:
+        agg.admit(req)
+    envelope = agg.seal("edge-a", b"\x07" * 32)
+    blob = envelope.to_bytes()
+    back = PartialAggregateEnvelope.from_bytes(blob)
+    assert back.edge_id == "edge-a"
+    assert back.window_seq == 0
+    assert back.round_seed == b"\x07" * 32
+    assert back.members == envelope.members
+    assert back.masked == envelope.masked
+    assert set(back.seed_dicts) == set(envelope.members)
+    for pk in back.members:
+        assert {
+            k: v.as_bytes() for k, v in back.seed_dicts[pk].items()
+        } == {k: v.as_bytes() for k, v in envelope.seed_dicts[pk].items()}
+
+    # window sequence advances; dedup: resubmitting a shipped member fails
+    with pytest.raises(EdgeAdmitError):
+        agg.admit(reqs[0])
+
+    # corruption: a flipped payload byte fails the digest, truncation fails
+    corrupt = bytearray(blob)
+    corrupt[-1] ^= 0x01
+    with pytest.raises(EnvelopeError):
+        PartialAggregateEnvelope.from_bytes(bytes(corrupt))
+    with pytest.raises(EnvelopeError):
+        PartialAggregateEnvelope.from_bytes(blob[: len(blob) // 2])
+    with pytest.raises(EnvelopeError):
+        PartialAggregateEnvelope.from_bytes(b"NOTMAGIC" + blob)
+
+
+class _FakeParams:
+    """Just enough RoundParameters surface for request building."""
+
+    def __init__(self, config):
+        self.mask_config = config
+        self.model_length = MODEL_LEN
+
+
+# --- partition-merge property ------------------------------------------------
+
+
+def test_partition_merge_byte_identical_to_flat_fold():
+    """Merging K random partitions of one update set through edge partials
+    is byte-identical to the flat fold, for several random partitions, and
+    the merged seed dict is independent of the merge order."""
+    config = _mask_config()
+    rng = np.random.default_rng(11)
+    n = 12
+    models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(n)]
+    sum_dict = {b"\x01" * 32: b"\x02" * 32, b"\x03" * 32: b"\x04" * 32}
+    params = _FakeParams(config)
+    reqs = _build_update_requests(params, sum_dict, models, Fraction(1, n))
+
+    # flat fold: every update aggregated centrally, in order
+    flat = Aggregation(config, MODEL_LEN)
+    flat_seed_dict: dict = {}
+    for req in reqs:
+        flat.aggregate(req.masked_model)
+        for sum_pk, enc in req.local_seed_dict.items():
+            flat_seed_dict.setdefault(sum_pk, {})[req.participant_pk] = enc.as_bytes()
+
+    for trial in range(4):
+        prng = np.random.default_rng(100 + trial)
+        k = int(prng.integers(1, 5))
+        assignment = prng.integers(0, k, size=n)
+        order = list(prng.permutation(k))
+
+        merged = Aggregation(config, MODEL_LEN)
+        merged_seed_dict: dict = {}
+        total = 0
+        for part in order:
+            member_ids = [i for i in range(n) if assignment[i] == part]
+            if not member_ids:
+                continue
+            edge = EdgeAggregator(config, MODEL_LEN, max_members=n)
+            for i in member_ids:
+                edge.admit(reqs[i])
+            envelope = edge.seal(f"edge-{part}", b"\x07" * 32)
+            envelope = PartialAggregateEnvelope.from_bytes(envelope.to_bytes())
+            merged.aggregate_partial(envelope.masked, len(envelope))
+            total += len(envelope)
+            # seed-dict merge order independence: dict merge is keyed by
+            # (sum_pk, update_pk) — disjoint per member, any order works
+            for pk in envelope.members:
+                for sum_pk, enc in envelope.seed_dicts[pk].items():
+                    merged_seed_dict.setdefault(sum_pk, {})[pk] = enc.as_bytes()
+
+        assert total == n
+        assert merged.nb_models == flat.nb_models == n
+        assert (
+            merged.object.vect.data.tobytes() == flat.object.vect.data.tobytes()
+        ), f"trial {trial}: partitioned fold diverged from flat fold"
+        assert merged.object.unit.data.tobytes() == flat.object.unit.data.tobytes()
+        assert merged_seed_dict == flat_seed_dict
+
+
+# --- two-tier round ----------------------------------------------------------
+
+
+def test_two_tier_round_byte_identical_with_batched_ingress():
+    """Acceptance: a 2-edge x 8-participant round produces a global model
+    byte-identical to the flat run on the same inputs, with the
+    coordinator folding ~N/edge-batch envelopes instead of N updates, and
+    a replayed envelope rejected by the per-edge watermark."""
+    registry = get_registry()
+
+    def sample(name, labels=None):
+        return registry.sample_value(name, labels) or 0.0
+
+    async def run():
+        n = 8
+        rng = np.random.default_rng(5)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(n)]
+        expected = sum(m.astype(np.float64) for m in models) / n
+
+        folded0 = sample("xaynet_edge_members_folded_total")
+        accepted0 = sample("xaynet_edge_envelopes_total", {"outcome": "accepted"})
+
+        async with _Coordinator(_settings(n)) as coord:
+            async with _Edge(coord.url, "edge-a", max_members=4) as ea, _Edge(
+                coord.url, "edge-b", max_members=4
+            ) as eb:
+                await coord.wait_phase("sum")
+                targets = [HttpClient(ea.url), HttpClient(eb.url)]
+
+                async def edges_see_update_phase():
+                    # lock-step: edges must SEE the update phase before the
+                    # flood, or early uploads would be relayed upstream and
+                    # dilute the batching assertion
+                    await ea.wait_update_phase()
+                    await eb.wait_update_phase()
+
+                got_tiered = await asyncio.wait_for(
+                    _drive_round(
+                        coord, models, targets, before_updates=edges_see_update_phase
+                    ),
+                    120,
+                )
+                # every update was folded via envelopes, none directly
+                assert sample("xaynet_edge_members_folded_total") - folded0 == n
+                envelopes = (
+                    sample("xaynet_edge_envelopes_total", {"outcome": "accepted"})
+                    - accepted0
+                )
+                # coordinator ingress shrank by ~the edge batch factor:
+                # 8 updates over 2 edges with max_members=4 -> 2..4
+                # envelopes (linger may split a window)
+                assert 1 <= envelopes <= n / 2, envelopes
+
+        np.testing.assert_allclose(got_tiered, expected, atol=1e-9)
+
+        # flat control run: same models, updates straight to the coordinator
+        async with _Coordinator(_settings(n)) as coord:
+            got_flat = await asyncio.wait_for(
+                _drive_round(coord, models, [HttpClient(coord.url)]), 120
+            )
+        np.testing.assert_allclose(got_flat, expected, atol=1e-9)
+        assert got_tiered.tobytes() == got_flat.tobytes()
+
+    asyncio.run(run())
+
+
+# --- watermark + atomicity ---------------------------------------------------
+
+
+def test_envelope_watermark_and_atomicity():
+    """Direct protocol-level checks on the coordinator: a replayed envelope
+    is rejected as stale, an envelope overlapping an already-seeded member
+    is rejected WHOLE (the fresh member is not folded either), and the
+    nb_models == seed-watermark invariant holds throughout."""
+
+    async def run():
+        n_min = 6
+        config = _mask_config()
+        rng = np.random.default_rng(9)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(8)]
+        # the members that end up folded below: edge-a windows [0,1,2] and
+        # [6], edge-b window [3,4]
+        expected = sum(models[i].astype(np.float64) for i in (0, 1, 2, 3, 4, 6)) / 6
+
+        async with _Coordinator(_settings(n_min)) as coord:
+            probe = HttpClient(coord.url)
+            await coord.wait_phase("sum")
+            params = await probe.get_round_params()
+            seed = params.seed.as_bytes()
+            summer = ParticipantSM(
+                PetSettings(keys=keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum")),
+                HttpClient(coord.url),
+                _ArrayModelStore(None),
+            )
+
+            async def drive_summer():
+                for _ in range(4000):
+                    try:
+                        await summer.transition()
+                    except Exception:
+                        pass
+                    model = await probe.get_model()
+                    if model is not None and summer.phase.value == "awaiting":
+                        return
+                    await asyncio.sleep(0.01)
+
+            summer_task = asyncio.create_task(drive_summer())
+            try:
+                await coord.wait_phase("update")
+                sum_dict = None
+                while not sum_dict:
+                    sum_dict = await probe.get_sums()
+                    await asyncio.sleep(0.01)
+
+                reqs = _build_update_requests(
+                    params, sum_dict, models, Fraction(1, 6), key_start=7000
+                )
+
+                aggs: dict[str, EdgeAggregator] = {}
+
+                def seal(member_reqs, edge_id):
+                    edge = aggs.setdefault(
+                        edge_id, EdgeAggregator(config, MODEL_LEN, max_members=8)
+                    )
+                    for r in member_reqs:
+                        edge.admit(r)
+                    return edge.seal(edge_id, seed)
+
+                api = coord.edge_api
+                env_a = seal(reqs[0:3], "edge-a")
+                ok, _ = await api.submit_envelope(env_a.to_bytes())
+                assert ok
+
+                # replay of the envelope AT the watermark (lost ack, the
+                # edge retried): acked idempotently as success — but NOT
+                # folded again (the final model below proves nb_models and
+                # the count window did not double-advance)
+                ok, detail = await api.submit_envelope(env_a.to_bytes())
+                assert ok, detail
+
+                # overlap: reqs[2] already seeded + a FRESH member -> the
+                # whole envelope bounces; the fresh member is NOT seeded
+                env_overlap = seal([reqs[2], reqs[6]], "edge-b")
+                ok, detail = await api.submit_envelope(env_overlap.to_bytes())
+                assert not ok and "already seeded" in detail
+                seed_dict_now = await coord.store.coordinator.seed_dict() or {}
+                seeded_pks = {pk for inner in seed_dict_now.values() for pk in inner}
+                assert reqs[6].participant_pk not in seeded_pks
+
+                # ...so the bounced fresh member reaches the round through
+                # another window (here: edge-a's next one, seq 1)
+                env_a2 = seal([reqs[6]], "edge-a")
+                ok, _ = await api.submit_envelope(env_a2.to_bytes())
+                assert ok
+
+                # an envelope strictly BELOW the watermark (an older
+                # window, not the lost-ack replay) is rejected stale
+                ok, detail = await api.submit_envelope(env_a.to_bytes())
+                assert not ok and "stale" in detail
+
+                # wrong round seed -> rejected
+                env_wrong = seal([reqs[7]], "edge-c")
+                env_wrong.round_seed = b"\x00" * 32
+                ok, detail = await api.submit_envelope(env_wrong.to_bytes())
+                assert not ok and "another round" in detail
+
+                # a garbled envelope is a 400-class EnvelopeError
+                with pytest.raises(EnvelopeError):
+                    await api.submit_envelope(b"XNEDGE1garbage")
+
+                # edge-b's next window completes the count window (3+1+2)
+                env_b = seal(reqs[3:5], "edge-b")
+                ok, _ = await api.submit_envelope(env_b.to_bytes())
+                assert ok
+
+                await asyncio.wait_for(summer_task, timeout=60)
+            finally:
+                if not summer_task.done():
+                    summer_task.cancel()
+
+            # the round unmasked exactly the 6 folded members: nb_models
+            # agreed with the seed watermark, or unmask would have failed
+            model = await probe.get_model()
+            np.testing.assert_allclose(np.asarray(model), expected, atol=1e-9)
+
+    asyncio.run(run())
+
+
+# --- window straddling + restart sequences -----------------------------------
+
+
+def test_aggregator_start_seq_continues_past_a_crashed_incarnation():
+    """A restarted edge process must ship sequences PAST its predecessor's
+    (the coordinator's per-edge watermark is strictly monotonic within a
+    round): ``start_seq`` seeds the window sequence, and seals increment
+    from there."""
+    config = _mask_config()
+    edge = EdgeAggregator(config, MODEL_LEN, max_members=4, start_seq=1_000)
+    params_seed = b"\x05" * 32
+    reqs = _build_update_requests(
+        _FakeParams(config), {b"s" * 32: b"e" * 32}, [np.ones(MODEL_LEN)], Fraction(1, 1),
+        key_start=9_500,
+    )
+    edge.admit(reqs[0])
+    assert edge.seal("edge-r", params_seed).window_seq == 1_000
+    edge2 = EdgeAggregator(config, MODEL_LEN, max_members=4, start_seq=1_000)
+    edge2.admit(
+        _build_update_requests(
+            _FakeParams(config), {b"s" * 32: b"e" * 32}, [np.ones(MODEL_LEN)],
+            Fraction(1, 1), key_start=9_600,
+        )[0]
+    )
+    assert edge2.seal("edge-r", params_seed).window_seq == 1_000  # same base
+    edge2.admit(
+        _build_update_requests(
+            _FakeParams(config), {b"s" * 32: b"e" * 32}, [np.ones(MODEL_LEN)],
+            Fraction(1, 1), key_start=9_700,
+        )[0]
+    )
+    assert edge2.seal("edge-r", params_seed).window_seq == 1_001  # increments
+
+
+class _FakeParams:
+    """Just enough RoundParameters surface for _build_update_requests."""
+
+    def __init__(self, config):
+        self.mask_config = config
+
+
+def test_coalesced_batch_straddling_window_boundary_seals_mid_batch():
+    """A coalesced ingest batch larger than the window's remaining space
+    must seal the full window MID-BATCH and fold the tail into a fresh one
+    — never bounce tail members with 'window-full' (a rejection the PR-5
+    participant FSM treats as a permanent upload failure)."""
+
+    async def run():
+        n = 5
+        rng = np.random.default_rng(23)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(n)]
+        expected = sum(m.astype(np.float64) for m in models) / n
+
+        async with _Coordinator(_settings(n)) as coord:
+            probe = HttpClient(coord.url)
+            async with _Edge(
+                coord.url, "edge-straddle", max_members=2, linger_s=0.05
+            ) as edge:
+
+                async def inject_batch():
+                    await edge.wait_update_phase()
+                    params = await probe.get_round_params()
+                    sum_dict = await probe.get_sums()
+                    reqs = _build_update_requests(
+                        params, sum_dict, models, Fraction(1, n), key_start=11_000
+                    )
+                    loop = asyncio.get_running_loop()
+                    futures = [loop.create_future() for _ in reqs]
+                    from xaynet_tpu.server.requests import CoalescedUpdates
+
+                    # one batch of 5 against max_members=2: straddles two
+                    # window boundaries (2 + 2 + 1)
+                    await edge.service.request_tx.request(
+                        CoalescedUpdates(members=reqs, responses=futures)
+                    )
+                    results = await asyncio.gather(*futures, return_exceptions=True)
+                    rejected = [r for r in results if isinstance(r, Exception)]
+                    assert not rejected, f"tail members bounced: {rejected}"
+
+                model = await _drive_round(
+                    coord,
+                    [],  # updates injected below, not uploaded over REST
+                    [HttpClient(coord.url)],
+                    before_updates=inject_batch,
+                )
+                np.testing.assert_allclose(model, expected, atol=1e-9)
+                # the batch became >= 3 envelopes (2+2+1), not one bounce
+                assert edge.service.shipped >= 3
+
+    asyncio.run(run())
+
+
+# --- edge crash mid-window ---------------------------------------------------
+
+
+def test_edge_crash_mid_window_participants_fall_back_upstream():
+    """An edge that dies before shipping its window loses nothing durable:
+    the participants (whose uploads it absorbed) retry upstream directly,
+    the round completes, and the invariant holds (the unmasked model is
+    exactly the final member set)."""
+
+    async def run():
+        n = 4
+        rng = np.random.default_rng(17)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(n)]
+        expected = sum(m.astype(np.float64) for m in models) / n
+
+        async with _Coordinator(_settings(n)) as coord:
+            probe = HttpClient(coord.url)
+            # an edge with a long linger: it will absorb uploads and sit on
+            # them, simulating a crash before any envelope ships
+            async with _Edge(
+                coord.url, "edge-crash", max_members=64, linger_s=30.0
+            ) as edge:
+                await coord.wait_phase("sum")
+                params = await probe.get_round_params()
+                seed = params.seed.as_bytes()
+                summer = ParticipantSM(
+                    PetSettings(keys=keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum")),
+                    HttpClient(coord.url),
+                    _ArrayModelStore(None),
+                )
+
+                async def drive_summer():
+                    for _ in range(4000):
+                        try:
+                            await summer.transition()
+                        except Exception:
+                            pass
+                        model = await probe.get_model()
+                        if model is not None and summer.phase.value == "awaiting":
+                            return
+                        await asyncio.sleep(0.01)
+
+                summer_task = asyncio.create_task(drive_summer())
+                try:
+                    await coord.wait_phase("update")
+                    sum_dict = None
+                    while not sum_dict:
+                        sum_dict = await probe.get_sums()
+                        await asyncio.sleep(0.01)
+                    await edge.wait_update_phase()
+                    sealed = [
+                        build_update_message(
+                            params,
+                            keys_for_task(
+                                seed, SUM_PROB, UPDATE_PROB, "update", start=(40 + i) * 1000
+                            ),
+                            sum_dict,
+                            models[i],
+                            Fraction(1, n),
+                        )
+                        for i in range(n)
+                    ]
+                    # half the participants upload via the edge...
+                    edge_client = HttpClient(edge.url)
+                    for blob in sealed[: n // 2]:
+                        await edge_client.send_message(blob)
+                    # ...whose window absorbed them (nothing shipped yet)
+                    while edge.service.aggregator.pending < n // 2:
+                        await asyncio.sleep(0.01)
+                    assert edge.service.shipped == 0
+                    # CRASH: the edge dies mid-window
+                    await edge.service.stop()
+
+                    # the participants' resilient clients notice the dead
+                    # edge and fall back to the coordinator directly —
+                    # modeled here by re-uploading ALL updates upstream
+                    # (the edge-absorbed ones were never seeded upstream,
+                    # so their retries are fresh, not duplicates)
+                    direct = HttpClient(coord.url)
+                    for blob in sealed:
+                        await direct.send_message(blob)
+
+                    await asyncio.wait_for(summer_task, timeout=60)
+                finally:
+                    if not summer_task.done():
+                        summer_task.cancel()
+
+                model = await probe.get_model()
+                np.testing.assert_allclose(np.asarray(model), expected, atol=1e-9)
+
+    asyncio.run(run())

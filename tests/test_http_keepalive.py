@@ -1,0 +1,259 @@
+"""SDK transport keep-alive: one connection per host, not per request.
+
+The REST stack used to re-handshake per request (ROADMAP item 5's
+transport tax); ``HttpClient`` now pools its connection and reuses it
+across requests, with ``keep_alive=False`` restoring the historical
+one-shot behavior and a one-retry fallback when a pooled connection turns
+out to be stale (the server idled it out between requests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from xaynet_tpu.sdk.client import ClientTransientError, HttpClient
+
+
+class _MiniServer:
+    """Counts TCP connections; answers every request 200 with a tiny body."""
+
+    def __init__(self, close_after_each: bool = False, advertise_close: bool = False):
+        self.connections = 0
+        self.requests = 0
+        self.close_after_each = close_after_each
+        self.advertise_close = advertise_close
+
+    async def __aenter__(self):
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                length = 0
+                while True:
+                    header = await reader.readline()
+                    if header in (b"\r\n", b""):
+                        break
+                    name, _, value = header.decode().partition(":")
+                    if name.strip().lower() == "content-length":
+                        length = int(value.strip())
+                if length:
+                    await reader.readexactly(length)
+                self.requests += 1
+                connection = "close" if self.advertise_close else "keep-alive"
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
+                    b"Content-Length: 2\r\n"
+                    + f"Connection: {connection}\r\n\r\n".encode()
+                    + b"ok"
+                )
+                await writer.drain()
+                if self.close_after_each or self.advertise_close:
+                    return
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+def test_keep_alive_reuses_one_connection():
+    async def run():
+        async with _MiniServer() as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}")
+            try:
+                for _ in range(5):
+                    status, _, body = await client._request("GET", "/params")
+                    assert status == 200 and body == b"ok"
+            finally:
+                client.close()
+            assert srv.requests == 5
+            assert srv.connections == 1, "keep-alive must reuse the connection"
+            assert client.connections_opened == 1
+
+    asyncio.run(run())
+
+
+def test_keep_alive_opt_out_reconnects_per_request():
+    async def run():
+        async with _MiniServer() as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}", keep_alive=False)
+            for _ in range(3):
+                status, _, _ = await client._request("GET", "/params")
+                assert status == 200
+            assert srv.requests == 3
+            assert srv.connections == 3, "opt-out must re-handshake per request"
+
+    asyncio.run(run())
+
+
+def test_server_advertised_close_is_respected():
+    """A response carrying ``Connection: close`` must not be pooled."""
+
+    async def run():
+        async with _MiniServer(advertise_close=True) as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}")
+            try:
+                for _ in range(3):
+                    status, _, _ = await client._request("GET", "/params")
+                    assert status == 200
+            finally:
+                client.close()
+            assert srv.connections == 3
+
+    asyncio.run(run())
+
+
+def test_stale_pooled_connection_retried_once():
+    """The server silently drops the connection after each response (an
+    idle timeout): the next request on the pooled stream fails mid-flight
+    and must transparently retry on a fresh connection."""
+
+    async def run():
+        async with _MiniServer(close_after_each=True) as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}")
+            try:
+                for _ in range(4):
+                    status, _, body = await client._request("GET", "/params")
+                    assert status == 200 and body == b"ok"
+            finally:
+                client.close()
+            assert srv.requests == 4
+
+    asyncio.run(run())
+
+
+class _PartialResponseServer:
+    """Answers the first request normally (keep-alive), then kills the
+    connection mid-status-line on the second — after response bytes began."""
+
+    def __init__(self, partial: bytes = b"HTT"):
+        self.requests = 0
+        self.connections = 0
+        self.partial = partial
+
+    async def __aenter__(self):
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                while True:
+                    header = await reader.readline()
+                    if header in (b"\r\n", b""):
+                        break
+                self.requests += 1
+                if self.requests == 1:
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                        b"Connection: keep-alive\r\n\r\nok"
+                    )
+                    await writer.drain()
+                else:
+                    writer.write(self.partial)  # torn response, then die
+                    await writer.drain()
+                    return
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+def test_no_silent_resend_after_response_bytes_began():
+    """A reused connection that dies AFTER yielding response bytes means
+    the server processed the request — a silent re-send could duplicate a
+    non-idempotent POST, so the error must surface instead of retrying."""
+
+    async def run():
+        async with _PartialResponseServer() as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}")
+            try:
+                status, _, _ = await client._request("GET", "/params")
+                assert status == 200
+                with pytest.raises(ClientTransientError):
+                    await client._request("POST", "/message", b"payload")
+            finally:
+                client.close()
+            # exactly the two requests the caller made: no hidden third
+            assert srv.requests == 2
+            assert srv.connections == 1
+
+    asyncio.run(run())
+
+
+def test_no_silent_resend_on_timeout():
+    """A timeout on a reused connection is NOT the stale-keep-alive race —
+    the peer may be processing — so the client must not re-send."""
+
+    class _StallServer(_PartialResponseServer):
+        async def _handle(self, reader, writer):
+            self.connections += 1
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    while True:
+                        header = await reader.readline()
+                        if header in (b"\r\n", b""):
+                            break
+                    self.requests += 1
+                    if self.requests == 1:
+                        writer.write(
+                            b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                            b"Connection: keep-alive\r\n\r\nok"
+                        )
+                        await writer.drain()
+                    else:
+                        await asyncio.sleep(30)  # stall past the timeout
+                        return
+            finally:
+                writer.close()
+
+    async def run():
+        async with _StallServer() as srv:
+            client = HttpClient(f"http://127.0.0.1:{srv.port}", timeout=0.3)
+            try:
+                status, _, _ = await client._request("GET", "/params")
+                assert status == 200
+                with pytest.raises(ClientTransientError):
+                    await client._request("POST", "/message", b"payload")
+            finally:
+                client.close()
+            assert srv.requests == 2, "timeout must not trigger a re-send"
+
+    asyncio.run(run())
+
+
+def test_connect_failure_is_transient():
+    async def run():
+        client = HttpClient("http://127.0.0.1:1")  # nothing listens there
+        with pytest.raises(ClientTransientError):
+            await client._request("GET", "/params")
+
+    asyncio.run(run())

@@ -221,3 +221,40 @@ def test_sdk_tree_is_clean_under_raw_http_rule():
     for path in sorted(target.rglob("*.py")):
         problems.extend(xn_lint.check_file(path))
     assert problems == []
+
+
+# --- edge fold-accounting rule ---------------------------------------------
+
+
+def test_direct_fold_rejected_in_edge_tree(tmp_path, monkeypatch):
+    source = (
+        "def f(agg, obj, stack, units, ol):\n"
+        "    agg.aggregate(obj)\n"
+        "    agg.aggregate_batch(stack, units)\n"
+        "    mod_add(stack, stack, ol)\n"
+        "    agg.fold_partial(obj, 3)\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/edge/foo.py", source)
+    assert sum("partial-aggregate accounting path" in p for p in problems) == 4
+
+
+def test_fold_allowlisted_and_out_of_tree_pass(tmp_path, monkeypatch):
+    annotated = (
+        "def f(agg, obj):\n"
+        "    agg.aggregate(obj)  # lint: fold-ok\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/edge/foo.py", annotated)
+    assert not any("accounting path" in p for p in problems)
+
+    bare = "def f(agg, obj):\n    agg.aggregate(obj)\n"
+    for rel in ("xaynet_tpu/server/foo.py", "xaynet_tpu/core/foo.py", "tools/foo.py"):
+        problems = _check(tmp_path, monkeypatch, rel, bare)
+        assert not any("accounting path" in p for p in problems), rel
+
+
+def test_edge_tree_is_clean_under_fold_rule():
+    target = REPO / "xaynet_tpu" / "edge"
+    problems = []
+    for path in sorted(target.rglob("*.py")):
+        problems.extend(xn_lint.check_file(path))
+    assert problems == []

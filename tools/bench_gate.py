@@ -1,0 +1,147 @@
+"""Bench regression gate: replay BENCH_HISTORY.jsonl, fail on regression.
+
+The perf trajectory (BENCH.md) must only move up: this gate replays the
+bench history, finds the HEADLINE series — masked-update aggregation
+throughput in updates/s — and exits 1 when the latest recorded round
+regresses more than ``--threshold`` (default 10%) against the best prior
+round. Wire it as a tier-2 check after appending a fresh bench round:
+
+  python bench.py ... && python tools/bench_gate.py
+
+Entries are heterogeneous (several generations of writers appended here);
+a record contributes when its metric/value/unit can be found either at the
+top level or under ``parsed``. Unmatched lines are skipped, never fatal —
+the gate must keep working as writers evolve.
+
+Usage:
+  python tools/bench_gate.py [--history BENCH_HISTORY.jsonl]
+                             [--metric-prefix "masked-update aggregation throughput"]
+                             [--threshold 0.10] [--list]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_HISTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_HISTORY.jsonl"
+)
+HEADLINE_PREFIX = "masked-update aggregation throughput"
+HEADLINE_UNIT = "updates/s"
+
+
+def extract(record: dict) -> tuple[str, float, str] | None:
+    """(metric, value, unit) from one history record, wherever the writer
+    put it; None when the record carries no scalar metric."""
+    for node in (record, record.get("parsed") or {}):
+        metric = node.get("metric")
+        value = node.get("value")
+        unit = node.get("unit")
+        if metric and isinstance(value, (int, float)):
+            return str(metric), float(value), str(unit or "")
+    return None
+
+
+def load_series(path: str, metric_prefix: str, unit: str) -> list[tuple[float, str, float]]:
+    """Chronological (ts, metric, value) for the headline series."""
+    series = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn append must not kill the gate
+            found = extract(record)
+            if found is None:
+                continue
+            metric, value, rec_unit = found
+            if metric.startswith(metric_prefix) and rec_unit == unit:
+                series.append((float(record.get("ts", 0.0)), metric, value))
+    series.sort(key=lambda item: item[0])
+    return series
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", default=DEFAULT_HISTORY)
+    ap.add_argument(
+        "--metric-prefix",
+        default=HEADLINE_PREFIX,
+        help="headline series selector (metric name prefix)",
+    )
+    ap.add_argument("--unit", default=HEADLINE_UNIT)
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="maximum tolerated fractional regression vs the best prior round",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="print the headline series and exit 0"
+    )
+    args = ap.parse_args()
+    if not (0.0 < args.threshold < 1.0):
+        ap.error("--threshold must be in (0, 1)")
+
+    series = load_series(args.history, args.metric_prefix, args.unit)
+    if args.list:
+        for ts, metric, value in series:
+            print(f"{ts:.0f}  {value:10.2f} {args.unit}  {metric}")
+        return 0
+    if len(series) < 2:
+        # nothing to gate against: a fresh repo (or a renamed headline) must
+        # not hard-fail CI, but say so loudly
+        print(
+            f"bench-gate: only {len(series)} headline round(s) in "
+            f"{args.history}; nothing to compare",
+            file=sys.stderr,
+        )
+        return 0
+
+    # gate within ONE exact series: the prefix family carries variants
+    # (@25M params vs @200k params) whose absolute numbers are worlds
+    # apart — the latest record picks which variant is being gated
+    latest_metric = series[-1][1]
+    series = [item for item in series if item[1] == latest_metric]
+    if len(series) < 2:
+        print(
+            f"bench-gate: first round of '{latest_metric}'; nothing to compare",
+            file=sys.stderr,
+        )
+        return 0
+    *prior, (_, _, latest) = series
+    best_ts, best_metric, best = max(prior, key=lambda item: item[2])
+    floor = best * (1.0 - args.threshold)
+    verdict = {
+        "latest": latest,
+        "best_prior": best,
+        "floor": round(floor, 3),
+        "threshold": args.threshold,
+        "unit": args.unit,
+        "rounds": len(series),
+        "metric": latest_metric,
+    }
+    if latest < floor:
+        verdict["result"] = "REGRESSION"
+        print(json.dumps(verdict))
+        print(
+            f"bench-gate: FAIL — latest {latest:.2f} {args.unit} is "
+            f"{(1 - latest / best) * 100:.1f}% below the best prior round "
+            f"({best:.2f} @ ts {best_ts:.0f}, '{best_metric}'); "
+            f"tolerated: {args.threshold * 100:.0f}%",
+            file=sys.stderr,
+        )
+        return 1
+    verdict["result"] = "ok"
+    print(json.dumps(verdict))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,35 @@ def _is_raw_http_call(node: ast.Call) -> bool:
     return isinstance(func, ast.Name) and func.id in _RAW_HTTP_CALLEES
 
 
+# fold entry points that bypass the EdgeAggregator accounting path when
+# called directly from edge code: a modular add without the matching
+# member/seed-dict accounting ships an envelope whose nb_models disagrees
+# with its content and breaks the coordinator's nb_models == seed-watermark
+# unmask invariant (docs/DESIGN.md §11)
+_FOLD_CALLEES = frozenset(
+    {
+        "aggregate",
+        "aggregate_batch",
+        "aggregate_partial",
+        "fold_partial",
+        "mod_add",
+        "batch_mod_sum",
+        "fold_wire_batch_host",
+        "fold_planar_batch_host",
+        "masked_add",
+    }
+)
+
+
+def _is_fold_call(node: ast.Call) -> bool:
+    """True for any spelling that resolves to a masked-add/fold entry point
+    (syntactic, like the queue rule)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _FOLD_CALLEES
+    return isinstance(func, ast.Name) and func.id in _FOLD_CALLEES
+
+
 def _is_device_put(node: ast.Call) -> bool:
     """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
     rule is syntactic, like the queue rule: any spelling that resolves to
@@ -285,7 +314,12 @@ def check_file(path: Path) -> list[str]:
     # hot-path trees: raw perf_counter timing bypasses the telemetry layer
     hot_path = str(rel).startswith(("xaynet_tpu/parallel", "xaynet_tpu/server"))
     # coordinator queue trees: unbounded queues defeat admission control
-    bounded_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/ingest"))
+    bounded_tree = str(rel).startswith(
+        ("xaynet_tpu/server", "xaynet_tpu/ingest", "xaynet_tpu/edge")
+    )
+    # edge tree: every fold must flow through the EdgeAggregator accounting
+    # path (admit/seal), never a direct masked_add
+    edge_tree = str(rel).startswith("xaynet_tpu/edge")
     # coordinator/storage trees: silent broad swallows hide infrastructure
     # failures from the resilience layer and the operator
     no_swallow_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/storage"))
@@ -325,6 +359,14 @@ def check_file(path: Path) -> list[str]:
                     "bypasses the resilient client wrapper (route coordinator "
                     "traffic through sdk.client.HttpClient/ResilientClient, or "
                     "annotate the transport itself with '# lint: raw-http-ok')"
+                )
+        if edge_tree and isinstance(node, ast.Call) and _is_fold_call(node):
+            if "lint: fold-ok" not in line_of(node):
+                problems.append(
+                    f"{rel}:{node.lineno}: direct masked_add/fold call in the edge "
+                    "tree bypasses the partial-aggregate accounting path (fold "
+                    "through EdgeAggregator.admit/seal, or annotate the accounting "
+                    "path's own fold site with '# lint: fold-ok')"
                 )
         if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
             if "lint: device-put-ok" not in line_of(node):

@@ -80,8 +80,24 @@ wire_ingest = {agg_wire_ingest}
 backend = "filesystem"
 model_dir = "{model_dir}"
 
+{edge_enabled_line}
 [log]
 # info: the soak artifact reads the aggregator's "kernel resolved" line
+filter = "info"
+"""
+
+EDGE_CONFIG = """
+[api]
+bind_address = "127.0.0.1:{port}"
+
+[edge]
+upstream_url = "http://127.0.0.1:{upstream_port}"
+edge_id = "{edge_id}"
+max_members = {max_members}
+linger_s = 0.2
+poll_s = 0.1
+
+[log]
 filter = "info"
 """
 
@@ -112,7 +128,9 @@ def run_chaos_soak_sync(
         # exists to exercise: one connection reset on a bare HttpClient
         # would abort the whole run (the sum leg already retries — the
         # Participant wraps its client in ResilientClient by default)
-        return ResilientClient(HttpClient(url))
+        # one-shot per-poll client: its event loop dies with asyncio.run,
+        # so a pooled keep-alive socket would just leak until GC
+        return ResilientClient(HttpClient(url, keep_alive=False))
 
     def fetch_params():
         return asyncio.run(_client().get_round_params())
@@ -193,6 +211,108 @@ def run_chaos_soak_sync(
     }
 
 
+def run_two_tier_soak_sync(
+    port: int, edge_ports: list, rounds: int, model_len: int, updaters: int
+) -> dict:
+    """Two-tier soak: the sum leg talks to the coordinator directly; every
+    update upload goes to an EDGE (round-robin across ``edge_ports``),
+    which folds windows locally and ships partial-aggregate envelopes
+    upstream. The round completes exactly like the flat topology — the
+    coordinator just sees envelopes instead of per-participant updates."""
+    import itertools
+
+    from fractions import Fraction
+
+    import numpy as np
+
+    from xaynet_tpu.sdk.client import HttpClient, ResilientClient
+    from xaynet_tpu.sdk.participant import Participant
+    from xaynet_tpu.sdk.simulation import flood, keys_for_task
+
+    url = f"http://127.0.0.1:{port}"
+    edge_urls = [f"http://127.0.0.1:{p}" for p in edge_ports]
+
+    def fetch_params():
+        # one-shot per-poll clients (here and below): the loop dies with
+        # asyncio.run, so a pooled keep-alive socket would leak until GC
+        return asyncio.run(
+            ResilientClient(HttpClient(url, keep_alive=False)).get_round_params()
+        )
+
+    completed = 0
+    accepted_total = 0
+    last_seed = None
+    t0 = time.perf_counter()
+    while completed < rounds:
+        params = fetch_params()
+        if params.seed.as_bytes() == last_seed:
+            time.sleep(0.01)
+            continue
+        last_seed = params.seed.as_bytes()
+        seed = last_seed
+        summer = Participant(
+            url,
+            keys=keys_for_task(seed, params.sum, params.update, "sum"),
+            scalar=Fraction(1, updaters),
+        )
+        try:
+            for _ in range(200):
+                summer.tick()
+                sum_dict = asyncio.run(
+                    ResilientClient(HttpClient(url, keep_alive=False)).get_sums()
+                )
+                if sum_dict:
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"round {completed + 1}: sum dictionary never appeared")
+
+            async def flood_edges():
+                clients = [ResilientClient(HttpClient(u)) for u in edge_urls]
+                rr = itertools.count()
+
+                async def submit(blob: bytes) -> None:
+                    await clients[next(rr) % len(clients)].send_message(blob)
+
+                rng = np.random.default_rng(completed + 1)
+                try:
+                    return await flood(
+                        submit,
+                        params,
+                        sum_dict,
+                        updaters,
+                        models=[
+                            rng.uniform(-1, 1, model_len).astype(np.float32)
+                            for _ in range(updaters)
+                        ],
+                        scalar=Fraction(1, updaters),
+                        key_spacing=100_000,
+                    )
+                finally:
+                    for c in clients:
+                        c.close()
+
+            stats = asyncio.run(flood_edges())
+            accepted_total += stats.accepted
+            for _ in range(600):
+                summer.tick()
+                if fetch_params().seed.as_bytes() != seed:
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"round {completed + 1} did not complete")
+        finally:
+            summer.close()
+        completed += 1
+    return {
+        "rounds": completed,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "updates_accepted": accepted_total,
+        "edges": len(edge_urls),
+        "updaters_per_round": updaters,
+    }
+
+
 def run_soak_sync(port: int, rounds: int, model_len: int) -> dict:
     # synchronous driver: Participant.tick() owns its own event loop, so
     # the soak loop must NOT run inside asyncio itself
@@ -207,7 +327,7 @@ def run_soak_sync(port: int, rounds: int, model_len: int) -> dict:
     url = f"http://127.0.0.1:{port}"
 
     def fetch_params():
-        return asyncio.run(HttpClient(url).get_round_params())
+        return asyncio.run(HttpClient(url, keep_alive=False).get_round_params())
 
     completed = 0
     last_seed = None
@@ -282,6 +402,23 @@ def main() -> None:
         "(they still land inside the stall grace window)",
     )
     ap.add_argument(
+        "--edges",
+        type=int,
+        default=None,
+        metavar="N",
+        help="two-tier soak: spawn N edge aggregator processes; all update "
+        "uploads go through the edges (round-robin) and reach the "
+        "coordinator as partial-aggregate envelopes",
+    )
+    ap.add_argument(
+        "--edge-updaters",
+        type=int,
+        default=10,
+        metavar="M",
+        help="with --edges: update participants PER EDGE per round "
+        "(default 10; --edges 4 therefore drives 40 participants)",
+    )
+    ap.add_argument(
         "--faults",
         type=int,
         default=None,
@@ -303,6 +440,14 @@ def main() -> None:
     chaos = args.dropout is not None or args.stragglers is not None
     dropout = args.dropout or 0.0
     stragglers = args.stragglers or 0
+    if args.edges is not None:
+        if args.edges < 1:
+            ap.error("--edges must be >= 1")
+        if chaos:
+            ap.error("--edges and --dropout/--stragglers are separate soaks")
+        if args.edge_updaters < 1:
+            ap.error("--edge-updaters must be >= 1")
+    two_tier_updaters = (args.edges or 0) * args.edge_updaters
     if chaos:
         if not (0.0 <= dropout < 1.0):
             ap.error("--dropout must be in [0, 1)")
@@ -352,12 +497,22 @@ def main() -> None:
                     agg_batch=2 if args.device_kernel else 64,
                     agg_kernel=args.device_kernel or "auto",
                     # churn soak: full updater fan-in as the window, quorum
-                    # at the floor so dropped-out rounds close degraded
-                    update_min=N_CHAOS_UPDATERS if chaos else 3,
-                    update_max=N_CHAOS_UPDATERS if chaos else 3,
+                    # at the floor so dropped-out rounds close degraded;
+                    # two-tier soak: the window is the full edge fan-in
+                    update_min=(
+                        two_tier_updaters
+                        if args.edges
+                        else (N_CHAOS_UPDATERS if chaos else 3)
+                    ),
+                    update_max=(
+                        two_tier_updaters
+                        if args.edges
+                        else (N_CHAOS_UPDATERS if chaos else 3)
+                    ),
                     update_quorum_line="quorum = 3" if chaos else "",
                     # stragglers delay 0.3s: inside the grace, so they count
                     stall_grace=1.0,
+                    edge_enabled_line="[edge]\nenabled = true" if args.edges else "",
                 )
             )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -369,6 +524,7 @@ def main() -> None:
                 env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
         coord_log_path = os.path.join(tmp, "coordinator.log")
         coord_log = open(coord_log_path, "w")
+        edge_procs, edge_ports, edge_logs = [], [], []
         proc = subprocess.Popen(
             [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", cfg_path],
             env=env,
@@ -391,6 +547,43 @@ def main() -> None:
                     time.sleep(0.25)
             else:
                 raise RuntimeError("coordinator did not start listening in 60s")
+            if args.edges:
+                for i in range(args.edges):
+                    edge_port = args.port + 1 + i
+                    edge_cfg = os.path.join(tmp, f"edge{i}.toml")
+                    with open(edge_cfg, "w") as f:
+                        f.write(
+                            EDGE_CONFIG.format(
+                                port=edge_port,
+                                upstream_port=args.port,
+                                edge_id=f"edge-{i}",
+                                max_members=args.edge_updaters,
+                            )
+                        )
+                    edge_log = open(os.path.join(tmp, f"edge{i}.log"), "w")
+                    edge_logs.append(edge_log)
+                    edge_procs.append(
+                        subprocess.Popen(
+                            [sys.executable, "-m", "xaynet_tpu.edge.runner",
+                             "-c", edge_cfg],
+                            env=env,
+                            stdout=edge_log,
+                            stderr=subprocess.STDOUT,
+                        )
+                    )
+                    edge_ports.append(edge_port)
+                deadline = time.time() + 60
+                pending_ports = list(edge_ports)
+                while pending_ports and time.time() < deadline:
+                    try:
+                        with socket.create_connection(
+                            ("127.0.0.1", pending_ports[0]), timeout=1
+                        ):
+                            pending_ports.pop(0)
+                    except OSError:
+                        time.sleep(0.25)
+                if pending_ports:
+                    raise RuntimeError("edge processes did not start listening in 60s")
             rss_start = _rss_kb(proc.pid)
             # warmup block first: the first rounds pay one-time costs (JIT
             # compiles, XLA buffer pools, import side-effects) that are not
@@ -399,6 +592,11 @@ def main() -> None:
             warmup_rounds = min(20, max(1, args.rounds // 10))
 
             def run_block(n_rounds: int) -> dict:
+                if args.edges:
+                    return run_two_tier_soak_sync(
+                        args.port, edge_ports, n_rounds, args.model_len,
+                        two_tier_updaters,
+                    )
                 if chaos:
                     return run_chaos_soak_sync(
                         args.port, n_rounds, args.model_len, dropout, stragglers
@@ -431,6 +629,7 @@ def main() -> None:
                     ),
                     "kernel_requested": args.device_kernel,
                     "kernel_resolved": resolved,
+                    "edges": args.edges,
                     "fault_plan": fault_plan,
                     "dropout": dropout if chaos else None,
                     "stragglers": stragglers if chaos else None,
@@ -438,13 +637,23 @@ def main() -> None:
             )
             print(json.dumps(result))
         finally:
+            for ep in edge_procs:
+                ep.terminate()
             proc.terminate()
+            for ep in edge_procs:
+                try:
+                    ep.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    ep.kill()
+                    ep.wait(timeout=5)
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5)
             coord_log.close()
+            for el in edge_logs:
+                el.close()
 
 
 if __name__ == "__main__":

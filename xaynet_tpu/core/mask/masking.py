@@ -255,6 +255,25 @@ class Aggregation:
             )[0]
         self.nb_models += k
 
+    def aggregate_partial(self, obj: MaskObject, nb_models: int) -> None:
+        """Fold a pre-aggregated PARTIAL — the modular sum of ``nb_models``
+        already-masked updates — as one addition.
+
+        Masked aggregation is modular addition (associative and
+        commutative), so an edge-side partial folded here is byte-identical
+        to folding its member updates individually; only the model count
+        must advance by the partial's member count instead of one.
+        """
+        if nb_models < 1:
+            raise AggregationError("EmptyPartial")
+        remaining = min(
+            self.object.vect.config.max_nb_models, self.object.unit.config.max_nb_models
+        ) - self.nb_models
+        if nb_models > remaining:
+            raise AggregationError("TooManyModels")
+        self.aggregate(obj)
+        self.nb_models += nb_models - 1
+
     # --- unmasking (reference: masking.rs:190-231) ------------------------
 
     def _unmasked_limbs(self, mask_obj: MaskObject) -> tuple[np.ndarray, int]:

@@ -1,0 +1,18 @@
+"""Hierarchical edge pre-aggregation tier (docs/DESIGN.md §11).
+
+Edge aggregators admit and decrypt/verify participant uploads near the
+participants, fold accepted masked updates into a partial masked aggregate
+(modular addition — byte-identical to folding centrally), and ship ONE
+``PartialAggregate`` envelope upstream per linger window. The coordinator
+ingress shrinks by the edge batch factor — the structural unlock for
+million-participant rounds (ROADMAP item 2, NET-SA in PAPERS.md).
+"""
+
+from .aggregator import EdgeAdmitError as EdgeAdmitError
+from .aggregator import EdgeAggregator as EdgeAggregator
+from .api import EdgeCoordinatorApi as EdgeCoordinatorApi
+from .envelope import EnvelopeError as EnvelopeError
+from .envelope import PartialAggregateEnvelope as PartialAggregateEnvelope
+from .service import EdgeService as EdgeService
+from .upstream import ResilientUpstream as ResilientUpstream
+from .upstream import UpstreamClient as UpstreamClient

@@ -235,4 +235,7 @@ class IngestPipeline:
             "capacity": self.intake.capacity,
             "shards": len(self.intake.shards),
             "running": self.running,
+            # updates buffered toward the next coalesced envelope (operators
+            # watching an edge's backlog need the pre-seal depth too)
+            "coalescer_pending": self.coalescer.pending if self.coalescer else 0,
         }

@@ -128,7 +128,14 @@ class HttpClient(XaynetClient):
     does for URL arguments).
     """
 
-    def __init__(self, base_url: str, timeout: float = 30.0, tls_context=None):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        tls_context=None,
+        keep_alive: bool = True,
+        max_idle: int = 4,
+    ):
         self.tls = tls_context
         if base_url.startswith("https://"):
             base_url = base_url[len("https://") :]
@@ -141,16 +148,26 @@ class HttpClient(XaynetClient):
         self.host, _, port = base_url.partition(":")
         self.port = int(port or (443 if self.tls is not None else 80))
         self.timeout = timeout
+        # transport keep-alive: reuse one connection per host instead of
+        # re-handshaking per request (ROADMAP item 5's transport tax). The
+        # idle pool holds a handful of connections so concurrent callers
+        # sharing this client each reuse their own instead of serializing;
+        # ``keep_alive=False`` restores the historical one-shot behavior.
+        self.keep_alive = keep_alive
+        self.max_idle = max(1, max_idle)
+        self._idle: list[tuple] = []  # (reader, writer, owning loop)
+        self.connections_opened = 0  # reuse observability (tests/metrics)
 
-    async def _request(
-        self, method: str, path: str, body: bytes | None = None
-    ) -> tuple[int, dict, bytes]:
-        """One request; returns (status, lowercased headers, payload).
+    def close(self) -> None:
+        """Drop every idle connection (best-effort; safe cross-loop)."""
+        idle, self._idle = self._idle, []
+        for _, writer, _ in idle:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
-        Connection-level faults (refused, reset, timed out, truncated)
-        surface as ``ClientTransientError`` — the transport layer cannot
-        produce a permanent verdict, only a status line can.
-        """
+    async def _connect(self):
         try:
             reader, writer = await asyncio.wait_for(
                 # the SDK's one raw socket: this IS the wrapped transport
@@ -160,37 +177,115 @@ class HttpClient(XaynetClient):
                 self.timeout,
             )
         except (OSError, asyncio.TimeoutError) as err:
-            raise ClientTransientError(
-                f"{method} {path}: connect failed: {err}"
-            ) from err
-        try:
-            return await self._exchange(reader, writer, method, path, body)
-        except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError,
-                ValueError, IndexError) as err:
-            # ValueError/IndexError: garbled status line from a dying peer
-            raise ClientTransientError(f"{method} {path}: {err}") from err
-        finally:
-            writer.close()
+            raise ClientTransientError(f"connect failed: {err}") from err
+        self.connections_opened += 1
+        return reader, writer
+
+    def _checkout(self):
+        """Pop an idle connection usable on the CURRENT loop (connections
+        are loop-bound; callers like the soak driver run one ``asyncio.run``
+        per request, so a cached stream from a dead loop must be skipped)."""
+        loop = asyncio.get_running_loop()
+        while self._idle:
+            reader, writer, owner = self._idle.pop()
+            if owner is loop and not writer.is_closing():
+                return reader, writer
             try:
-                await writer.wait_closed()
+                writer.close()
             except Exception:
                 pass
+        return None
+
+    def _checkin(self, reader, writer, reusable: bool) -> None:
+        if (
+            self.keep_alive
+            and reusable
+            and len(self._idle) < self.max_idle
+            and not writer.is_closing()
+        ):
+            self._idle.append((reader, writer, asyncio.get_running_loop()))
+            return
+        writer.close()
+
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        body: bytes | None = None,
+        headers: Optional[dict] = None,
+    ) -> tuple[int, dict, bytes]:
+        """One request; returns (status, lowercased headers, payload).
+
+        Connection-level faults (refused, reset, timed out, truncated)
+        surface as ``ClientTransientError`` — the transport layer cannot
+        produce a permanent verdict, only a status line can. A REUSED
+        connection that dies before yielding any response byte is the
+        normal stale-keep-alive race (the server idled it out between our
+        requests): retried once on a fresh connection before the error
+        surfaces. ONLY that shape retries — once a response byte arrived
+        (the request was definitely processed) or on a timeout (the peer
+        may still be processing), a silent re-send could duplicate a
+        non-idempotent POST; those surface to the caller's retry policy,
+        which understands protocol-level idempotence.
+        """
+        reused = self._checkout() if self.keep_alive else None
+        for attempt in ("reused", "fresh"):
+            if reused is not None:
+                reader, writer = reused
+            else:
+                reader, writer = await self._connect()
+            response_begun: list = []
+            try:
+                status, resp_headers, payload = await self._exchange(
+                    reader, writer, method, path, body, headers, response_begun
+                )
+            except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    ValueError, IndexError) as err:
+                # ValueError/IndexError: garbled status line from a dying peer
+                writer.close()
+                if (
+                    reused is not None
+                    and attempt == "reused"
+                    and not response_begun
+                    and not isinstance(err, (asyncio.TimeoutError, TimeoutError))
+                ):
+                    reused = None  # stale pooled connection: one fresh retry
+                    continue
+                raise ClientTransientError(f"{method} {path}: {err}") from err
+            except BaseException:
+                writer.close()
+                raise
+            self._checkin(
+                reader,
+                writer,
+                resp_headers.get("connection", "keep-alive").lower() != "close",
+            )
+            return status, resp_headers, payload
+        raise AssertionError("unreachable")  # pragma: no cover
 
     async def _exchange(
-        self, reader, writer, method: str, path: str, body: bytes | None
+        self, reader, writer, method: str, path: str, body: bytes | None,
+        extra_headers: Optional[dict] = None, response_begun: Optional[list] = None,
     ) -> tuple[int, dict, bytes]:
         # self.timeout bounds each individual read as an IDLE timeout, not
         # the whole exchange: a peer that stalls mid-response fails fast
         # (transient, the wrapper retries), while a large model download
         # that keeps making progress on a slow link is never cut off
+        extra = "".join(
+            f"{name}: {value}\r\n" for name, value in (extra_headers or {}).items()
+        )
+        connection = "keep-alive" if self.keep_alive else "close"
         head = (
             f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
             f"Content-Length: {len(body) if body else 0}\r\n"
-            "Connection: close\r\n\r\n"
+            f"{extra}"
+            f"Connection: {connection}\r\n\r\n"
         ).encode()
         writer.write(head + (body or b""))
         await asyncio.wait_for(writer.drain(), self.timeout)
         status_line = await asyncio.wait_for(reader.readline(), self.timeout)
+        if status_line and response_begun is not None:
+            response_begun.append(True)  # any byte back: request was processed
         status = int(status_line.split()[1])
         headers: dict[str, str] = {}
         content_length = 0
@@ -300,6 +395,12 @@ class ResilientClient(XaynetClient):
     def __init__(self, inner: XaynetClient, policy: Optional[RetryPolicy] = None):
         self.inner = inner
         self.policy = policy if policy is not None else default_client_policy()
+
+    def close(self) -> None:
+        """Release the wrapped transport's pooled connections (if any)."""
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
 
     async def _call(self, endpoint: str, fn, *args):
         # the shared policy loop carries the per-site retry/giveup/backoff

@@ -86,6 +86,7 @@ class Participant:
             client = HttpClient(client)
             if retries:
                 client = ResilientClient(client)
+        self._client = client
         self._loop = asyncio.new_event_loop()
         self._events = _Events()
         self._store = _SettableModelStore()
@@ -153,7 +154,18 @@ class Participant:
         return state
 
     def close(self) -> None:
-        """Releases the private event loop (idempotent)."""
+        """Releases the private event loop and any pooled transport
+        connections (idempotent)."""
+        # unwrap retry decorators down to the transport (keep-alive pool)
+        client = getattr(self, "_client", None)
+        while client is not None:
+            if hasattr(client, "close"):
+                try:
+                    client.close()
+                except Exception:
+                    pass
+                break
+            client = getattr(client, "inner", None)
         if not self._loop.is_closed():
             self._loop.close()
 

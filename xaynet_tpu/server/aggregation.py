@@ -172,6 +172,63 @@ class StagedAggregator:
                 else:
                     vect._staged_planar = planar
 
+    def validate_partial(self, obj: MaskObject, members: int) -> None:
+        """Protocol validation for an edge PARTIAL aggregate of ``members``
+        updates: same config/length checks as a single update, but the
+        model-count headroom must fit the whole member count (the envelope
+        is atomic — it folds entirely or not at all)."""
+        if members < 1:
+            raise AggregationError("EmptyPartial")
+        if self.config.vect != obj.vect.config:
+            raise AggregationError("ModelMismatch")
+        if self.config.unit != obj.unit.config:
+            raise AggregationError("ScalarMismatch")
+        if self.object_size != len(obj.vect):
+            raise AggregationError("ModelMismatch")
+        if self.nb_models + members > self.config.vect.max_nb_models:
+            raise AggregationError("TooManyModels")
+        if self.nb_models + members > self.config.unit.max_nb_models:
+            raise AggregationError("TooManyScalars")
+        if not obj.is_valid():
+            raise AggregationError("InvalidObject")
+
+    def fold_partial(self, obj: MaskObject, members: int) -> None:
+        """Fold a pre-aggregated partial of ``members`` updates as ONE
+        ``masked_add`` dispatch and advance ``nb_models`` by ``members``.
+
+        Ordering: any singly-staged updates flush first, so the aggregate
+        stays the plain modular sum of everything accepted so far (order
+        never changes the result — this just keeps the accounting simple).
+        """
+        if members < 1:
+            raise AggregationError("EmptyPartial")
+        if self._device is not None:
+            # drain() is the device sync point: with nothing in flight the
+            # model-count adjustment below cannot race the fold worker
+            self.drain()
+            from ..ops import limbs as limb_ops
+            from ..ops.fold_jax import wire_to_planar
+
+            planar = wire_to_planar(np.asarray(obj.vect.data))
+            padded = self._device.padded_length
+            if planar.shape[1] != padded:
+                planar = np.pad(planar, ((0, 0), (0, padded - planar.shape[1])))
+            self._stream.submit_host_planar_rows([planar])
+            self._stream.drain()
+            # the partial counts as `members` models, not the one row folded
+            self._device.nb_models += members - 1
+            order_limbs = limb_ops.order_limbs_for(self.config.unit.order)
+            self._unit_acc = limb_ops.mod_add(
+                self._unit_acc[None, :], np.asarray(obj.unit.data)[None, :], order_limbs
+            )[0]
+        else:
+            self.flush()
+            profiling.timed_kernel(
+                "masked_add",
+                self.object_size,
+                lambda: self._host.aggregate_partial(obj, members),
+            )
+
     @property
     def pending(self) -> int:
         """Updates staged but not yet folded."""

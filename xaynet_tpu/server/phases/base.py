@@ -29,7 +29,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time as time_mod
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Optional
 
 from ...storage.traits import Store
@@ -39,6 +39,8 @@ from ..events import EventPublisher, PhaseName
 from ..requests import (
     ChannelClosed,
     CoalescedUpdates,
+    EnvelopeReplay,
+    PartialAggregate,
     RequestError,
     RequestReceiver,
     StateMachineRequest,
@@ -114,6 +116,11 @@ class Shared:
     # adaptive count-window controller ([liveness] adaptive = true); phases
     # report window outcomes here, Unmask/Failure report round outcomes
     round_ctl: Optional[object] = None
+    # per-edge partial-aggregate watermarks for the CURRENT round (reset by
+    # Idle): edge_id -> highest window_seq folded. A redelivered envelope
+    # (edge retry after a lost acknowledgement) is rejected as stale
+    # instead of folded twice (docs/DESIGN.md §11).
+    edge_watermarks: dict = field(default_factory=dict)
 
     def set_round_id(self, round_id: int) -> None:
         self.state.round_id = round_id
@@ -177,6 +184,17 @@ class PhaseState:
     async def handle_request(self, req: StateMachineRequest) -> None:
         """Phase-specific request handling; raises ``RequestError`` to reject."""
         raise RequestError(RequestError.Kind.MESSAGE_REJECTED, "phase accepts no requests")
+
+    async def handle_partial(self, req: PartialAggregate, remaining: int) -> None:
+        """Phase-specific partial-aggregate handling (edge tier); raises
+        ``RequestError`` to reject the WHOLE envelope — partials are atomic
+        and only the update phase accepts them. ``remaining`` is the count
+        window's free capacity: the overshoot check lives in the handler,
+        AFTER the watermark replay check, so a redelivered already-folded
+        envelope is still acked idempotently at a nearly-closed window."""
+        raise RequestError(
+            RequestError.Kind.MESSAGE_REJECTED, "phase accepts no partial aggregates"
+        )
 
     async def coalesced_batch_start(self, members) -> None:
         """Hook: a coalesced micro-batch is about to be processed
@@ -396,6 +414,9 @@ class PhaseState:
                 raise
             self._respond(env, None)
             return
+        if isinstance(env.request, PartialAggregate):
+            await self._process_partial(env, counter)
+            return
         if counter.has_overmuch:
             counter.discarded += 1
             if self.shared.metrics is not None:
@@ -429,6 +450,62 @@ class PhaseState:
         self._record_handled(t0)
         if self.shared.metrics is not None:
             self.shared.metrics.message_accepted(self.shared.round_id, self.NAME.value)
+        self._respond(env, None)
+
+    async def _process_partial(self, env, counter: _Counter) -> None:
+        """One edge envelope, accepted WHOLE or rejected WHOLE.
+
+        The window accounting treats the envelope as its member count
+        (count.min/max/quorum are per UPDATE, not per envelope): an
+        envelope that would overshoot ``count.max`` is discarded atomically
+        — never split across the boundary — and an accepted one advances
+        the counter (and the stall clock) by every member it carried. The
+        overshoot check itself lives in the handler so the watermark can
+        ack a replayed envelope idempotently even at a nearly-closed
+        window (its members already count).
+        """
+        k = len(env.request)
+        t0 = time_mod.monotonic()
+        try:
+            with tracing.use_request_id(env.request_id), tracing.span(
+                "handle_partial", phase=self.NAME.value
+            ):
+                await self.handle_partial(
+                    env.request, counter.max - counter.accepted
+                )
+        except EnvelopeReplay:
+            # already folded (the edge retried after a lost ack): success,
+            # but the window counter must NOT advance a second time
+            self._record_handled(t0)
+            self._respond(env, None)
+            return
+        except RequestError as err:
+            self._record_handled(t0)
+            if err.kind is RequestError.Kind.MESSAGE_DISCARDED:
+                counter.discarded += 1
+                if self.shared.metrics is not None:
+                    self.shared.metrics.message_discarded(
+                        self.shared.round_id, self.NAME.value
+                    )
+            else:
+                counter.rejected += 1
+                if self.shared.metrics is not None:
+                    self.shared.metrics.message_rejected(
+                        self.shared.round_id, self.NAME.value
+                    )
+            self._respond(env, err)
+            return
+        except BaseException as err:
+            self._respond(
+                env,
+                RequestError(RequestError.Kind.INTERNAL, str(err) or type(err).__name__),
+            )
+            raise
+        counter.accepted += k
+        self._record_handled(t0)
+        if self.shared.metrics is not None:
+            for _ in range(k):  # dashboards count UPDATES, not envelopes
+                self.shared.metrics.message_accepted(self.shared.round_id, self.NAME.value)
         self._respond(env, None)
 
     def _record_handled(self, t0: float) -> None:

@@ -36,6 +36,9 @@ class Idle(PhaseState):
         # cannot outlive the dictionaries it is consistent with
         await self.shared.store.coordinator.delete_round_checkpoint()
         self.shared.resume_attempts = 0
+        # per-edge envelope watermarks are round-scoped: window sequences
+        # restart at 0 with every round's fresh window state on the edges
+        self.shared.edge_watermarks.clear()
         self._gen_round_keypair()
         self._update_round_probabilities()
         self._update_round_seed()

@@ -30,12 +30,32 @@ import logging
 
 from ...core.mask.masking import AggregationError
 from ...resilience.checkpoint import CheckpointManager, RoundCheckpoint
+from ...telemetry.registry import get_registry
 from ..aggregation import StagedAggregator
 from ..events import DictionaryUpdate, PhaseName
-from ..requests import RequestError, StateMachineRequest, UpdateRequest
+from ..requests import (
+    EnvelopeReplay,
+    PartialAggregate,
+    RequestError,
+    StateMachineRequest,
+    UpdateRequest,
+)
 from .base import PhaseError, PhaseState
 
 logger = logging.getLogger("xaynet.coordinator")
+
+_registry = get_registry()
+EDGE_ENVELOPES = _registry.counter(
+    "xaynet_edge_envelopes_total",
+    "Partial-aggregate envelopes handled by the update phase, by outcome "
+    "(accepted | replay = already-folded envelope acked idempotently | "
+    "stale = below the per-edge watermark | rejected).",
+    ("outcome",),
+)
+EDGE_MEMBERS_FOLDED = _registry.counter(
+    "xaynet_edge_members_folded_total",
+    "Masked updates folded via accepted partial-aggregate envelopes.",
+)
 
 
 class UpdatePhase(PhaseState):
@@ -154,6 +174,111 @@ class UpdatePhase(PhaseState):
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
             if self._ckpt is not None:
                 await self._ckpt.maybe_save()
+
+    async def handle_partial(self, req: PartialAggregate, remaining: int) -> None:
+        """Fold one edge envelope ATOMICALLY (docs/DESIGN.md §11).
+
+        Order of checks: round identity -> per-edge watermark (idempotent
+        replay ack / stale) -> count-window overshoot (atomic: the
+        envelope is never split across ``count.max``) -> envelope
+        self-consistency -> aggregation validation -> seed-dict
+        pre-validation against a snapshot (this phase is the round's only
+        seed-dict writer, so the snapshot cannot go stale under us) ->
+        commit (all seed dicts, then ONE ``masked_add`` dispatch advancing
+        ``nb_models`` by the member count). Every pre-commit failure
+        rejects the envelope whole; a storage failure mid-commit is an
+        infrastructure error that fails the round rather than leave seeds
+        without models (the nb_models == seed-watermark invariant).
+        """
+        shared = self.shared
+        if req.round_seed != shared.state.round_params.seed.as_bytes():
+            EDGE_ENVELOPES.labels(outcome="rejected").inc()
+            raise RequestError(
+                RequestError.Kind.MESSAGE_REJECTED, "envelope from another round"
+            )
+        last_seq = shared.edge_watermarks.get(req.edge_id)
+        if last_seq is not None and req.window_seq <= last_seq:
+            if req.window_seq == last_seq:
+                # the envelope AT the watermark: the edge retried after a
+                # lost acknowledgement, its content is already folded —
+                # ack idempotently so a successfully folded envelope is
+                # not misreported as rejected data loss on the edge
+                EDGE_ENVELOPES.labels(outcome="replay").inc()
+                logger.info(
+                    "round %d: idempotent ack for replayed edge envelope %s/%d",
+                    shared.round_id,
+                    req.edge_id,
+                    req.window_seq,
+                )
+                raise EnvelopeReplay()
+            EDGE_ENVELOPES.labels(outcome="stale").inc()
+            raise RequestError(
+                RequestError.Kind.MESSAGE_REJECTED,
+                f"stale envelope: edge {req.edge_id} window {req.window_seq} "
+                f"already folded (watermark {last_seq})",
+            )
+        if len(req) > remaining:
+            raise RequestError(
+                RequestError.Kind.MESSAGE_DISCARDED,
+                f"envelope of {len(req)} would exceed count.max",
+            )
+        if len(req.members) == 0 or len(set(req.members)) != len(req.members) or sorted(
+            req.seed_dicts
+        ) != sorted(req.members):
+            EDGE_ENVELOPES.labels(outcome="rejected").inc()
+            raise RequestError(
+                RequestError.Kind.MESSAGE_REJECTED, "inconsistent envelope accounting"
+            )
+        try:
+            # off the event loop: validity scans the full element vector
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.aggregator.validate_partial, req.masked, len(req)
+            )
+        except AggregationError as err:
+            EDGE_ENVELOPES.labels(outcome="rejected").inc()
+            raise RequestError(RequestError.Kind.MESSAGE_REJECTED, err.kind) from err
+        sum_dict = await shared.store.coordinator.sum_dict() or {}
+        seed_dict = await shared.store.coordinator.seed_dict() or {}
+        seeded = {pk for inner in seed_dict.values() for pk in inner}
+        for pk in req.members:
+            local = req.seed_dicts[pk]
+            if pk in seeded:
+                EDGE_ENVELOPES.labels(outcome="rejected").inc()
+                raise RequestError(
+                    RequestError.Kind.MESSAGE_REJECTED,
+                    "envelope member already seeded this round",
+                )
+            if len(local) != len(sum_dict) or any(spk not in sum_dict for spk in local):
+                EDGE_ENVELOPES.labels(outcome="rejected").inc()
+                raise RequestError(
+                    RequestError.Kind.MESSAGE_REJECTED,
+                    "envelope member seed dict does not match the sum dictionary",
+                )
+        # commit point: no rejection is possible past here
+        for pk in req.members:
+            store_err = await shared.store.coordinator.add_local_seed_dict(
+                pk, req.seed_dicts[pk]
+            )
+            if store_err is not None:  # pre-validated: only infrastructure left
+                raise PhaseError(
+                    "EdgeEnvelope",
+                    f"seed-dict commit failed mid-envelope: {store_err.value}",
+                )
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.aggregator.fold_partial, req.masked, len(req)
+        )
+        shared.edge_watermarks[req.edge_id] = req.window_seq
+        EDGE_ENVELOPES.labels(outcome="accepted").inc()
+        EDGE_MEMBERS_FOLDED.inc(len(req))
+        logger.info(
+            "round %d: folded edge envelope %s/%d (%d members, one dispatch)",
+            shared.round_id,
+            req.edge_id,
+            req.window_seq,
+            len(req),
+        )
+        if self._ckpt is not None:
+            await self._ckpt.maybe_save()
 
     async def coalesced_batch_start(self, members) -> None:
         """Batch prevalidation: when device wire ingest is on, the whole

@@ -97,7 +97,43 @@ class CoalescedUpdates:
                 fut.set_exception(error)
 
 
-StateMachineRequest = Union[SumRequest, UpdateRequest, Sum2Request, CoalescedUpdates]
+@dataclass
+class PartialAggregate:
+    """An edge aggregator's pre-folded window: the modular sum of
+    ``len(members)`` verified masked updates plus every member's seed dict,
+    travelling upstream as ONE envelope (``xaynet_tpu.edge``).
+
+    The envelope is ATOMIC: the update phase folds it as a single
+    ``masked_add`` dispatch and advances ``nb_models`` by the member count
+    with all seed dicts inserted, or rejects it whole — it is never split
+    across a window boundary or a degraded close. ``(edge_id, window_seq)``
+    is the per-edge watermark: a redelivered envelope (the edge retried
+    after a lost acknowledgement) is rejected as stale instead of folded
+    twice, which would break the nb_models == seed-watermark invariant.
+    """
+
+    edge_id: str
+    window_seq: int
+    round_seed: bytes
+    members: list[bytes]  # update participant pks, envelope order
+    seed_dicts: dict[bytes, LocalSeedDict]  # update pk -> local seed dict
+    masked: MaskObject  # modular sum of the members' masked models
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+
+class EnvelopeReplay(Exception):
+    """The EXACT envelope at the per-edge watermark was redelivered — the
+    edge retried after a lost acknowledgement, and everything it carries is
+    already folded. The phase answers SUCCESS without folding or advancing
+    the count window (idempotent ack), so the edge does not misreport a
+    folded envelope as rejected data loss."""
+
+
+StateMachineRequest = Union[
+    SumRequest, UpdateRequest, Sum2Request, CoalescedUpdates, PartialAggregate
+]
 
 
 def request_from_message(message: Message) -> StateMachineRequest:

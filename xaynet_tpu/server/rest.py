@@ -42,7 +42,8 @@ MAX_BODY = 1 << 32  # u32 length field ceiling, as in the reference
 # both tokens are attacker-controlled, and every distinct label value is a
 # permanent registry child
 _KNOWN_PATHS = {"/message", "/params", "/sums", "/seeds", "/model",
-                "/health", "/healthz", "/metrics"}
+                "/health", "/healthz", "/metrics",
+                "/edge/round", "/edge/envelope"}
 _KNOWN_METHODS = {"GET", "POST", "HEAD", "PUT", "DELETE", "OPTIONS", "PATCH"}
 
 
@@ -54,6 +55,8 @@ class RestServer:
         read_timeout: float = 120.0,
         registry: Optional[MetricsRegistry] = None,
         pipeline=None,
+        edge_api=None,
+        health_extra=None,
     ):
         # `registry` selects what GET /metrics renders. Hot-path modules
         # (request queue, message pipeline, kernel profiling, dispatcher)
@@ -63,9 +66,17 @@ class RestServer:
         # `pipeline` (ingest.IngestPipeline) switches POST /message to the
         # admission-controlled path: 429 + Retry-After under saturation, and
         # /healthz gains the intake section. None keeps the direct path.
+        # `edge_api` (edge.api.EdgeCoordinatorApi) serves the edge tier:
+        # GET /edge/round (round params + round keys for trusted edges) and
+        # POST /edge/envelope (partial-aggregate intake).
+        # `health_extra` is a zero-arg callable whose dict is merged into
+        # the /healthz payload (the edge runner reports its upstream link
+        # and envelope backlog through this hook).
         self.fetcher = fetcher
         self.handler = handler
         self.pipeline = pipeline
+        self.edge_api = edge_api
+        self.health_extra = health_extra
         self.read_timeout = read_timeout  # slow-client defense
         self.registry = registry if registry is not None else get_registry()
         self._started_at = time.monotonic()
@@ -119,7 +130,7 @@ class RestServer:
                     else b""
                 )
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-                status, payload, ctype, extra = await self._route(method, target, body)
+                status, payload, ctype, extra = await self._route(method, target, body, headers)
                 await self._respond(writer, status, payload, ctype, keep_alive, extra)
                 if not keep_alive:
                     break
@@ -132,10 +143,10 @@ class RestServer:
             except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
 
-    async def _route(self, method: str, target: str, body: bytes):
+    async def _route(self, method: str, target: str, body: bytes, headers=None):
         url = urlparse(target)
         # handlers return (status, payload, ctype) or + an extra-headers dict
-        result = await self._dispatch(method, url, body)
+        result = await self._dispatch(method, url, body, headers or {})
         status, payload, ctype = result[:3]
         extra = result[3] if len(result) > 3 else None
         self._http_requests.labels(
@@ -145,11 +156,13 @@ class RestServer:
         ).inc()
         return status, payload, ctype, extra
 
-    async def _dispatch(self, method: str, url, body: bytes):
+    async def _dispatch(self, method: str, url, body: bytes, headers=None):
         path = url.path
         try:
             if method == "POST" and path == "/message":
                 return await self._post_message(body)
+            if self.edge_api is not None and path.startswith("/edge/"):
+                return await self._edge_route(method, path, body, headers or {})
             if method == "GET" and path == "/params":
                 return 200, json.dumps(self.fetcher.round_params().to_dict()).encode(), "application/json"
             if method == "GET" and path == "/sums":
@@ -190,6 +203,11 @@ class RestServer:
                     payload["ingest"] = ingest
                     if ingest["saturated"]:
                         payload["status"] = "saturated"
+                if self.health_extra is not None:
+                    # role-specific sections (the edge runner reports its
+                    # upstream link + envelope backlog here); an extra
+                    # "status" key overrides ok (e.g. upstream unreachable)
+                    payload.update(self.health_extra())
                 return 200, json.dumps(payload).encode(), "application/json"
             if method == "GET" and path == "/health":
                 return 200, json.dumps(self._health_payload()).encode(), "application/json"
@@ -202,6 +220,39 @@ class RestServer:
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)
             return 500, str(err).encode(), "text/plain"
+
+    async def _edge_route(self, method: str, path: str, body: bytes, headers: dict):
+        """Edge-tier endpoints (served only with ``[edge] enabled = true``).
+
+        Status mapping for POST /edge/envelope keeps the edge's retry
+        decision unambiguous: 200 folded, 400 unparseable, 401 bad token,
+        409 protocol rejection (PERMANENT — drop the envelope, its members
+        fall back to uploading upstream directly), 503 the state machine
+        could not take the request right now (transient — retry).
+        """
+        from ..edge.envelope import EnvelopeError
+
+        if not self.edge_api.authorized(headers):
+            return 401, b"bad edge token", "text/plain"
+        if method == "GET" and path == "/edge/round":
+            return (
+                200,
+                json.dumps(self.edge_api.round_info()).encode(),
+                "application/json",
+            )
+        if method == "POST" and path == "/edge/envelope":
+            try:
+                accepted, detail = await self.edge_api.submit_envelope(body)
+            except EnvelopeError as err:
+                return 400, f"bad envelope: {err}".encode(), "text/plain"
+            except RequestError as err:
+                # INTERNAL: channel closed / machine mid-transition — the
+                # envelope was NOT folded; the edge retries it
+                return 503, str(err).encode(), "text/plain", {"Retry-After": "1"}
+            if not accepted:
+                return 409, (detail or "envelope rejected").encode(), "text/plain"
+            return 200, b"", "text/plain"
+        return 404, b"not found", "text/plain"
 
     def _health_payload(self) -> dict:
         """Shared by /health (legacy shape) and /healthz (superset)."""
@@ -246,10 +297,14 @@ class RestServer:
             200: "OK",
             204: "No Content",
             400: "Bad Request",
+            401: "Unauthorized",
             404: "Not Found",
+            409: "Conflict",
             413: "Payload Too Large",
             429: "Too Many Requests",
             500: "Internal Server Error",
+            502: "Bad Gateway",
+            503: "Service Unavailable",
         }.get(status, "")
         extra = "".join(
             f"{name}: {value}\r\n" for name, value in (extra_headers or {}).items()

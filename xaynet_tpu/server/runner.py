@@ -147,7 +147,15 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
 
         pipeline = IngestPipeline(handler, request_tx, events, settings.ingest)
         await pipeline.start()
-    rest = RestServer(fetcher, handler, registry=metrics.registry, pipeline=pipeline)
+    edge_api = None
+    if settings.edge.enabled:
+        from ..edge.api import EdgeCoordinatorApi
+
+        edge_api = EdgeCoordinatorApi(events, request_tx, token=settings.edge.token)
+        logger.info("edge tier enabled: serving /edge/round + /edge/envelope")
+    rest = RestServer(
+        fetcher, handler, registry=metrics.registry, pipeline=pipeline, edge_api=edge_api
+    )
     host, _, port = settings.api.bind_address.partition(":")
     tls = None
     if settings.api.tls_certificate:

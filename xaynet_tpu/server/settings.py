@@ -352,6 +352,49 @@ class LivenessSettings:
 
 
 @dataclass
+class EdgeSettings:
+    """Hierarchical edge pre-aggregation tier (``xaynet_tpu.edge``,
+    docs/DESIGN.md §11). One section, two roles:
+
+    - on the COORDINATOR, ``enabled = true`` serves the edge endpoints
+      (``GET /edge/round`` — round params + round keys for the trusted
+      edge tier, ``POST /edge/envelope`` — partial-aggregate intake);
+    - on an EDGE process (``python -m xaynet_tpu.edge.runner``),
+      ``upstream_url`` names the coordinator and the window knobs bound
+      how much an edge batches before shipping one envelope upstream.
+
+    ``token``, when set on both sides, must match (``X-Edge-Token``) —
+    edges sit inside the coordinator's trust domain (they decrypt
+    participant uploads with the round keys), so the endpoint is never
+    served to anonymous callers unless the operator explicitly leaves the
+    token empty on a closed network.
+    """
+
+    enabled: bool = False  # coordinator: serve /edge/round + /edge/envelope
+    token: str = ""  # shared secret for the edge endpoints ("" = open)
+    # edge-runner role
+    upstream_url: str = ""  # coordinator base URL (required for the runner)
+    edge_id: str = ""  # stable identity; "" derives host:port at startup
+    max_members: int = 64  # seal the window at this many folded updates
+    linger_s: float = 0.5  # seal a non-empty window after this much time
+    poll_s: float = 0.25  # upstream round/phase poll cadence
+
+    def validate(self) -> None:
+        if self.max_members < 1:
+            raise SettingsError("edge.max_members must be >= 1")
+        if self.linger_s < 0:
+            raise SettingsError("edge.linger_s must be >= 0")
+        if self.poll_s <= 0:
+            raise SettingsError("edge.poll_s must be > 0")
+
+    def validate_runner(self) -> None:
+        """Extra invariants for the edge runner entrypoint."""
+        self.validate()
+        if not self.upstream_url:
+            raise SettingsError("edge.upstream_url is required to run an edge")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -365,6 +408,7 @@ class Settings:
     ingest: IngestSettings = field(default_factory=IngestSettings)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings)
     liveness: LivenessSettings = field(default_factory=LivenessSettings)
+    edge: EdgeSettings = field(default_factory=EdgeSettings)
 
     def validate(self) -> None:
         self.pet.validate()
@@ -372,6 +416,7 @@ class Settings:
         self.ingest.validate()
         self.resilience.validate()
         self.liveness.validate()
+        self.edge.validate()
         if self.model.length < 1:
             raise SettingsError("model.length must be >= 1")
         if self.aggregation.batch_size < 1:
@@ -470,6 +515,8 @@ class Settings:
         res_base = base.resilience
         live_raw = raw.get("liveness", {})
         live_base = base.liveness
+        edge_raw = raw.get("edge", {})
+        edge_base = base.edge
 
         return cls(
             pet=PetSettings(
@@ -597,6 +644,15 @@ class Settings:
                     live_raw.get("time_max_ceil_s", live_base.time_max_ceil_s)
                 ),
                 window=int(live_raw.get("window", live_base.window)),
+            ),
+            edge=EdgeSettings(
+                enabled=bool(edge_raw.get("enabled", edge_base.enabled)),
+                token=str(edge_raw.get("token", edge_base.token)),
+                upstream_url=str(edge_raw.get("upstream_url", edge_base.upstream_url)),
+                edge_id=str(edge_raw.get("edge_id", edge_base.edge_id)),
+                max_members=int(edge_raw.get("max_members", edge_base.max_members)),
+                linger_s=float(edge_raw.get("linger_s", edge_base.linger_s)),
+                poll_s=float(edge_raw.get("poll_s", edge_base.poll_s)),
             ),
         )
 
