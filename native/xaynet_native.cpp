@@ -313,10 +313,13 @@ unsigned fold_threads() {
 // is embarrassingly parallel, so each thread owns a disjoint slice and no
 // merge step exists. Slices align to `align` (the fold's BLOCK size) and a
 // minimum slice keeps tiny folds single-threaded — thread spawn (~10us)
-// must never dominate a sub-millisecond fold.
+// must never dominate a sub-millisecond fold. `nt_override` > 0 pins the
+// worker count for this call (the per-shard thread budget of the sharded
+// streaming fold, where several kernel calls run concurrently and must
+// split the process-wide budget between them); 0 keeps fold_threads().
 template <typename F>
-void run_sliced(uint64_t n, uint64_t align, F&& fn) {
-  unsigned nt = fold_threads();
+void run_sliced(uint64_t n, uint64_t align, F&& fn, unsigned nt_override = 0) {
+  unsigned nt = nt_override ? (nt_override > 64 ? 64u : nt_override) : fold_threads();
   constexpr uint64_t MIN_SLICE = 1ull << 19;  // 512k elements (~4 MB of u64 sums)
   if (nt > 1) {
     const uint64_t cap = n / MIN_SLICE;
@@ -345,8 +348,16 @@ void run_sliced(uint64_t n, uint64_t align, F&& fn) {
 // little-endian u64 — contiguous 8-byte loads). The arithmetic —
 // double-reciprocal quotient with two rounding fixups, u64 wraparound on
 // pow2-boundary orders — lives exactly once here.
+// Strides (planar layout only; the wire layout is always natural):
+// `acc_stride` separates the limb planes of acc AND out (full-width buffers
+// pass their row length; a contiguous per-shard slice passes its width),
+// `stack_row_stride` separates limb planes within one staged update and
+// `stack_batch_stride` separates updates — so a fold can read one shard's
+// column slice [*, s0:s1) straight out of a full staged batch with zero
+// slice copies (the sharded streaming fold and the multi-device bench leg).
 template <bool Wire>
 void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
+                    uint64_t acc_stride, uint64_t stack_row_stride, uint64_t stack_batch_stride,
                     uint32_t n_limbs, uint64_t k, uint64_t order, uint64_t s0, uint64_t s1) {
   const bool pow2_boundary = order == 0;
   const bool two_limbs = n_limbs == 2;
@@ -377,12 +388,12 @@ void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, u
         // through one base pointer — measured ~1.5x on the 25M bench
         // shape (the prefetcher tracks two unit-stride streams)
         const uint32_t* alo = acc + s;
-        const uint32_t* ahi = acc + n + s;
+        const uint32_t* ahi = acc + acc_stride + s;
         for (uint64_t i = 0; i < bn; i++)
           sum[i] = (uint64_t)alo[i] | ((uint64_t)ahi[i] << 32);
         for (uint64_t kk = 0; kk < k; kk++) {
-          const uint32_t* lo = stack + kk * 2 * n + s;
-          const uint32_t* hi = lo + n;
+          const uint32_t* lo = stack + kk * stack_batch_stride + s;
+          const uint32_t* hi = lo + stack_row_stride;
           for (uint64_t i = 0; i < bn; i++)
             sum[i] += (uint64_t)lo[i] | ((uint64_t)hi[i] << 32);
         }
@@ -390,7 +401,7 @@ void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, u
     } else {
       for (uint64_t i = 0; i < bn; i++) sum[i] = acc[s + i];
       for (uint64_t kk = 0; kk < k; kk++) {
-        const uint32_t* up = stack + kk * n + s;
+        const uint32_t* up = stack + kk * (Wire ? n : stack_batch_stride) + s;
         for (uint64_t i = 0; i < bn; i++) sum[i] += up[i];
       }
     }
@@ -414,7 +425,7 @@ void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, u
         }
       } else {
         uint32_t* olo = out + s;
-        uint32_t* ohi = out + n + s;
+        uint32_t* ohi = out + acc_stride + s;
         for (uint64_t i = 0; i < bn; i++) {
           olo[i] = (uint32_t)sum[i];
           ohi[i] = (uint32_t)(sum[i] >> 32);
@@ -428,12 +439,18 @@ void fold_u64_slice(const uint32_t* acc, const uint32_t* stack, uint32_t* out, u
 
 template <bool Wire>
 void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, uint64_t n,
-                   uint32_t n_limbs, uint64_t k, const uint32_t* order_limbs) {
+                   uint64_t acc_stride, uint64_t stack_row_stride, uint64_t stack_batch_stride,
+                   uint32_t n_limbs, uint64_t k, const uint32_t* order_limbs,
+                   unsigned n_threads) {
   uint64_t order = 0;
   for (uint32_t j = 0; j < n_limbs; j++) order |= (uint64_t)order_limbs[j] << (32 * j);
-  run_sliced(n, 4096, [=](uint64_t s0, uint64_t s1) {
-    fold_u64_slice<Wire>(acc, stack, out, n, n_limbs, k, order, s0, s1);
-  });
+  run_sliced(
+      n, 4096,
+      [=](uint64_t s0, uint64_t s1) {
+        fold_u64_slice<Wire>(acc, stack, out, n, acc_stride, stack_row_stride,
+                             stack_batch_stride, n_limbs, k, order, s0, s1);
+      },
+      n_threads);
 }
 
 }  // namespace
@@ -455,8 +472,32 @@ void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, ui
 XN_EXPORT void xn_fold_planar_u64(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
                                   uint64_t n, uint32_t n_limbs, uint64_t k,
                                   const uint32_t* order_limbs) {
-  fold_u64_core<false>(acc, stack, out, n, n_limbs, k, order_limbs);
+  fold_u64_core<false>(acc, stack, out, n, n, n, (uint64_t)n_limbs * n, n_limbs, k,
+                       order_limbs, 0);
 }
+
+// Strided planar fold over a column slice: acc/out address `width` elements
+// per limb plane with `acc_stride` elements between planes (callers pass
+// pointers already offset to the slice start), while the staged batch is
+// read in place through `stack_row_stride`/`stack_batch_stride` — one
+// shard's contiguous plane slice folds straight out of the full staged
+// batch with zero slice copies. `n_threads` > 0 pins this call's worker
+// count (the per-shard budget when several shard folds run concurrently);
+// 0 keeps the process-wide fold_threads() default.
+XN_EXPORT void xn_fold_planar_u64_strided(const uint32_t* acc, const uint32_t* stack,
+                                          uint32_t* out, uint64_t width, uint64_t acc_stride,
+                                          uint64_t stack_row_stride,
+                                          uint64_t stack_batch_stride, uint32_t n_limbs,
+                                          uint64_t k, const uint32_t* order_limbs,
+                                          uint32_t n_threads) {
+  fold_u64_core<false>(acc, stack, out, width, acc_stride, stack_row_stride,
+                       stack_batch_stride, n_limbs, k, order_limbs, n_threads);
+}
+
+// The process-wide fold worker budget (XAYNET_NATIVE_THREADS or the 2x-cores
+// default), exported so the Python shard planner can split it into per-shard
+// budgets without duplicating the policy.
+XN_EXPORT uint32_t xn_fold_threads(void) { return fold_threads(); }
 
 // Wire-layout variant: acc/out uint32[n, L], stack uint32[K, n, L] — the
 // layout the coordinator's host aggregation path
@@ -464,7 +505,7 @@ XN_EXPORT void xn_fold_planar_u64(const uint32_t* acc, const uint32_t* stack, ui
 XN_EXPORT void xn_fold_wire_u64(const uint32_t* acc, const uint32_t* stack, uint32_t* out,
                                 uint64_t n, uint32_t n_limbs, uint64_t k,
                                 const uint32_t* order_limbs) {
-  fold_u64_core<true>(acc, stack, out, n, n_limbs, k, order_limbs);
+  fold_u64_core<true>(acc, stack, out, n, n, n, n, n_limbs, k, order_limbs, 0);
 }
 
 // (a - b) mod order, elementwise (same layout/conventions as xn_mod_add).
@@ -685,7 +726,7 @@ XN_EXPORT uint64_t xn_count_ge(const uint32_t* limbs, uint64_t count, uint32_t n
   return bad;
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 5; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 6; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout

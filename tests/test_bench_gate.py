@@ -103,3 +103,74 @@ def test_gate_with_nothing_to_compare_is_a_soft_pass(tmp_path):
 def test_gate_runs_clean_on_the_real_history():
     """The repo's own BENCH_HISTORY must parse and currently pass."""
     assert _run(str(REPO / "BENCH_HISTORY.jsonl")) == 0
+
+
+# --- kernel/thread-config series identity ----------------------------------
+
+
+def _cfg_rec(ts, value, metric=HEADLINE, **config):
+    parsed = {"metric": metric, "value": value, "unit": "updates/s"}
+    parsed.update(config)
+    return {"ts": ts, "parsed": parsed}
+
+
+def test_gate_treats_thread_config_change_as_new_series(tmp_path, capsys):
+    """BENCH_r05's 29.46 vs r03's ~49 on the same code path came from an
+    implicit thread-default shift: with the config recorded, the gate must
+    start a NEW series instead of flagging a 40% regression."""
+    path = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 49.0, kernel="native-u64", native_threads=16),
+            _cfg_rec(2, 48.2, kernel="native-u64", native_threads=16),
+            _cfg_rec(3, 29.5, kernel="native-u64", native_threads=4),
+        ],
+    )
+    assert _run(path) == 0
+    assert "NEW series" in capsys.readouterr().err
+
+
+def test_gate_kernel_change_is_a_new_series(tmp_path):
+    path = _write(
+        tmp_path,
+        [_cfg_rec(1, 49.0, kernel="native-u64"), _cfg_rec(2, 20.0, kernel="xla")],
+    )
+    assert _run(path) == 0
+
+
+def test_gate_still_fails_within_one_config_series(tmp_path, capsys):
+    path = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 49.0, kernel="native-u64", native_threads=16),
+            _cfg_rec(2, 30.0, kernel="native-u64", native_threads=16),
+        ],
+    )
+    assert _run(path) == 1
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["result"] == "REGRESSION"
+    assert "native_threads=16" in verdict["config"]
+
+
+def test_gate_mesh8_series_is_gated_independently(tmp_path, capsys):
+    """The mesh=8 shard-parallel headline is its own series: its first
+    round soft-passes against a taller single-device history, and a later
+    mesh=8 regression fails against the mesh=8 best only."""
+    mesh_metric = HEADLINE + ", mesh=8 CPU fallback (PET update phase)"
+    base = [
+        _cfg_rec(1, 49.0, kernel="native-u64", native_threads=16),
+        _cfg_rec(2, 48.0, kernel="native-u64", native_threads=16),
+    ]
+    first_mesh = _cfg_rec(
+        3, 34.0, metric=mesh_metric, kernel="native-u64", native_threads=4,
+        shard_threads=4, mesh=8,
+    )
+    path = _write(tmp_path, base + [first_mesh])
+    assert _run(path) == 0  # first mesh=8 round: nothing to compare
+
+    regressed = _cfg_rec(
+        4, 20.0, metric=mesh_metric, kernel="native-u64", native_threads=4,
+        shard_threads=4, mesh=8,
+    )
+    path = _write(tmp_path, base + [first_mesh, regressed])
+    assert _run(path) == 1  # 20 < 34 * 0.9, within the mesh=8 series

@@ -258,3 +258,58 @@ def test_edge_tree_is_clean_under_fold_rule():
     for path in sorted(target.rglob("*.py")):
         problems.extend(xn_lint.check_file(path))
     assert problems == []
+
+
+# --- fold-worker blocking-sync rule ----------------------------------------
+
+
+def test_blocking_sync_rejected_in_parallel_worker_paths(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "import jax\n"
+        "def _process(item):\n"
+        "    return np.asarray(item)\n"
+        "def submit_batch(stack):\n"
+        "    jax.block_until_ready(stack)\n"
+        "def _fold_payload(x):\n"
+        "    x.block_until_ready()\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/parallel/foo.py", source)
+    assert sum("blocking host sync" in p for p in problems) == 3
+
+
+def test_blocking_sync_drain_and_allowlist_exempt(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "import jax\n"
+        "def drain(pending):\n"
+        "    return [np.asarray(t) for t in pending]\n"
+        "def _drain_sharded(acc):\n"
+        "    jax.block_until_ready(acc)\n"
+        "def _fold_shard_item(payload):\n"
+        "    piece = np.asarray(payload)  # host-kernel view  # lint: sync-ok\n"
+        "    return piece\n"
+        "def helper(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/parallel/foo.py", source)
+    assert not any("blocking host sync" in p for p in problems)
+
+
+def test_sync_rule_scoped_to_parallel_tree(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "def _process(item):\n"
+        "    return np.asarray(item)\n"
+    )
+    for rel in ("xaynet_tpu/server/foo.py", "xaynet_tpu/ops/foo.py", "tools/foo.py"):
+        problems = _check(tmp_path, monkeypatch, rel, source)
+        assert not any("blocking host sync" in p for p in problems), rel
+
+
+def test_parallel_tree_is_clean_under_sync_rule():
+    target = REPO / "xaynet_tpu" / "parallel"
+    problems = []
+    for path in sorted(target.rglob("*.py")):
+        problems.extend(xn_lint.check_file(path))
+    assert problems == []

@@ -1,0 +1,453 @@
+"""Shard-parallel streaming fold (parallel.shards + streaming shard mode).
+
+The property everything rests on: the shard-parallel pipeline — one fold
+worker per mesh device, per-shard staging rings, donated per-shard
+accumulators, drain() as the cross-shard barrier — is **byte-identical to
+the sequential single-device path** across kernels (xla, native-u64, auto)
+× mesh sizes (1, 2, 8) × planar/wire submit paths, including
+dispatch-ahead out-of-order schedules, and its per-shard degradation
+ladder (fold failure → per-shard sync retry → pipeline-wide sync mode →
+sticky poison) keeps the shards consistent: a batch commits only when
+every shard folded it.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from xaynet_tpu.core.mask import (
+    Aggregation,
+    BoundType,
+    DataType,
+    GroupType,
+    Masker,
+    MaskConfig,
+    ModelType,
+    Scalar,
+)
+from xaynet_tpu.core.mask.serialization import serialize_mask_vect, vect_element_block
+from xaynet_tpu.ops import limbs as host_limbs
+from xaynet_tpu.parallel.aggregator import ShardedAggregator
+from xaynet_tpu.parallel.mesh import make_mesh, shard_slices
+from xaynet_tpu.parallel.shards import ShardPlan, shard_thread_budget
+from xaynet_tpu.parallel.streaming import (
+    SHARD_INFLIGHT,
+    SHARD_STAGING_DEPTH,
+    StreamingAggregator,
+    StreamingError,
+)
+
+CFG = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
+
+KERNELS = ("xla", "native-u64", "auto")
+MESH_SIZES = (1, 2, 8)
+
+
+def _mesh(n):
+    return make_mesh(jax.devices()[:n])
+
+
+def _updates(n, total, seed=0):
+    rng = np.random.default_rng(seed)
+    host = Aggregation(CFG.pair(), n)
+    stacks, raws = [], []
+    for _ in range(total):
+        w = rng.uniform(-1, 1, size=n).astype(np.float32)
+        _, masked = Masker(CFG.pair()).mask(Scalar(1, total), w)
+        host.aggregate(masked)
+        stacks.append(masked.vect.data)
+        raws.append(
+            np.frombuffer(
+                vect_element_block(serialize_mask_vect(masked.vect)), dtype=np.uint8
+            )
+        )
+    return stacks, raws, host
+
+
+def _sequential_oracle(n, stacks, bs):
+    seq = ShardedAggregator(CFG, n, mesh=_mesh(1), kernel="xla")
+    for i in range(0, len(stacks), bs):
+        seq.add_batch(np.stack(stacks[i : i + bs]))
+    return seq
+
+
+# --- the core property: kernels x mesh sizes x planar/wire ---------------
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("mesh_size", MESH_SIZES)
+def test_sharded_planar_byte_identical_to_sequential(kernel, mesh_size):
+    n, total, bs = 103, 13, 4  # n not divisible by 8: padding columns in play
+    stacks, _, host = _updates(n, total)
+    seq = _sequential_oracle(n, stacks, bs)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(mesh_size), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    assert stream._sharded == (mesh_size > 1)
+    for i in range(0, total, bs):
+        stream.submit_batch(np.stack(stacks[i : i + bs]))
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+    stream.close()
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("mesh_size", MESH_SIZES)
+def test_sharded_wire_byte_identical_with_deferred_acceptance(kernel, mesh_size):
+    """Wire path: the per-shard fold must preserve the psum-consistent
+    validity semantics (an update invalid anywhere is excluded everywhere),
+    the acceptance vectors stay deferred until drain, and the aggregate +
+    nb_models equal the sequential path."""
+    n, total, bs = 57, 11, 4
+    _, raws, _ = _updates(n, total, seed=3)
+    bad = raws[5].copy()
+    bad[: CFG.bytes_per_number] = 0xFF  # element >= order -> member rejected
+    wires = raws[:5] + [bad] + raws[6:]
+
+    seq = ShardedAggregator(CFG, n, mesh=_mesh(1), kernel="xla")
+    seq_oks = [
+        seq.add_wire_batch(np.stack(wires[i : i + bs])) for i in range(0, total, bs)
+    ]
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(mesh_size), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    tickets = [
+        stream.submit_wire_batch(np.stack(wires[i : i + bs]))
+        for i in range(0, total, bs)
+    ]
+    if mesh_size > 1:
+        # acceptance is deferred: no ticket resolves before the barrier
+        assert all(t.accepted is None for t in tickets)
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total - 1
+    got = np.concatenate([t.accepted for t in tickets])
+    assert np.array_equal(got, np.concatenate(seq_oks))
+    assert not got[5]
+    stream.close()
+
+
+@pytest.mark.parametrize("kernel", ("xla", "native-u64"))
+def test_sharded_mixed_paths_across_drain_cycles(kernel):
+    """Planar and wire batches interleaved over several drain cycles: the
+    plan decomposes/reassembles per cycle and the result stays pinned to
+    the sequential single-device fold."""
+    n, total, bs = 103, 12, 3
+    stacks, raws, _ = _updates(n, total, seed=11)
+
+    seq = ShardedAggregator(CFG, n, mesh=_mesh(1), kernel="xla")
+    seq.add_wire_batch(np.stack(raws[0:3]))
+    seq.add_batch(np.stack(stacks[3:6]))
+    seq.add_wire_batch(np.stack(raws[6:9]))
+    seq.add_batch(np.stack(stacks[9:12]))
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=2, dispatch_ahead=2, max_batch=bs)
+    stream.submit_wire_batch(np.stack(raws[0:3]))
+    stream.submit_batch(np.stack(stacks[3:6]))
+    stream.drain()  # cycle 1: reassemble
+    stream.submit_wire_batch(np.stack(raws[6:9]))  # cycle 2: re-decompose
+    stream.submit_batch(np.stack(stacks[9:12]))
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total
+    stream.close()
+
+
+def test_sharded_dispatch_ahead_out_of_order_stress():
+    """Producer runs several batches ahead of shard folds that complete
+    late with per-shard jitter (shard progress skew): every batch must
+    commit exactly once, the per-shard gauges must return to zero, and the
+    aggregate must stay byte-identical."""
+    n, total, bs = 64, 36, 3
+    stacks, _, host = _updates(n, total, seed=7)
+    seq = _sequential_oracle(n, stacks, bs)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=4, dispatch_ahead=3, max_batch=bs)
+
+    rng = np.random.default_rng(0)
+    jitters = {d: rng.uniform(0.0, 0.004, size=64) for d in range(8)}
+    counts = {d: 0 for d in range(8)}
+    real_fold = ShardPlan.fold_shard
+
+    def slow_fold(self, d, batch):
+        i = counts[d]
+        counts[d] += 1
+        time.sleep(float(jitters[d][i % 64]))
+        return real_fold(self, d, batch)
+
+    try:
+        ShardPlan.fold_shard = slow_fold
+        for i in range(0, total, bs):
+            stream.submit_batch(np.stack(stacks[i : i + bs]))
+        stream.drain()
+    finally:
+        ShardPlan.fold_shard = real_fold
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert np.array_equal(agg.snapshot(), host.object.vect.data)
+    assert agg.nb_models == total
+    for d in range(8):
+        assert SHARD_INFLIGHT.labels(shard=str(d)).value == 0
+        assert SHARD_STAGING_DEPTH.labels(shard=str(d)).value == 0
+    stream.close()
+
+
+# --- degradation ladder ----------------------------------------------------
+
+
+def test_shard_failure_degrades_then_completes_byte_identical():
+    """One shard's fold fails once (accumulator untouched): that shard
+    retries synchronously, the pipeline flips to the sync path, and the
+    round completes with the exact sequential aggregate."""
+    n, total, bs = 48, 12, 3
+    stacks, _, _ = _updates(n, total, seed=5)
+    seq = _sequential_oracle(n, stacks, bs)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    real_fold = ShardPlan.fold_shard
+    state = {"failed": False}
+
+    def flaky(self, d, batch):
+        if d == 3 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient shard fault")
+        return real_fold(self, d, batch)
+
+    try:
+        ShardPlan.fold_shard = flaky
+        for i in range(0, total, bs):
+            stream.submit_batch(np.stack(stacks[i : i + bs]))
+        stream.drain()
+    finally:
+        ShardPlan.fold_shard = real_fold
+
+    assert stream.degraded
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == total
+    stream.close()
+
+
+def test_shard_failure_twice_poisons_with_batch_diagnostics():
+    """The same shard failing on the retry too loses the batch: the
+    pipeline poisons permanently, every later submit AND drain keeps
+    raising with the poisoning batch index and root cause."""
+    n, bs = 48, 3
+    stacks, _, _ = _updates(n, 9, seed=6)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    real_fold = ShardPlan.fold_shard
+
+    def always_broken(self, d, batch):
+        if d == 5:
+            raise RuntimeError("shard 5 is on fire")
+        return real_fold(self, d, batch)
+
+    try:
+        ShardPlan.fold_shard = always_broken
+        stream.submit_batch(np.stack(stacks[0:3]))
+        with pytest.raises(StreamingError, match="batch 1.*shard 5 is on fire"):
+            stream.drain()
+    finally:
+        ShardPlan.fold_shard = real_fold
+    # sticky: healthy folds cannot resurrect a poisoned pipeline
+    with pytest.raises(StreamingError, match="poisoned"):
+        stream.submit_batch(np.stack(stacks[3:6]))
+    with pytest.raises(StreamingError, match="batch 1"):
+        stream.drain()
+    assert stream.in_flight_models == 0
+    stream.close()
+
+
+# --- sequential multi-device native fold ----------------------------------
+
+
+def test_sequential_multidevice_native_fold_and_unmask():
+    """add_batch with kernel=native-u64 on an 8-device mesh: the per-shard
+    strided host fold must equal the mesh XLA fold, and unmask_limbs must
+    handle the host-resident accumulator."""
+    n, total, bs = 103, 8, 4
+    stacks, _, _ = _updates(n, total, seed=9)
+    ref = _sequential_oracle(n, stacks, bs)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="native-u64")
+    for i in range(0, total, bs):
+        agg.add_batch(np.stack(stacks[i : i + bs]))
+    assert agg.kernel_used == "native-u64"
+    assert np.array_equal(agg.snapshot(), ref.snapshot())
+
+    mask = _updates(n, 1, seed=13)[0][0]
+    assert np.array_equal(agg.unmask_limbs(mask), ref.unmask_limbs(mask))
+
+
+# --- ShardPlan / slice-fold units ------------------------------------------
+
+
+def test_fold_planar_slice_host_matches_full_fold():
+    order = CFG.order
+    ol = host_limbs.order_limbs_for(order)
+    rng = np.random.default_rng(2)
+    k, L, n = 6, 2, 1024
+    stack = rng.integers(0, 2**32, size=(k, L, n), dtype=np.uint32)
+    stack[:, L - 1, :] &= np.uint32((1 << 13) - 1)
+    ref = host_limbs.fold_planar_batch_host(np.zeros((L, n), np.uint32), stack, ol)
+
+    # full-width buffers, strided per-slice folds
+    acc = np.zeros((L, n), np.uint32)
+    out = np.empty_like(acc)
+    for lo, hi in shard_slices(n, 8):
+        assert host_limbs.fold_planar_slice_host(acc, stack, out, lo, hi, ol, n_threads=1)
+    assert np.array_equal(out, ref)
+
+    # contiguous per-shard buffers (the streaming accumulators)
+    pieces = []
+    for lo, hi in shard_slices(n, 4):
+        a = np.zeros((L, hi - lo), np.uint32)
+        o = np.empty_like(a)
+        assert host_limbs.fold_planar_slice_host(
+            a, stack, o, lo, hi, ol, n_threads=2, acc_cols=hi - lo
+        )
+        pieces.append(o)
+    assert np.array_equal(np.concatenate(pieces, axis=1), ref)
+
+
+def test_shard_thread_budget_resolution(monkeypatch):
+    monkeypatch.delenv("XAYNET_NATIVE_SHARD_THREADS", raising=False)
+    assert shard_thread_budget(4, explicit=3) == 3
+    monkeypatch.setenv("XAYNET_NATIVE_SHARD_THREADS", "5")
+    assert shard_thread_budget(4) == 5
+    monkeypatch.setenv("XAYNET_NATIVE_SHARD_THREADS", "junk")
+    total = host_limbs.native_fold_threads()
+    assert shard_thread_budget(4) == max(1, total // 4)
+    monkeypatch.delenv("XAYNET_NATIVE_SHARD_THREADS", raising=False)
+    assert shard_thread_budget(10_000) == 1  # never below one thread
+
+
+def test_shard_plan_requires_resolved_kernel():
+    agg = ShardedAggregator(CFG, 64, mesh=_mesh(2), kernel="auto")
+    with pytest.raises(ValueError, match="resolved"):
+        ShardPlan(agg)
+
+
+def test_shard_plan_reassemble_roundtrip():
+    """decompose -> per-shard folds -> reassemble equals the sequential
+    fold, for both backends, starting from a non-zero accumulator."""
+    n, total, bs = 96, 4, 4
+    stacks, _, _ = _updates(n, total, seed=17)
+    base = _updates(n, 2, seed=18)[0]
+
+    for kernel in ("xla", "native-u64"):
+        ref = ShardedAggregator(CFG, n, mesh=_mesh(1), kernel="xla")
+        ref.add_batch(np.stack(base))
+        ref.add_batch(np.stack(stacks))
+
+        agg = ShardedAggregator(CFG, n, mesh=_mesh(4), kernel=kernel)
+        agg.add_batch(np.stack(base))  # resolves the kernel, non-zero acc
+        plan = ShardPlan(agg)
+        planar = np.zeros((total, agg.n_limbs, agg.padded_length), np.uint32)
+        from xaynet_tpu.ops.fold_jax import wire_to_planar
+
+        planar[:, :, :n] = wire_to_planar(np.stack(stacks))
+        if plan.native:
+            plan.fold_full(planar)
+        else:
+            for d, (lo, hi) in enumerate(plan.slices):
+                piece = jax.device_put(
+                    np.ascontiguousarray(planar[:, :, lo:hi]), plan.devices[d]
+                )
+                plan.fold_shard(d, piece)
+            plan.block_until_ready()
+        agg.acc = plan.reassemble()
+        plan.close()
+        assert np.array_equal(agg.snapshot(), ref.snapshot()), kernel
+
+
+# --- surfaces --------------------------------------------------------------
+
+
+def test_shard_parallel_settings_surface():
+    from xaynet_tpu.server.settings import SettingsError, Settings
+
+    s = Settings.default()
+    assert s.aggregation.shard_parallel is True
+    assert s.aggregation.shard_threads == 0
+    s.aggregation.shard_threads = -1
+    with pytest.raises(SettingsError, match="shard_threads"):
+        s.validate()
+
+
+def test_shard_parallel_opt_out_forces_single_worker():
+    n, total, bs = 64, 6, 3
+    stacks, _, _ = _updates(n, total, seed=21)
+    seq = _sequential_oracle(n, stacks, bs)
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(
+        agg, staging_buffers=2, dispatch_ahead=2, max_batch=bs, shard_parallel=False
+    )
+    assert not stream._sharded
+    for i in range(0, total, bs):
+        stream.submit_batch(np.stack(stacks[i : i + bs]))
+    stream.drain()
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    stream.close()
+
+
+def test_healthz_pipeline_section_reports_shards():
+    """The REST /healthz builder reads the streaming + per-shard gauges
+    straight from the telemetry registry (no jax import on that path)."""
+    n, bs = 64, 3
+    stacks, _, _ = _updates(n, 6, seed=23)
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=2, dispatch_ahead=2, max_batch=bs)
+    stream.submit_batch(np.stack(stacks[0:3]))
+    stream.drain()
+    stream.close()
+
+    from xaynet_tpu.server.rest import RestServer
+
+    rest = RestServer.__new__(RestServer)  # only _streaming_health is exercised
+    from xaynet_tpu.telemetry.registry import get_registry
+
+    rest.registry = get_registry()
+    section = rest._streaming_health()
+    assert section is not None
+    assert section["degraded"] in (False, True)
+    assert "shards" in section
+    for d in range(8):
+        shard = section["shards"][str(d)]
+        assert shard["staging_depth"] == 0
+        assert shard["inflight_folds"] == 0
+
+
+@pytest.mark.parametrize("kernel", ("xla", "native-u64"))
+def test_sharded_fold_planar_rows_now_device_resident(kernel):
+    """The server wire-ingest flush path: device-resident planars cached by
+    validate_wire_updates fold synchronously per shard (the stacked chunk
+    re-pinned to the batch sharding) and stay byte-identical."""
+    n, total = 96, 10
+    _, raws, _ = _updates(n, total, seed=29)
+
+    seq = ShardedAggregator(CFG, n, mesh=_mesh(1), kernel="xla")
+    seq.add_wire_batch(np.stack(raws))
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel=kernel)
+    stream = StreamingAggregator(agg, staging_buffers=2, dispatch_ahead=2, max_batch=4)
+    planars = agg.validate_wire_updates([np.asarray(r) for r in raws])
+    assert all(p is not None for p in planars)
+    stream.fold_planar_rows_now(planars)
+    stream.drain()
+
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    assert agg.nb_models == seq.nb_models == total
+    stream.close()

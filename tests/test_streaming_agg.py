@@ -39,8 +39,9 @@ from xaynet_tpu.parallel.streaming import (
 
 CFG = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6)
 
-# native-u64 requires a single-device mesh (the host kernel cannot shard);
-# the conftest forces 8 virtual CPU devices, so pin device 0 explicitly
+# these tests pin device 0 explicitly (the conftest forces 8 virtual CPU
+# devices) to exercise the SINGLE-WORKER pipeline; the shard-parallel
+# multi-device mode has its own suite in tests/test_shard_parallel.py
 KERNELS = ("xla", "native-u64", "auto")
 
 

@@ -33,20 +33,34 @@ HEADLINE_PREFIX = "masked-update aggregation throughput"
 HEADLINE_UNIT = "updates/s"
 
 
-def extract(record: dict) -> tuple[str, float, str] | None:
-    """(metric, value, unit) from one history record, wherever the writer
-    put it; None when the record carries no scalar metric."""
+def extract(record: dict) -> tuple[str, float, str, str] | None:
+    """(metric, value, unit, config) from one history record, wherever the
+    writer put it; None when the record carries no scalar metric.
+
+    ``config`` is the measurement-configuration fingerprint: the fold
+    kernel plus the pinned thread counts (and mesh size) when the writer
+    recorded them. A kernel or thread-config change is a DIFFERENT
+    experiment — BENCH_r05 re-measured 29.46 updates/s where r03 recorded
+    ~49 on the same code purely from an implicit thread-default shift — so
+    the gate compares only within one exact (metric, config) series
+    instead of flagging the config change as a regression."""
     for node in (record, record.get("parsed") or {}):
         metric = node.get("metric")
         value = node.get("value")
         unit = node.get("unit")
         if metric and isinstance(value, (int, float)):
-            return str(metric), float(value), str(unit or "")
+            parts = []
+            for field in ("kernel", "native_threads", "shard_threads", "mesh"):
+                if node.get(field) is not None:
+                    parts.append(f"{field}={node[field]}")
+            return str(metric), float(value), str(unit or ""), ",".join(parts)
     return None
 
 
-def load_series(path: str, metric_prefix: str, unit: str) -> list[tuple[float, str, float]]:
-    """Chronological (ts, metric, value) for the headline series."""
+def load_series(
+    path: str, metric_prefix: str, unit: str
+) -> list[tuple[float, str, float, str]]:
+    """Chronological (ts, metric, value, config) for the headline series."""
     series = []
     with open(path) as f:
         for line in f:
@@ -60,9 +74,9 @@ def load_series(path: str, metric_prefix: str, unit: str) -> list[tuple[float, s
             found = extract(record)
             if found is None:
                 continue
-            metric, value, rec_unit = found
+            metric, value, rec_unit, config = found
             if metric.startswith(metric_prefix) and rec_unit == unit:
-                series.append((float(record.get("ts", 0.0)), metric, value))
+                series.append((float(record.get("ts", 0.0)), metric, value, config))
     series.sort(key=lambda item: item[0])
     return series
 
@@ -91,8 +105,9 @@ def main() -> int:
 
     series = load_series(args.history, args.metric_prefix, args.unit)
     if args.list:
-        for ts, metric, value in series:
-            print(f"{ts:.0f}  {value:10.2f} {args.unit}  {metric}")
+        for ts, metric, value, config in series:
+            suffix = f"  [{config}]" if config else ""
+            print(f"{ts:.0f}  {value:10.2f} {args.unit}  {metric}{suffix}")
         return 0
     if len(series) < 2:
         # nothing to gate against: a fresh repo (or a renamed headline) must
@@ -106,17 +121,27 @@ def main() -> int:
 
     # gate within ONE exact series: the prefix family carries variants
     # (@25M params vs @200k params) whose absolute numbers are worlds
-    # apart — the latest record picks which variant is being gated
-    latest_metric = series[-1][1]
-    series = [item for item in series if item[1] == latest_metric]
+    # apart, and a kernel/thread-config change is a different experiment —
+    # the latest record picks which (metric, config) series is being gated
+    latest_metric, latest_config = series[-1][1], series[-1][3]
+    same_metric = [item for item in series if item[1] == latest_metric]
+    series = [item for item in same_metric if item[3] == latest_config]
     if len(series) < 2:
-        print(
-            f"bench-gate: first round of '{latest_metric}'; nothing to compare",
-            file=sys.stderr,
-        )
+        if len(same_metric) >= 2:
+            print(
+                f"bench-gate: first round of '{latest_metric}' with config "
+                f"[{latest_config or 'none recorded'}] — a kernel/thread-config "
+                "change starts a NEW series, not a regression; nothing to compare",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"bench-gate: first round of '{latest_metric}'; nothing to compare",
+                file=sys.stderr,
+            )
         return 0
-    *prior, (_, _, latest) = series
-    best_ts, best_metric, best = max(prior, key=lambda item: item[2])
+    *prior, (_, _, latest, _) = series
+    best_ts, best_metric, best, _best_cfg = max(prior, key=lambda item: item[2])
     floor = best * (1.0 - args.threshold)
     verdict = {
         "latest": latest,
@@ -126,6 +151,7 @@ def main() -> int:
         "unit": args.unit,
         "rounds": len(series),
         "metric": latest_metric,
+        "config": latest_config,
     }
     if latest < floor:
         verdict["result"] = "REGRESSION"

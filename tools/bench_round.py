@@ -64,6 +64,9 @@ def main() -> None:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from xaynet_tpu.utils.jaxcache import silence_cpu_cache
+
+    silence_cpu_cache(jax)  # no cross-machine SIGILL warning wall on CPU
     import numpy as np
 
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType

@@ -40,6 +40,16 @@ Checks:
     wrapper's retry/Retry-After/typed-error semantics apply. The one
     legitimate transport (``HttpClient._request``) is annotated with
     ``# lint: raw-http-ok``.
+  - blocking host syncs (``np.asarray`` / ``block_until_ready``) inside
+    fold-worker code paths under ``xaynet_tpu/parallel`` (functions whose
+    names mark the worker/submit/fold call graph — see
+    ``_WORKER_SYNC_PREFIXES``): the streaming pipeline's whole point is
+    that the only sanctioned synchronization point is ``drain()`` (exempt
+    by name), so a stray sync in a worker or submit path silently
+    serializes the overlap. A deliberate sync (a transfer barrier before
+    ring-buffer reuse, the native kernel's host-view materialization, a
+    degraded-path acceptance resolve) must carry ``# lint: sync-ok`` on
+    the offending line.
   - silent broad-exception swallows (``except Exception: pass`` and
     friends) under ``xaynet_tpu/server`` and ``xaynet_tpu/storage``: a
     coordinator-side failure must be logged, metered, retried or
@@ -249,6 +259,39 @@ def _is_fold_call(node: ast.Call) -> bool:
     return isinstance(func, ast.Name) and func.id in _FOLD_CALLEES
 
 
+# fold-worker call-graph function-name prefixes under xaynet_tpu/parallel:
+# the producers (submit_*), the per-batch/per-shard fold paths (_fold*,
+# fold*, _credit, _dispatch*, _retry*, _shard*), and the worker loops
+# (_process*, _worker*). drain()/_drain* are the sanctioned sync points and
+# deliberately NOT listed.
+_WORKER_SYNC_PREFIXES = (
+    "_process",
+    "_fold",
+    "fold",
+    "_dispatch",
+    "_credit",
+    "_retry",
+    "_shard",
+    "_worker",
+    "submit",
+    "_submit",
+)
+
+# host-blocking entry points: np.asarray materializes a device value on the
+# host; block_until_ready is an explicit device barrier
+_SYNC_CALLEES = frozenset({"asarray", "block_until_ready"})
+
+
+def _is_blocking_sync(node: ast.Call) -> bool:
+    """True for any spelling of ``np.asarray(...)`` /
+    ``jax.block_until_ready(...)`` / ``x.block_until_ready()`` (syntactic,
+    like the other rules)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _SYNC_CALLEES
+    return isinstance(func, ast.Name) and func.id in _SYNC_CALLEES
+
+
 def _is_device_put(node: ast.Call) -> bool:
     """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
     rule is syntactic, like the queue rule: any spelling that resolves to
@@ -329,6 +372,32 @@ def check_file(path: Path) -> list[str]:
 
     def line_of(node: ast.AST) -> str:
         return src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
+
+    # parallel tree: blocking host syncs inside fold-worker code paths
+    # serialize the pipeline overlap; drain() is the sanctioned sync point
+    if str(rel).startswith("xaynet_tpu/parallel"):
+        flagged: set[int] = set()
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not fn.name.startswith(_WORKER_SYNC_PREFIXES):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_blocking_sync(node)
+                    and node.lineno not in flagged
+                ):
+                    if "lint: sync-ok" not in line_of(node):
+                        flagged.add(node.lineno)
+                        problems.append(
+                            f"{rel}:{node.lineno}: blocking host sync in fold-worker "
+                            f"code path '{fn.name}' (synchronize in drain(), or "
+                            "annotate a deliberate transfer barrier / host-kernel "
+                            "materialization with '# lint: sync-ok')"
+                        )
+                    else:
+                        flagged.add(node.lineno)
 
     for node in ast.walk(tree):
         if hot_path and isinstance(node, ast.Call):

@@ -74,13 +74,19 @@ def main() -> int:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
-    try:
-        cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:
-        print(f"compile cache unavailable: {e}", file=sys.stderr)
+    from xaynet_tpu.utils.jaxcache import silence_cpu_cache
+
+    if not silence_cpu_cache(jax):
+        # accelerator backend: the persistent cache saves tunnel-window
+        # recompiles (on CPU it only buys the cross-machine SIGILL warning
+        # wall over the bench tail — see utils/jaxcache.py)
+        try:
+            cache_dir = os.environ.get("XAYNET_JAX_CACHE", "/tmp/xaynet_jax_cache")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:
+            print(f"compile cache unavailable: {e}", file=sys.stderr)
 
     from xaynet_tpu.core.mask.config import BoundType, DataType, GroupType, MaskConfig, ModelType
     from xaynet_tpu.ops import limbs as host_limbs

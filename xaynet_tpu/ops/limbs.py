@@ -268,9 +268,92 @@ def batch_mod_sum(stack: np.ndarray, order_limbs: np.ndarray) -> np.ndarray:
     return stack[0]
 
 
+def native_fold_threads() -> int:
+    """The native library's process-wide fold worker budget
+    (``XAYNET_NATIVE_THREADS`` or its 2x-cores default), or 1 when the
+    library is unavailable. The shard planner divides this into per-shard
+    budgets instead of re-implementing the policy in Python."""
+    from ..utils import native
+
+    lib = native.load()
+    return int(lib.xn_fold_threads()) if lib is not None else 1
+
+
+def u64_fold_applicable(k: int, n_limb: int, order_limbs: np.ndarray) -> bool:
+    """Whether the native single-pass u64 fold is exact for this shape: a
+    <= 2-limb order whose K+1-term running sum fits u64 (pow2-boundary
+    orders — all-zero limbs — wrap exactly for any K)."""
+    if n_limb > 2:
+        return False
+    if not np.any(order_limbs):
+        return True
+    order = limbs_to_int(order_limbs)
+    return (k + 1) <= ((1 << 64) // order)
+
+
+def fold_planar_slice_host(
+    acc: np.ndarray,
+    stack: np.ndarray,
+    out: np.ndarray,
+    col0: int,
+    col1: int,
+    order_limbs: np.ndarray,
+    n_threads: int = 0,
+    acc_cols: int | None = None,
+) -> bool:
+    """Fold the model-axis column slice ``[col0, col1)`` of the planar
+    ``uint32[K, L, n]`` batch into the same slice of ``acc``, writing
+    ``out`` — reading the batch IN PLACE through its strides, so one
+    shard's fold touches zero bytes outside its slice and the staged batch
+    is never copied per shard.
+
+    ``acc``/``out`` are either full-width ``[L, n]`` buffers (the slice is
+    addressed at ``col0``) or contiguous per-shard ``[L, col1-col0]``
+    buffers (pass ``acc_cols=col1-col0``; the slice starts at column 0 —
+    the donated per-shard accumulators of the sharded streaming fold).
+    ``n_threads`` > 0 pins this call's native worker count (the per-shard
+    budget when shard folds run concurrently); 0 keeps the process default.
+
+    Returns False when no native path applies (caller falls back to a
+    copy + :func:`fold_planar_batch_host`); requirements otherwise match
+    the u64 kernel (use :func:`u64_fold_applicable`).
+    """
+    k, n_limb, n = stack.shape
+    width = col1 - col0
+    a_cols = acc_cols if acc_cols is not None else n
+    if acc.shape != (n_limb, a_cols) or out.shape != acc.shape:
+        raise ValueError("accumulator/out shape mismatch")
+    if not (acc.flags.c_contiguous and out.flags.c_contiguous and stack.flags.c_contiguous):
+        raise ValueError("slice fold requires C-contiguous buffers")
+    if out is acc:
+        raise ValueError("out must not alias acc")
+    if not u64_fold_applicable(k, n_limb, order_limbs):
+        return False
+    from ..utils import native
+
+    lib = native.load()
+    if lib is None:
+        return False
+    off = 0 if acc_cols is not None else col0
+    lib.xn_fold_planar_u64_strided(
+        native.np_u32p_at(acc, off),
+        native.np_u32p_at(stack, col0),
+        native.np_u32p_at(out, off),
+        width,
+        a_cols,  # acc/out plane stride
+        n,  # stack row (limb-plane) stride
+        n_limb * n,  # stack batch (update) stride
+        n_limb,
+        k,
+        native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
+        max(0, int(n_threads)),
+    )
+    return True
+
+
 def fold_planar_batch_host(
     acc: np.ndarray, stack: np.ndarray, order_limbs: np.ndarray,
-    out: np.ndarray | None = None,
+    out: np.ndarray | None = None, n_threads: int = 0,
 ) -> np.ndarray:
     """Single-pass host fold of planar ``uint32[K, L, n]`` updates into the
     planar ``uint32[L, n]`` accumulator (host analogue of
@@ -287,16 +370,14 @@ def fold_planar_batch_host(
     buffer costs ~0.15 s of page faults per fold, so steady-state callers
     (the aggregator's native kernel) ping-pong two buffers instead. Only
     the native path honors it; callers must use the RETURNED array either
-    way.
+    way. ``n_threads`` > 0 pins the native worker count for this call (the
+    per-shard budget of the sharded streaming fold); 0 keeps the process
+    default.
     """
     k, n_limb, n = stack.shape
     if acc.shape != (n_limb, n):
         raise ValueError("accumulator/batch shape mismatch")
-    order = limbs_to_int(order_limbs) or (1 << (32 * n_limb))
-    # pow2-boundary orders (all-zero limbs) wrap exactly in u64 for any K;
-    # otherwise the running sum (K+1 terms) must fit u64
-    pow2_boundary = not np.any(order_limbs)
-    if n_limb <= 2 and (pow2_boundary or (k + 1) <= ((1 << 64) // order)):
+    if u64_fold_applicable(k, n_limb, order_limbs):
         from ..utils import native
 
         lib = native.load()
@@ -313,14 +394,18 @@ def fold_planar_batch_host(
                 pass  # reuse the caller's spare buffer
             else:
                 out = np.empty_like(acc_c)
-            lib.xn_fold_planar_u64(
+            lib.xn_fold_planar_u64_strided(
                 native.np_u32p(acc_c),
                 native.np_u32p(stack_c),
                 native.np_u32p(out),
                 n,
+                n,  # acc/out plane stride (full width)
+                n,  # stack row stride
+                n_limb * n,  # stack batch stride
                 n_limb,
                 k,
                 native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
+                max(0, int(n_threads)),
             )
             return out
     # fallback: wire layout pairwise tree (exact for any limb count)

@@ -7,14 +7,17 @@ zero-collective elementwise kernels and multi-host extensions.
 """
 
 from .aggregator import ShardedAggregator
-from .mesh import MODEL_AXIS, make_mesh
+from .mesh import MODEL_AXIS, make_mesh, shard_slices
 from .multihost import MultiHostAggregator
+from .shards import ShardPlan
 from .streaming import StreamingAggregator
 
 __all__ = [
     "ShardedAggregator",
+    "ShardPlan",
     "StreamingAggregator",
     "MODEL_AXIS",
     "make_mesh",
+    "shard_slices",
     "MultiHostAggregator",
 ]

@@ -96,6 +96,68 @@ def _build_wire_unpack(bpn: int, order: int, multi_device: bool):
     return unpack_mask
 
 
+def _sharded_native_fold(
+    acc_np: np.ndarray, stack_np: np.ndarray, order_limbs, n_shards: int, state: dict
+) -> np.ndarray:
+    """One concurrent strided native kernel call per mesh shard over the
+    full host planar batch: shard ``d`` reads and writes only its
+    contiguous plane slice of the shared acc/out buffers (disjoint columns
+    — no synchronization beyond the join), each call under the per-shard
+    thread budget. The GIL is released inside the C++ kernel, so the
+    threads genuinely overlap the shard folds; they are spawned per call
+    (spawn cost ~10us each, noise against a >=100ms fold) because the
+    aggregator has no close() hook to own a pool's lifecycle. Returns the
+    new accumulator (``state['spare']`` reused when possible, exactly like
+    the single-device ping-pong)."""
+    import threading
+
+    from .mesh import shard_slices
+    from .shards import shard_thread_budget
+
+    acc_c = np.ascontiguousarray(acc_np, dtype=np.uint32)
+    stack_c = np.ascontiguousarray(stack_np, dtype=np.uint32)
+    spare = state["spare"]
+    if not (
+        spare is not None
+        and spare.shape == acc_c.shape
+        and spare.dtype == np.uint32
+        and spare.flags.c_contiguous
+        and spare is not acc_c
+    ):
+        spare = np.empty_like(acc_c)
+    if not state["budget"]:
+        state["budget"] = shard_thread_budget(n_shards)
+    budget = state["budget"]
+    slices = shard_slices(acc_c.shape[1], n_shards)
+    results = [False] * n_shards
+    errors: list[BaseException] = []
+
+    def fold_slice(i: int, lo: int, hi: int) -> None:
+        try:
+            results[i] = host_limbs.fold_planar_slice_host(
+                acc_c, stack_c, spare, lo, hi, order_limbs, n_threads=budget
+            )
+        except BaseException as e:  # surfaced after the join
+            errors.append(e)
+
+    threads = [
+        threading.Thread(
+            target=fold_slice, args=(i, lo, hi), name=f"xn-shard-fold-{i}", daemon=True
+        )
+        for i, (lo, hi) in enumerate(slices)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    if all(results):
+        return spare
+    # library unavailable mid-round: exact generic fallback
+    return host_limbs.fold_planar_batch_host(acc_c, stack_c, order_limbs, out=spare)
+
+
 class ShardedAggregator:
     """Accumulates masked updates on-device, sharded over the model axis.
 
@@ -366,11 +428,20 @@ class ShardedAggregator:
         """Host C++ single-pass u64 fold (``utils.native``), same
         ``(acc, staged) -> acc`` contract as the device folds but over host
         numpy (jax inputs are viewed with ``np.asarray`` — zero-copy for
-        CPU-backend arrays). NOT memoized in ``_FOLD_FN_CACHE``: there is no
-        compiled executable to leak, and the closure carries a
-        per-aggregator spare accumulator so the steady state allocates
-        nothing (a fresh 200 MB result buffer costs ~0.15 s/fold in page
-        faults at 25M params)."""
+        CPU-backend arrays; mesh-sharded inputs gather once). NOT memoized
+        in ``_FOLD_FN_CACHE``: there is no compiled executable to leak, and
+        the closure carries a per-aggregator spare accumulator so the
+        steady state allocates nothing (a fresh 200 MB result buffer costs
+        ~0.15 s/fold in page faults at 25M params).
+
+        On a multi-device mesh the fold runs ONE CONCURRENT STRIDED KERNEL
+        CALL PER SHARD — each folds its device's contiguous plane slice
+        straight out of the full staged batch (zero slice copies) under
+        the per-shard thread budget (the process-wide auto-calibrated
+        budget split across shards, ``XAYNET_NATIVE_SHARD_THREADS`` to
+        pin) — so the host kernel honors the mesh decomposition instead of
+        refusing it, and the result stays host-resident (``unmask_limbs``
+        and ``snapshot`` handle a host accumulator)."""
         order = self.order
         order_limbs = host_limbs.order_limbs_for(order)
         # u64 running-sum headroom: K+1 terms < order each must fit u64
@@ -378,10 +449,12 @@ class ShardedAggregator:
         headroom = (
             None if order == (1 << (32 * self.n_limbs)) else (1 << 64) // order
         )
-        state = {"spare": None, "warned": False}
+        n_shards = self.mesh.devices.size
+        state = {"spare": None, "warned": False, "budget": 0}
 
         def fold(acc, staged):
-            stack_np = np.asarray(staged)
+            # host kernel reads host memory (zero-copy on CPU)  # lint: sync-ok
+            stack_np = np.asarray(staged)  # lint: sync-ok
             if headroom is not None and stack_np.shape[0] + 1 > headroom:
                 # the usability check binds kernel_used on the FIRST batch's
                 # K; a later larger batch past the u64 headroom (high-order
@@ -395,11 +468,14 @@ class ShardedAggregator:
                         stack_np.shape[0],
                         order.bit_length(),
                     )
-                return fold_planar_batch(np.asarray(acc), stack_np, order)
-            acc_np = np.asarray(acc)
-            out = host_limbs.fold_planar_batch_host(
-                acc_np, stack_np, order_limbs, out=state["spare"]
-            )
+                return fold_planar_batch(np.asarray(acc), stack_np, order)  # lint: sync-ok
+            acc_np = np.asarray(acc)  # lint: sync-ok
+            if n_shards > 1:
+                out = _sharded_native_fold(acc_np, stack_np, order_limbs, n_shards, state)
+            else:
+                out = host_limbs.fold_planar_batch_host(
+                    acc_np, stack_np, order_limbs, out=state["spare"]
+                )
             # the old accumulator's buffer becomes the next spare: the
             # aggregator owns ``acc`` exclusively (readers go through
             # snapshot(), which copies), so it is dead once the caller
@@ -413,12 +489,15 @@ class ShardedAggregator:
         return fold
 
     def _native_u64_usable(self, k: int) -> bool:
-        """Whether the native u64 fold can serve THIS aggregator: single
-        device (the host kernel cannot shard), an order within 2 limbs whose
-        K+1-term running sum fits u64 (``fold_planar_batch_host``'s fast
-        path — anything else would silently fall back to the slow pairwise
-        tree), and a loadable shared library."""
-        if self.mesh.devices.size > 1 or self.n_limbs > 2:
+        """Whether the native u64 fold can serve THIS aggregator: an order
+        within 2 limbs whose K+1-term running sum fits u64
+        (``fold_planar_batch_host``'s fast path — anything else would
+        silently fall back to the slow pairwise tree), and a loadable
+        shared library. Multi-device meshes are served too: each device's
+        contiguous plane slice folds through the strided kernel entry with
+        a per-shard thread budget (one concurrent call per shard), so the
+        mesh no longer forces the XLA fallback."""
+        if self.n_limbs > 2:
             return False
         if self.order != (1 << (32 * self.n_limbs)) and (k + 1) > (
             (1 << 64) // self.order
@@ -511,8 +590,8 @@ class ShardedAggregator:
             used = self.kernel
             if used == "native-u64" and not self._native_u64_usable(k):
                 logger.warning(
-                    "native-u64 fold unavailable (no loadable library, multi-device "
-                    "mesh, or order outside the u64 fast path); falling back to xla"
+                    "native-u64 fold unavailable (no loadable library, or order "
+                    "outside the u64 fast path); falling back to xla"
                 )
                 used = "xla"
             self.kernel_used = used
@@ -613,6 +692,24 @@ class ShardedAggregator:
         planar = wire_to_planar(mask) if mask.shape == (self.model_length, self.n_limbs) else mask
         if planar.shape[1] != self.padded_length:
             planar = np.pad(planar, ((0, 0), (0, self.padded_length - planar.shape[1])))
+        if not isinstance(self.acc, jax.Array):
+            # the native fold keeps the accumulator host-resident (it would
+            # previously ride into the jit as an implicit upload; a
+            # multi-device mesh makes that upload a sharding conflict):
+            # unmask is the same elementwise modular subtract, on host
+            # limbs, for a result the caller reads on the host anyway
+            acc_wire = np.ascontiguousarray(
+                np.asarray(self.acc)[:, : self.model_length].T
+            )
+            mask_wire = np.ascontiguousarray(planar[:, : self.model_length].T)
+            order_limbs = host_limbs.order_limbs_for(self.order)
+            return profiling.timed_kernel(
+                "unmask",
+                self.padded_length,
+                lambda: np.ascontiguousarray(
+                    host_limbs.mod_sub(acc_wire, mask_wire, order_limbs)
+                ),
+            )
         mask_dev = jax.device_put(jnp.asarray(planar), self._acc_sharding)
         out = profiling.timed_kernel(
             "unmask",

@@ -33,3 +33,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pad_to_multiple(n: int, k: int) -> int:
     """Model length padded so every device holds an equal slice."""
     return -(-n // k) * k
+
+
+def shard_slices(padded_len: int, n_dev: int) -> list[tuple[int, int]]:
+    """The contiguous model-axis column slice ``[lo, hi)`` each mesh device
+    owns under the 1-D ``P(None, MODEL_AXIS)`` sharding, in mesh-device
+    order. ``padded_len`` must already be a multiple of ``n_dev``
+    (``pad_to_multiple`` guarantees it), so the slices are equal-width and
+    the device-d slice of a serialized wire block is element-aligned."""
+    if padded_len % n_dev:
+        raise ValueError("padded length must divide evenly across devices")
+    width = padded_len // n_dev
+    return [(d * width, (d + 1) * width) for d in range(n_dev)]

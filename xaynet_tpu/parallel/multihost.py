@@ -34,7 +34,7 @@ import numpy as np
 from ..core.mask.config import MaskConfig
 from ..ops.fold_jax import p_mod_sub, wire_to_planar
 from .aggregator import ShardedAggregator
-from .mesh import make_mesh
+from .mesh import make_mesh, shard_slices
 
 
 def initialize(
@@ -73,9 +73,12 @@ class MultiHostAggregator:
         if n_local * n_proc != self.mesh.devices.size:
             raise ValueError("every process must contribute the same number of devices")
         self.agg = ShardedAggregator(config, model_length, mesh=self.mesh, kernel=kernel)
-        per = self.agg.padded_length // n_proc
-        self._lo_padded = per * jax.process_index()
-        self._hi_padded = self._lo_padded + per
+        # a process's slice is the union of its devices' shard slices: the
+        # same contiguous-column decomposition the shard-parallel streaming
+        # fold uses per device (mesh.shard_slices), taken n_local at a time
+        self._lo_padded, self._hi_padded = shard_slices(self.agg.padded_length, n_proc)[
+            jax.process_index()
+        ]
         self.n_limbs = self.agg.n_limbs
         self.model_length = model_length
         self._unmask_jit = jax.jit(

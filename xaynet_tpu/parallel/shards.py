@@ -1,0 +1,314 @@
+"""Per-shard decomposition of the aggregation accumulator.
+
+The mesh-sharded fold (``ShardedAggregator``) runs ONE program over the
+whole mesh per batch: a single dispatch, a single accumulator, a single
+host sync at drain. That shape cannot overlap per-device work — every
+device waits for the slowest transfer, and the host-native kernel was
+locked out of multi-device meshes entirely because it had no notion of a
+device slice.
+
+A :class:`ShardPlan` decomposes the aggregator's planar accumulator into
+per-shard owned buffers — one per mesh device, each covering that device's
+contiguous model-axis column slice (``mesh.shard_slices``) — so the
+streaming pipeline can run ONE FOLD WORKER PER SHARD with independent
+queues, donated per-shard accumulators, and per-shard host→device
+transfers that overlap other shards' in-flight folds (the DrJAX-style
+MapReduce pipelining of arxiv 2403.07128, applied across the mesh instead
+of across batches).
+
+Two shard-fold backends, chosen by the aggregator's resolved kernel:
+
+- **native-u64** — per-shard host buffers folded by the threaded C++
+  kernel. The strided entry (``ops.limbs.fold_planar_slice_host``) reads a
+  shard's column slice straight out of the full staged batch, so the
+  sequential multi-device fold and the bench's fold-only loop copy
+  nothing; the streaming path folds contiguous per-shard ring buffers.
+  Each call carries a per-shard thread budget: the process-wide
+  auto-calibrated budget (``XAYNET_NATIVE_THREADS`` / 2x cores) split
+  across the shards that now run concurrently, overridable with
+  ``XAYNET_NATIVE_SHARD_THREADS``.
+- **device kernels** (xla/pallas) — per-device single-device arrays folded
+  by the already-jitted ``fold_planar_batch`` (its ``donate_argnums=(0,)``
+  is the per-shard accumulator donation); the executable is shared across
+  shards (same shapes, same program).
+
+Exactness: the fold is an exact modular sum and the model axis is
+embarrassingly parallel, so any decomposition of the column axis folds to
+the byte-identical aggregate — per-shard progress skew (shard A two
+batches ahead of shard B) changes nothing once every shard has folded
+every batch, which is what the streaming pipeline's per-batch commit
+barrier guarantees.
+
+Ownership contract: while a plan is ACTIVE (built and not yet
+reassembled), the per-shard buffers are the authoritative accumulator and
+the aggregator's global ``acc`` is stale — for device kernels the first
+donated fold actually invalidates it (the zero-copy decomposition aliases
+its buffers). ``reassemble()`` publishes the per-shard state back as the
+global accumulator; the streaming pipeline calls it from ``drain()``, its
+cross-shard barrier.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..ops import limbs as host_limbs
+from .mesh import shard_slices
+
+logger = logging.getLogger(__name__)
+
+SHARD_THREADS_ENV = "XAYNET_NATIVE_SHARD_THREADS"
+
+
+def shard_thread_budget(n_shards: int, explicit: int = 0) -> int:
+    """Per-shard native worker-thread budget: an explicit setting wins,
+    then the ``XAYNET_NATIVE_SHARD_THREADS`` env pin (what the bench
+    records next to its headline), then the process-wide auto-calibrated
+    budget split across the shards that will run concurrently."""
+    if explicit > 0:
+        return explicit
+    env = os.environ.get(SHARD_THREADS_ENV, "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            logger.warning("ignoring non-integer %s=%r", SHARD_THREADS_ENV, env)
+    return max(1, host_limbs.native_fold_threads() // n_shards)
+
+
+class ShardPlan:
+    """Per-shard accumulator state + fold entry points for one aggregator.
+
+    Built against a resolved kernel (``agg.kernel_used``); ``zero_accs``
+    starts from zeros without reading ``agg.acc`` (kernel calibration and
+    tests race plans without touching the live accumulator).
+    """
+
+    def __init__(self, agg, shard_threads: int = 0, zero_accs: bool = False):
+        if agg.kernel_used is None:
+            raise ValueError("kernel must be resolved before building a shard plan")
+        self.agg = agg
+        self.native = agg.kernel_used == "native-u64"
+        self.n_shards = agg.mesh.devices.size
+        self.slices = shard_slices(agg.padded_length, self.n_shards)
+        self.devices = list(agg.mesh.devices.flat)
+        self.order_limbs = host_limbs.order_limbs_for(agg.order)
+        self.n_threads = shard_thread_budget(self.n_shards, shard_threads) if self.native else 0
+        self._pool: ThreadPoolExecutor | None = None
+        self._warned_fallback = False
+        # serializes device folds issued from the D worker threads: jax's
+        # dispatch/execution path is not reliably thread-safe for
+        # concurrent donating jit calls on the virtual-device CPU backend
+        # (~1 in 40k folds lands a torn shard slice under scheduler
+        # contention — reproduced with no fault injection). On CPU the
+        # lock is held through COMPLETION: the virtual devices share the
+        # physical cores, so serialized folds lose no real parallelism
+        # (XLA's intra-op pool still spans the cores, and staging copies
+        # keep overlapping). On real accelerators only the host-side
+        # dispatch serializes — per-device execution stays concurrent,
+        # which is the point of the shard fan-out. The native path never
+        # takes the lock (synchronous GIL-released kernel calls over
+        # disjoint buffers).
+        self._device_dispatch_lock = threading.Lock()
+        self._serialize_device_folds = False
+        if not self.native:
+            import jax
+
+            self._serialize_device_folds = jax.default_backend() == "cpu"
+        if self.native:
+            if zero_accs:
+                self.accs = [
+                    np.zeros((agg.n_limbs, hi - lo), dtype=np.uint32)
+                    for lo, hi in self.slices
+                ]
+            else:
+                acc_np = np.asarray(agg.acc)
+                self.accs = [
+                    np.ascontiguousarray(acc_np[:, lo:hi]) for lo, hi in self.slices
+                ]
+            self.spares: list = [np.empty_like(a) for a in self.accs]
+        else:
+            import jax
+            import jax.numpy as jnp
+
+            if zero_accs:
+                self.accs = [
+                    jax.device_put(
+                        jnp.zeros((agg.n_limbs, hi - lo), dtype=jnp.uint32), dev
+                    )
+                    for (lo, hi), dev in zip(self.slices, self.devices)
+                ]
+            elif not isinstance(agg.acc, jax.Array):
+                # a host-resident accumulator (an earlier native fold left
+                # it on the host): upload each device its slice
+                acc_np = np.asarray(agg.acc)
+                self.accs = [
+                    jax.device_put(np.ascontiguousarray(acc_np[:, lo:hi]), dev)
+                    for (lo, hi), dev in zip(self.slices, self.devices)
+                ]
+            else:
+                # zero-copy decomposition: the addressable shards of the
+                # mesh-sharded accumulator ARE the per-device slices; the
+                # first donated fold invalidates the global array, which is
+                # exactly the ownership handoff documented above
+                by_start = {
+                    s.index[-1].start or 0: s.data for s in agg.acc.addressable_shards
+                }
+                self.accs = [by_start[lo] for lo, _ in self.slices]
+            self.spares = []
+
+    # -- folds ------------------------------------------------------------
+
+    def fold_shard(self, d: int, batch) -> None:
+        """Fold a per-shard batch ``[K, L, width]`` into shard ``d``'s
+        accumulator. Native: a host-contiguous array folded by the C++
+        kernel under this plan's per-shard thread budget, ping-ponging the
+        shard's donated spare buffer. Device: a ``device[d]``-resident
+        array folded by the jitted kernel (accumulator donated).
+
+        The accumulator is reassigned only after the fold call returns, so
+        an exception leaves the shard consistent — the streaming pipeline's
+        per-shard sync-retry relies on this."""
+        if self.native:
+            stack_np = np.asarray(batch)  # host-kernel view  # lint: sync-ok
+            if not host_limbs.u64_fold_applicable(
+                stack_np.shape[0], self.agg.n_limbs, self.order_limbs
+            ):
+                self._warn_fallback(stack_np.shape[0])
+            acc = self.accs[d]
+            out = host_limbs.fold_planar_batch_host(
+                acc,
+                stack_np,
+                self.order_limbs,
+                out=self.spares[d],
+                n_threads=self.n_threads,
+            )
+            self.spares[d] = acc if (out is not acc and acc.flags.writeable) else None
+            self.accs[d] = out
+        elif self.agg.kernel_used in ("pallas", "pallas-interpret"):
+            from ..ops import fold_pallas
+
+            # late module-attribute lookup so test spies see the call, same
+            # as the aggregator's fold builder; the kernel is elementwise
+            # along the model axis, so each shard runs it on its own slice
+            def call(acc):
+                return fold_pallas.fold_planar_batch_pallas(
+                    acc,
+                    batch,
+                    self.agg.order,
+                    interpret=self.agg.kernel_used == "pallas-interpret",
+                )
+
+            self._locked_device_fold(d, call)
+        else:
+            from ..ops.fold_jax import fold_planar_batch
+
+            self._locked_device_fold(
+                d, lambda acc: fold_planar_batch(acc, batch, self.agg.order)
+            )
+
+    def _locked_device_fold(self, d: int, call) -> None:
+        """Run one shard's device fold under the dispatch lock; on the CPU
+        backend hold it through completion (see the lock's construction
+        note). The shard accumulator is reassigned only after ``call``
+        returns — an exception leaves the shard consistent."""
+        with self._device_dispatch_lock:
+            new_acc = call(self.accs[d])
+            if self._serialize_device_folds:
+                import jax
+
+                new_acc = jax.block_until_ready(new_acc)  # lint: sync-ok
+        self.accs[d] = new_acc
+
+    def fold_shard_slice(self, d: int, full_planar: np.ndarray) -> None:
+        """Fold shard ``d``'s column slice straight out of a FULL staged
+        planar ``uint32[K, L, padded]`` batch — the strided native read,
+        zero slice copies (native plans only)."""
+        if not self.native:
+            raise RuntimeError("slice folds are a native-kernel path")
+        lo, hi = self.slices[d]
+        acc, spare = self.accs[d], self.spares[d]
+        if spare is None:
+            spare = np.empty_like(acc)
+        if host_limbs.fold_planar_slice_host(
+            acc,
+            full_planar,
+            spare,
+            lo,
+            hi,
+            self.order_limbs,
+            n_threads=self.n_threads,
+            acc_cols=hi - lo,
+        ):
+            self.accs[d], self.spares[d] = spare, acc
+            return
+        # u64 headroom exceeded (or library gone mid-round): copy the slice
+        # and take the generic fold — exact, just not single-pass
+        self._warn_fallback(full_planar.shape[0])
+        self.fold_shard(d, np.ascontiguousarray(full_planar[:, :, lo:hi]))
+
+    def fold_full(self, full_planar: np.ndarray) -> None:
+        """Fold every shard's slice of a full staged batch CONCURRENTLY
+        (one strided kernel call per shard, each under the per-shard thread
+        budget) — the sequential multi-device native fold and the bench's
+        fold-only loop. The calls release the GIL inside the C++ kernel,
+        so a thread pool genuinely overlaps them."""
+        if not self.native:
+            raise RuntimeError("fold_full is a native-kernel path")
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.n_shards, thread_name_prefix="xn-shard-fold"
+            )
+        list(
+            self._pool.map(
+                lambda d: self.fold_shard_slice(d, full_planar), range(self.n_shards)
+            )
+        )
+
+    def _warn_fallback(self, k: int) -> None:
+        if not self._warned_fallback:
+            self._warned_fallback = True
+            logger.warning(
+                "native u64 headroom exceeded at K=%d (order ~2^%d); shard "
+                "folds taking the generic host path for oversized batches",
+                k,
+                self.agg.order.bit_length(),
+            )
+
+    # -- barrier / reassembly ---------------------------------------------
+
+    def block_until_ready(self) -> None:
+        """Wait for every shard's in-flight device fold (native folds are
+        synchronous — nothing to wait for)."""
+        if not self.native:
+            import jax
+
+            jax.block_until_ready(self.accs)
+
+    def reassemble(self):
+        """The global planar accumulator assembled from the per-shard
+        state: zero-copy for device plans
+        (``make_array_from_single_device_arrays`` over the per-device
+        buffers, which ARE the mesh sharding's shards), one concatenation
+        copy for native plans (host memory has no sharded view). The
+        caller (drain) re-publishes this as ``agg.acc``; the plan is stale
+        afterwards — rebuild before folding again."""
+        if self.native:
+            return np.concatenate(self.accs, axis=1)
+        import jax
+
+        return jax.make_array_from_single_device_arrays(
+            (self.agg.n_limbs, self.agg.padded_length),
+            self.agg._acc_sharding,
+            list(self.accs),
+        )
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None

@@ -29,6 +29,28 @@ modular sum, so the aggregate is byte-identical to sequential
 ``add_batch``/``add_wire_batch`` calls over the same updates regardless of
 how far the pipeline runs ahead.
 
+**Shard-parallel mode (multi-device meshes).** On a mesh of D devices the
+pipeline runs ONE FOLD WORKER PER SHARD instead of the single FIFO worker:
+each mesh device owns its contiguous model-axis plane slice with a donated
+per-shard accumulator (``shards.ShardPlan``), the producer slices the
+padded batch ONCE on the host into per-shard staging rings, and each
+shard's host→device transfer overlaps the other shards' in-flight folds
+(device kernels) or each shard's threaded host fold runs concurrently
+under a split thread budget (the native kernel). A batch COMMITS — counts
+toward ``nb_models`` / leaves flight — only when EVERY shard folded its
+slice (``_BatchJob``), so per-shard progress skew never shows up in the
+accounting; ``drain()`` is the cross-shard barrier that performs the one
+deferred acceptance sync and reassembles the per-shard accumulators into
+the aggregator's global ``acc``. Wire batches keep their single
+mesh-program unpack (the psum-consistent validity mask of the sequential
+path) and fan only the FOLD out per shard, so acceptance semantics are
+byte-identical to ``add_wire_batch``. The degradation ladder is per-shard:
+a shard's fold failure with a provably untouched shard accumulator retries
+once synchronously on that shard alone (the other shards' slices of the
+batch fold normally — consistency comes from the commit barrier), flips
+the whole pipeline to the synchronous path on success, and poisons it
+permanently on a second failure.
+
 **Degradation ladder (streaming -> sync -> fail).** A fold failure in the
 worker does NOT immediately poison the round: the accumulator is only
 reassigned after a fold returns, so the failed batch is retried once
@@ -56,6 +78,7 @@ import numpy as np
 
 from ..ops.fold_jax import MAX_LAZY_BATCH
 from ..resilience.faults import maybe_fail
+from ..telemetry import profiling
 from ..telemetry.registry import get_registry
 from .aggregator import ShardedAggregator
 
@@ -90,6 +113,23 @@ DEGRADED = _registry.gauge(
 DEGRADATIONS = _registry.counter(
     "xaynet_streaming_degradations_total",
     "Times a streaming pipeline degraded to the synchronous fold path.",
+)
+SHARD_STAGING_DEPTH = _registry.gauge(
+    "xaynet_streaming_shard_staging_depth",
+    "Per-shard staging ring buffers currently owned by in-flight batches "
+    "(shard-parallel pipelines).",
+    ("shard",),
+)
+SHARD_INFLIGHT = _registry.gauge(
+    "xaynet_streaming_shard_inflight_folds",
+    "Per-shard fold items queued to or executing in the shard's worker.",
+    ("shard",),
+)
+SHARD_OVERLAP = _registry.gauge(
+    "xaynet_streaming_shard_overlap_ratio",
+    "Per-shard fraction of the shorter pipeline leg (staging vs folding) "
+    "that ran concurrently with the other leg during the last drain window.",
+    ("shard",),
 )
 
 _SHUTDOWN = object()
@@ -128,6 +168,33 @@ class StreamTicket:
         self._ok = None  # in-flight device acceptance vector (wire batches)
 
 
+class _BatchJob:
+    """Cross-shard accounting for ONE batch in shard-parallel mode.
+
+    Each of the D shard workers folds its slice independently; the batch
+    COMMITS — ``nb_models`` credit for planar batches, ring-buffer release
+    for the shared wire buffer, the folded/failed metric — only when the
+    LAST shard finishes (``remaining`` hits zero under the pipeline lock).
+    ``failed`` is sticky: one shard's loss fails the whole batch, because a
+    batch folded on some shards but not others corresponds to no
+    consistent update set (the pipeline is poisoned by then anyway).
+    """
+
+    __slots__ = ("kind", "k", "ticket", "seq", "remaining", "failed", "retried",
+                 "staged", "global_release")
+
+    def __init__(self, kind: str, k: int, ticket, seq: int, n_shards: int):
+        self.kind = kind
+        self.k = k
+        self.ticket = ticket
+        self.seq = seq
+        self.remaining = n_shards
+        self.failed = False
+        self.retried = False
+        self.staged = None  # wire: the mesh-staged byte array (transfer barrier)
+        self.global_release = None  # wire: (ring, buf) released at commit
+
+
 class _StagingRing:
     """Fixed pool of pre-allocated host staging buffers.
 
@@ -136,19 +203,26 @@ class _StagingRing:
     ``size`` batches ahead of the fold worker).
     """
 
-    def __init__(self, size: int, shape: tuple, dtype):
+    def __init__(self, size: int, shape: tuple, dtype, gauge=None):
         self._free: queue_mod.Queue = queue_mod.Queue()
         self.size = size
+        # per-shard rings report on the shard-labelled gauge; the global
+        # depth gauge keeps counting every owned buffer either way
+        self._gauge = gauge
         for _ in range(size):
             self._free.put(np.zeros(shape, dtype=dtype))
 
     def acquire(self, timeout: float | None = None) -> np.ndarray:
         buf = self._free.get(timeout=timeout)
         STAGING_DEPTH.inc()
+        if self._gauge is not None:
+            self._gauge.inc()
         return buf
 
     def release(self, buf: np.ndarray) -> None:
         STAGING_DEPTH.dec()
+        if self._gauge is not None:
+            self._gauge.dec()
         self._free.put(buf)
 
 
@@ -193,6 +267,8 @@ class StreamingAggregator:
         staging_buffers: int = 3,
         dispatch_ahead: int = 2,
         max_batch: int = 64,
+        shard_parallel: bool | None = None,
+        shard_threads: int = 0,
     ):
         if staging_buffers < 2:
             raise ValueError("staging_buffers must be >= 2 (no overlap below that)")
@@ -204,6 +280,20 @@ class StreamingAggregator:
         self.staging_buffers = staging_buffers
         self.dispatch_ahead = dispatch_ahead
         self.max_batch = min(max_batch, MAX_LAZY_BATCH)
+        # shard-parallel: one fold worker per mesh device, on by default
+        # whenever the mesh actually has more than one (None = auto);
+        # shard_threads pins the per-shard native thread budget (0 = split
+        # the process budget across shards / XAYNET_NATIVE_SHARD_THREADS)
+        n_dev = agg.mesh.devices.size
+        self._sharded = n_dev > 1 and (shard_parallel is None or shard_parallel)
+        self._n_shards = n_dev if self._sharded else 1
+        self._shard_threads = shard_threads
+        self._plan = None  # shards.ShardPlan while per-shard accs are live
+        self._shard_queues: list[queue_mod.Queue] | None = None
+        self._shard_workers: list[threading.Thread | None] = []
+        self._shard_rings: dict[int, _StagingRing] = {}
+        self._shard_stage_seconds = [0.0] * self._n_shards
+        self._shard_fold_seconds = [0.0] * self._n_shards
         self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=dispatch_ahead)
         self._rings: dict[str, _StagingRing] = {}  # lazy: planar / wire
         self._pending: list[StreamTicket] = []  # wire tickets awaiting ok sync
@@ -255,6 +345,17 @@ class StreamingAggregator:
         if self._worker is not None and self._worker.is_alive():
             self._queue.put(_SHUTDOWN)
             self._worker.join(timeout=60.0)
+        if self._shard_queues is not None:
+            for q in self._shard_queues:
+                q.put(_SHUTDOWN)
+            for w in self._shard_workers:
+                if w is not None and w.is_alive():
+                    w.join(timeout=60.0)
+        if self._plan is not None:
+            # a poisoned drain left the plan live; the aggregate is
+            # unusable, just free the pool
+            self._plan.close()
+            self._plan = None
 
     # -- producer side -----------------------------------------------------
 
@@ -359,13 +460,15 @@ class StreamingAggregator:
     def submit_batch(self, stack: np.ndarray) -> StreamTicket:
         """Stage + stream-fold wire-layout ``uint32[K, model_len, L]``
         updates (the pre-validated path: all members count immediately)."""
-        stack = np.asarray(stack, dtype=np.uint32)
+        stack = np.asarray(stack, dtype=np.uint32)  # host input, no device sync  # lint: sync-ok
         if stack.ndim != 3 or stack.shape[2] != self.agg.n_limbs:
             raise ValueError("expected uint32[K, model_len, L]")
         if stack.shape[1] != self.agg.model_length:
             raise ValueError("model length mismatch")
         k = stack.shape[0]
         self._check(k)
+        if self._sharded:
+            return self._submit_sharded_planar_stack(stack, k)
         t0 = time.monotonic()
         buf = self._ring("planar").acquire()
         # transpose+pad straight into the ring buffer (numpy strided copy,
@@ -397,6 +500,8 @@ class StreamingAggregator:
         the same bound as the pre-streaming flush."""
         if not rows:
             return
+        if self._sharded:
+            return self._fold_planar_rows_now_sharded(rows)
         self._queue.join()
         if self._error is not None:
             raise self._poison_error() from self._error
@@ -423,6 +528,8 @@ class StreamingAggregator:
         if k == 0:
             raise ValueError("empty planar batch")
         self._check(k)
+        if self._sharded:
+            return self._submit_sharded_planar_rows(rows, k)
         t0 = time.monotonic()
         buf = self._ring("planar").acquire()
         view = buf[:k]
@@ -441,19 +548,22 @@ class StreamingAggregator:
         itself excludes invalid members either way)."""
         agg = self.agg
         bpn = agg.config.bytes_per_number
-        raw = np.asarray(raw)
+        raw = np.asarray(raw)  # host input, no device sync  # lint: sync-ok
         if raw.dtype != np.uint8 or raw.ndim != 2 or raw.shape[1] != agg.model_length * bpn:
             raise ValueError("expected uint8[K, model_len * bytes_per_number]")
         k = raw.shape[0]
         self._check(k)
         t0 = time.monotonic()
-        buf = self._ring("wire").acquire()
+        ring = self._ring("wire")
+        buf = ring.acquire()
         view = buf[:k]
         view[:, : raw.shape[1]] = raw
         if agg.padded_length != agg.model_length:
             view[:, raw.shape[1] :] = 0  # zero bytes decode to zero elements
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
+        if self._sharded:
+            return self._dispatch_sharded_wire(ring, buf, view, k, ticket)
         self._batch_seq += 1
         self._dispatch((buf, view, "wire", k, ticket, self._batch_seq))
         return ticket
@@ -497,7 +607,7 @@ class StreamingAggregator:
                 try:
                     # the transfer out of the ring buffer must complete
                     # before reuse; the fold itself stays in flight behind it
-                    jax.block_until_ready(staged)
+                    jax.block_until_ready(staged)  # lint: sync-ok
                 except BaseException as e:
                     with self._lock:
                         if ticket in self._pending:
@@ -506,7 +616,7 @@ class StreamingAggregator:
                     raise _UnsafeFoldError() from e
                 return
             try:
-                ok_host = np.asarray(ok)  # acceptance sync (and fold barrier)
+                ok_host = np.asarray(ok)  # acceptance sync (and fold barrier)  # lint: sync-ok
             except BaseException as e:
                 raise _UnsafeFoldError() from e
             ticket.accepted = ok_host
@@ -523,7 +633,7 @@ class StreamingAggregator:
             staged = jax.device_put(payload, agg._batch_sharding)
             self._credit(staged, k)
             try:
-                jax.block_until_ready(staged)  # host buffer free to reuse
+                jax.block_until_ready(staged)  # host buffer free to reuse  # lint: sync-ok
             except BaseException as e:
                 # _credit already handed the count off: settled
                 raise _UnsafeFoldError(settled=True) from e
@@ -567,6 +677,8 @@ class StreamingAggregator:
         """Worker-side fold with the degradation ladder: streaming fold ->
         one synchronous retry (switching the pipeline to sync mode) ->
         sticky poison naming the batch and the original exception."""
+        if isinstance(item[0], _BatchJob):  # shard-parallel item
+            return self._process_shard(item)
         buf, payload, kind, k, ticket, seq = item
         agg_t0 = time.monotonic()
         outcome = "folded"
@@ -607,7 +719,14 @@ class StreamingAggregator:
         """Wait for every in-flight fold, then perform the ONE deferred
         acceptance sync: fetch all pending ``ok`` vectors, resolve their
         tickets, credit ``nb_models``. Returns the number of updates
-        accepted from deferred wire batches in this window."""
+        accepted from deferred wire batches in this window.
+
+        In shard-parallel mode this is the CROSS-SHARD BARRIER: every
+        shard queue drains, every shard's device folds complete, and the
+        per-shard accumulators reassemble into the aggregator's global
+        ``acc`` before anything reads it."""
+        if self._sharded:
+            return self._drain_sharded()
         self._queue.join()
         if self._error is not None:
             # the pipeline is poisoned — PERMANENTLY: once the degraded
@@ -670,6 +789,517 @@ class StreamingAggregator:
         if shorter > 0:
             overlap = (self._stage_seconds + self._fold_seconds - wall) / shorter
             OVERLAP_RATIO.set(max(0.0, min(1.0, overlap)))
+        if self._sharded:
+            for d in range(self._n_shards):
+                s, f = self._shard_stage_seconds[d], self._shard_fold_seconds[d]
+                sh = min(s, f)
+                if sh > 0:
+                    ov = (s + f - wall) / sh
+                    SHARD_OVERLAP.labels(shard=str(d)).set(max(0.0, min(1.0, ov)))
+                self._shard_stage_seconds[d] = 0.0
+                self._shard_fold_seconds[d] = 0.0
         self._stage_seconds = 0.0
         self._fold_seconds = 0.0
         self._window_start = None
+
+    # -- shard-parallel mode ----------------------------------------------
+    #
+    # One fold worker per mesh shard. The producer slices each padded
+    # batch once on the host into per-shard staging rings; the batch
+    # commits only when EVERY shard folded its slice (_BatchJob); drain()
+    # is the cross-shard barrier that reassembles the per-shard donated
+    # accumulators (shards.ShardPlan) into the aggregator's global acc.
+
+    def _ensure_plan(self, k: int, calib_staged):
+        """Resolve the fold kernel (racing XLA against the per-shard native
+        fold on the first real batch, exactly like the sequential path) and
+        build the shard plan. ``calib_staged`` lazily produces a full
+        staged planar ``[K, L, padded]`` (host or device) — only invoked
+        when an auto verdict is not already memoized for this shape."""
+        agg = self.agg
+        if agg.kernel_used is None:
+            agg._resolve_kernel_cheap(k)
+            if agg.kernel_used is None:
+                import jax
+
+                staged = calib_staged()
+                if not isinstance(staged, jax.Array):
+                    staged = jax.device_put(staged, agg._batch_sharding)
+                agg._resolve_kernel(staged)
+        if self._plan is None:
+            from .shards import ShardPlan
+
+            self._plan = ShardPlan(agg, shard_threads=self._shard_threads)
+        return self._plan
+
+    def _shard_ring(self, d: int) -> _StagingRing:
+        ring = self._shard_rings.get(d)
+        if ring is None:
+            agg = self.agg
+            width = agg.padded_length // self._n_shards
+            ring = self._shard_rings[d] = _StagingRing(
+                self.staging_buffers,
+                (self.max_batch, agg.n_limbs, width),
+                np.uint32,
+                gauge=SHARD_STAGING_DEPTH.labels(shard=str(d)),
+            )
+        return ring
+
+    def _ensure_shard_workers(self) -> None:
+        if self._shard_queues is None:
+            self._shard_queues = [
+                queue_mod.Queue(maxsize=self.dispatch_ahead)
+                for _ in range(self._n_shards)
+            ]
+            self._shard_workers = [None] * self._n_shards
+            for q in self._shard_queues:
+                # wake the worker if this pipeline is dropped without close()
+                weakref.finalize(self, q.put, _SHUTDOWN)
+        for i, q in enumerate(self._shard_queues):
+            w = self._shard_workers[i]
+            if w is None or not w.is_alive():
+                w = threading.Thread(
+                    target=_worker_main,
+                    args=(weakref.ref(self), q),
+                    name=f"xn-stream-fold-{i}",
+                    daemon=True,
+                )
+                self._shard_workers[i] = w
+                w.start()
+
+    def _join_shard_queues(self) -> None:
+        for q in self._shard_queues or []:
+            q.join()
+
+    def _poison(self, cause: BaseException, seq: int) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = cause
+                self._poison_seq = seq
+
+    def _submit_sharded_planar_stack(self, stack: np.ndarray, k: int) -> StreamTicket:
+        """Slice the wire batch ONCE on the host into the per-shard planar
+        rings (each shard's slice transposed straight into its ring buffer
+        — no full-planar intermediate) and dispatch one item per shard."""
+        ticket = StreamTicket(k)
+        agg = self.agg
+        model_len = agg.model_length
+
+        def calib():
+            full = np.zeros((k, agg.n_limbs, agg.padded_length), dtype=np.uint32)
+            full[:, :, :model_len] = stack.transpose(0, 2, 1)
+            return full
+
+        plan = self._ensure_plan(k, calib)
+        self._batch_seq += 1
+        job = _BatchJob("planar", k, ticket, self._batch_seq, self._n_shards)
+        items = []
+        for d, (lo, hi) in enumerate(plan.slices):
+            t0 = time.monotonic()
+            ring = self._shard_ring(d)
+            buf = ring.acquire()
+            view = buf[:k]
+            real_hi = min(hi, model_len)
+            if lo < real_hi:
+                view[:, :, : real_hi - lo] = stack[:, lo:real_hi, :].transpose(0, 2, 1)
+            if real_hi < hi:
+                view[:, :, max(0, real_hi - lo):] = 0  # padding columns
+            dt = time.monotonic() - t0
+            with self._lock:
+                self._stage_seconds += dt
+                self._shard_stage_seconds[d] += dt
+            items.append((job, d, view, ring, buf))
+        self._dispatch_sharded(job, items)
+        return ticket
+
+    def _submit_sharded_planar_rows(self, rows: list, k: int) -> StreamTicket:
+        """Per-shard staging of host planar ``[L, padded]`` rows (sliced
+        once per shard, copied into that shard's ring buffer)."""
+        ticket = StreamTicket(k)
+        plan = self._ensure_plan(k, lambda: np.stack([np.asarray(r) for r in rows]))  # host rows  # lint: sync-ok
+        self._batch_seq += 1
+        job = _BatchJob("planar", k, ticket, self._batch_seq, self._n_shards)
+        items = []
+        for d, (lo, hi) in enumerate(plan.slices):
+            t0 = time.monotonic()
+            ring = self._shard_ring(d)
+            buf = ring.acquire()
+            view = buf[:k]
+            for i, row in enumerate(rows):
+                np.copyto(view[i], row[:, lo:hi])
+            dt = time.monotonic() - t0
+            with self._lock:
+                self._stage_seconds += dt
+                self._shard_stage_seconds[d] += dt
+            items.append((job, d, view, ring, buf))
+        self._dispatch_sharded(job, items)
+        return ticket
+
+    def _dispatch_sharded(self, job: _BatchJob, items: list) -> None:
+        """Queue one item per shard worker — or, once degraded, fold every
+        shard on the caller's thread after a full queue barrier (same math,
+        no overlap; the batch still commits atomically)."""
+        with self._lock:
+            self._in_flight_models += job.k
+        BATCHES_TOTAL.labels(stage="staged").inc()
+        if not self._degraded:
+            self._ensure_shard_workers()
+            INFLIGHT_FOLDS.inc()
+            for item, q in zip(items, self._shard_queues):
+                SHARD_INFLIGHT.labels(shard=str(item[1])).inc()
+                q.put(item)
+            return
+        t0 = time.monotonic()
+        released = [False] * len(items)
+        try:
+            # serialize with the shard workers: batches queued BEFORE the
+            # degradation must land before caller-thread folds touch the
+            # per-shard accumulators
+            self._join_shard_queues()
+            if self._error is not None:
+                raise self._poison_error() from self._error
+            for i, (jb, d, payload, ring, buf) in enumerate(items):
+                try:
+                    self._fold_shard_item(jb, d, payload)
+                finally:
+                    if ring is not None:
+                        ring.release(buf)
+                    released[i] = True
+            with self._lock:
+                self.agg.nb_models += job.k
+                self._in_flight_models -= job.k
+        except StreamingError:
+            with self._lock:
+                self._in_flight_models -= job.k
+            BATCHES_TOTAL.labels(stage="failed").inc()
+            raise
+        except BaseException as e:
+            unsafe = isinstance(e, _UnsafeFoldError)
+            cause = (e.__cause__ or e) if unsafe else e
+            self._poison(cause, job.seq)
+            with self._lock:
+                self._in_flight_models -= job.k
+            BATCHES_TOTAL.labels(stage="failed").inc()
+            raise self._poison_error() from cause
+        finally:
+            for i, (_jb, _d, _p, ring, buf) in enumerate(items):
+                if not released[i] and ring is not None:
+                    ring.release(buf)
+            with self._lock:
+                self._fold_seconds += time.monotonic() - t0
+        BATCHES_TOTAL.labels(stage="folded").inc()
+
+    def _dispatch_sharded_wire(
+        self, ring: _StagingRing, buf, view, k: int, ticket: StreamTicket
+    ) -> StreamTicket:
+        """Wire batches keep ONE mesh unpack program (the psum-consistent
+        per-update validity mask of the sequential path — an update invalid
+        on ANY shard is excluded on EVERY shard) and fan only the fold out
+        to the per-shard workers: each worker folds its addressable shard
+        of the already-masked planar. Acceptance stays deferred: the ``ok``
+        vector rides in flight until drain's single sync."""
+        import jax
+
+        agg = self.agg
+        self._batch_seq += 1
+        seq = self._batch_seq
+        try:
+            staged = jax.device_put(view, agg._batch_bytes_sharding)
+            planar_mesh, ok = profiling.timed_kernel(
+                "wire_unpack",
+                staged.shape[0] * agg.padded_length,
+                lambda: agg._make_unpack_fn()(staged),
+            )
+            plan = self._ensure_plan(k, lambda: planar_mesh)
+        except BaseException as e:
+            ring.release(buf)
+            self._poison(e, seq)
+            BATCHES_TOTAL.labels(stage="failed").inc()
+            raise self._poison_error() from e
+        by_start = {
+            s.index[-1].start or 0: s.data for s in planar_mesh.addressable_shards
+        }
+        job = _BatchJob("wire", k, ticket, seq, self._n_shards)
+        job.staged = staged
+        job.global_release = (ring, buf)
+        with self._lock:
+            self._in_flight_models += k
+        BATCHES_TOTAL.labels(stage="staged").inc()
+        if self._degraded:
+            released = False
+            try:
+                self._join_shard_queues()
+                if self._error is not None:
+                    raise self._poison_error() from self._error
+                ok_host = np.asarray(ok)  # acceptance sync (degraded path)  # lint: sync-ok
+                ticket.accepted = ok_host
+                for d, (lo, _hi) in enumerate(plan.slices):
+                    self._fold_shard_item(job, d, by_start[lo])
+                jax.block_until_ready(staged)  # lint: sync-ok
+                ring.release(buf)
+                released = True
+                with self._lock:
+                    self.agg.nb_models += int(ok_host.sum())
+                    self._in_flight_models -= k
+            except StreamingError:
+                with self._lock:
+                    self._in_flight_models -= k
+                BATCHES_TOTAL.labels(stage="failed").inc()
+                raise
+            except BaseException as e:
+                unsafe = isinstance(e, _UnsafeFoldError)
+                cause = (e.__cause__ or e) if unsafe else e
+                self._poison(cause, seq)
+                with self._lock:
+                    self._in_flight_models -= k
+                BATCHES_TOTAL.labels(stage="failed").inc()
+                raise self._poison_error() from cause
+            finally:
+                if not released:
+                    ring.release(buf)
+            BATCHES_TOTAL.labels(stage="folded").inc()
+            return ticket
+        ticket._ok = ok
+        with self._lock:
+            self._pending.append(ticket)
+        self._ensure_shard_workers()
+        INFLIGHT_FOLDS.inc()
+        for d, (lo, _hi) in enumerate(plan.slices):
+            SHARD_INFLIGHT.labels(shard=str(d)).inc()
+            self._shard_queues[d].put((job, d, by_start[lo], None, None))
+        return ticket
+
+    def _fold_shard_item(self, job: _BatchJob, d: int, payload) -> None:
+        """Fold one shard's slice of one batch. The shard's accumulator is
+        reassigned only after the fold returns, so an exception here leaves
+        it consistent (the per-shard retry relies on that); failures after
+        the accumulator handoff raise ``_UnsafeFoldError``."""
+        plan = self._plan
+        if job.kind == "wire":
+            piece = payload
+            if plan.native:
+                # materialize THIS shard's slice of the unpack output (the
+                # host kernel reads host memory); other shards keep folding
+                piece = np.asarray(piece)  # lint: sync-ok
+            plan.fold_shard(d, piece)
+            return
+        if plan.native:
+            plan.fold_shard(d, payload)
+            return
+        import jax
+
+        with plan._device_dispatch_lock:
+            # host-side transfer enqueue only — the copy itself proceeds
+            # async and the barrier below stays outside the lock
+            staged = jax.device_put(payload, plan.devices[d])
+        plan.fold_shard(d, staged)
+        try:
+            # the per-shard transfer out of the ring buffer must complete
+            # before reuse; the fold itself stays in flight behind it
+            jax.block_until_ready(staged)  # lint: sync-ok
+        except BaseException as e:
+            raise _UnsafeFoldError() from e
+
+    def _retry_shard(self, job: _BatchJob, d: int, payload, first: BaseException) -> bool:
+        """Per-shard leg of the degradation ladder: the failed shard's
+        accumulator is provably untouched, so retry ITS slice once
+        synchronously (the other shards' slices of this batch fold
+        normally — the commit barrier keeps the accounting consistent) and
+        flip the whole pipeline to the sync path. A second failure loses
+        the batch and poisons permanently."""
+        logger.warning(
+            "streaming shard %d fold failed at batch %d (%s: %s); retrying on "
+            "this shard and degrading the pipeline",
+            d,
+            job.seq,
+            type(first).__name__,
+            first,
+        )
+        with self._lock:
+            self._degraded = True
+        DEGRADED.set(1)
+        DEGRADATIONS.inc()
+        job.retried = True
+        try:
+            self._fold_shard_item(job, d, payload)
+            return True
+        except BaseException as second:
+            unsafe = isinstance(second, _UnsafeFoldError)
+            cause = (second.__cause__ or second) if unsafe else second
+            cause.__context__ = first
+            self._poison(cause, job.seq)
+            logger.exception(
+                "streaming shard %d lost batch %d; pipeline poisoned", d, job.seq
+            )
+            return False
+
+    def _process_shard(self, item: tuple) -> None:
+        """One shard worker's fold of its slice of one batch, with the
+        per-shard degradation ladder and the cross-shard commit handoff."""
+        job, d, payload, ring, buf = item
+        t0 = time.monotonic()
+        failed = False
+        try:
+            with self._lock:
+                poisoned = self._error is not None
+            if poisoned:
+                # the pipeline is already lost: drop the fold (the shards
+                # are inconsistent either way), release resources fast
+                failed = True
+                return
+            try:
+                maybe_fail("streaming.fold")
+                maybe_fail(f"streaming.shard{d}.fold")
+                self._fold_shard_item(job, d, payload)
+            except BaseException as first:
+                if isinstance(first, _UnsafeFoldError):
+                    cause = first.__cause__ or first
+                    self._poison(cause, job.seq)
+                    failed = True
+                    logger.exception(
+                        "streaming shard %d fold of batch %d failed post-dispatch; "
+                        "pipeline poisoned",
+                        d,
+                        job.seq,
+                    )
+                else:
+                    failed = not self._retry_shard(job, d, payload, first)
+        finally:
+            if ring is not None:
+                ring.release(buf)
+            dt = time.monotonic() - t0
+            with self._lock:
+                self._shard_fold_seconds[d] += dt
+                # D workers run concurrently: credit the global fold leg
+                # 1/D of each worker's wall so the overlap ratio keeps its
+                # single-pipeline meaning
+                self._fold_seconds += dt / self._n_shards
+            SHARD_INFLIGHT.labels(shard=str(d)).dec()
+            self._shard_job_done(job, failed)
+
+    def _shard_job_done(self, job: _BatchJob, failed: bool) -> None:
+        """Per-batch commit barrier: the LAST shard to finish settles the
+        accounting — planar batches credit ``nb_models`` and leave flight
+        atomically (or just leave flight when the batch failed); wire
+        batches release the shared byte buffer once the mesh transfer
+        completed (their credit waits for drain's acceptance sync)."""
+        with self._lock:
+            if failed:
+                job.failed = True
+            job.remaining -= 1
+            last = job.remaining == 0
+            if last and job.kind == "planar":
+                self._in_flight_models -= job.k
+                if not job.failed:
+                    self.agg.nb_models += job.k
+        if not last:
+            return
+        if job.global_release is not None:
+            ring, buf = job.global_release
+            job.global_release = None
+            try:
+                if job.staged is not None and not job.failed:
+                    import jax
+
+                    # the wire bytes must be fully consumed by the mesh
+                    # before the host buffer recycles
+                    jax.block_until_ready(job.staged)  # lint: sync-ok
+            except BaseException as e:
+                self._poison(e, job.seq)
+                job.failed = True
+            finally:
+                job.staged = None
+                ring.release(buf)
+        INFLIGHT_FOLDS.dec()
+        outcome = (
+            "failed" if job.failed else ("folded-degraded" if job.retried else "folded")
+        )
+        BATCHES_TOTAL.labels(stage=outcome).inc()
+
+    def _fold_planar_rows_now_sharded(self, rows: list) -> None:
+        """Shard-parallel variant of :meth:`fold_planar_rows_now`: the rows
+        are already device-resident mesh-sharded planars, so each shard
+        folds its addressable piece of the stacked chunk on the CALLER's
+        thread (deliberately synchronous, same rationale as the
+        single-worker path: these rows already occupy device memory)."""
+        self._join_shard_queues()
+        if self._error is not None:
+            raise self._poison_error() from self._error
+        if self._closed:
+            raise StreamingError("pipeline is closed")
+        import jax
+        import jax.numpy as jnp
+
+        agg = self.agg
+        rows = list(rows)
+        plan = self._ensure_plan(
+            min(8, len(rows)), lambda: jnp.stack(rows[: min(8, len(rows))])
+        )
+        while rows:
+            piece, rows = rows[:8], rows[8:]
+            # pin the stacked chunk to the batch sharding: jnp.stack of
+            # sharded rows does not guarantee the model-axis layout, and
+            # the per-shard fan-out below reads addressable shards by their
+            # column start
+            stacked = jax.device_put(jnp.stack(piece), agg._batch_sharding)
+            n_piece = len(piece)
+            del piece
+            if plan.native:
+                full = np.asarray(stacked)  # lint: sync-ok
+                for d in range(plan.n_shards):
+                    plan.fold_shard_slice(d, full)
+            else:
+                by_start = {
+                    s.index[-1].start or 0: s.data for s in stacked.addressable_shards
+                }
+                for d, (lo, _hi) in enumerate(plan.slices):
+                    plan.fold_shard(d, by_start[lo])
+            with self._lock:
+                agg.nb_models += n_piece
+
+    def _drain_sharded(self) -> int:
+        """The cross-shard barrier: every shard queue drains, the one
+        deferred acceptance sync resolves the pending wire tickets, every
+        shard's in-flight device folds complete, and the per-shard
+        accumulators reassemble into the aggregator's global ``acc``."""
+        self._join_shard_queues()
+        if self._error is not None:
+            with self._lock:
+                stale, self._pending = self._pending, []
+                self._in_flight_models -= sum(t.k for t in stale)
+            for ticket in stale:
+                ticket._ok = None
+            raise self._poison_error() from self._error
+        with self._lock:
+            pending, self._pending = self._pending, []
+        accepted = 0
+        try:
+            for ticket in pending:
+                ok_host = np.asarray(ticket._ok)
+                ticket._ok = None
+                ticket.accepted = ok_host
+                accepted += int(ok_host.sum())
+            if self._plan is not None:
+                # per-shard completion barrier (device folds dispatch
+                # asynchronously; their errors surface here, not in the
+                # workers)
+                self._plan.block_until_ready()
+        except Exception as e:
+            with self._lock:
+                self._error = e
+                self._in_flight_models -= sum(t.k for t in pending)
+            for ticket in pending:
+                ticket._ok = None
+            raise self._poison_error() from e
+        if pending:
+            with self._lock:
+                self.agg.nb_models += accepted
+                self._in_flight_models -= sum(t.k for t in pending)
+        if self._plan is not None:
+            # publish the per-shard accumulators back as the global acc;
+            # the next submit re-decomposes (zero-copy for device plans)
+            self.agg.acc = self._plan.reassemble()
+            self._plan.close()
+            self._plan = None
+        self._publish_overlap()
+        return accepted

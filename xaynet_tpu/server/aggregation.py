@@ -44,6 +44,8 @@ class StagedAggregator:
         kernel: str = "auto",
         dispatch_ahead: int = 2,
         staging_buffers: int = 3,
+        shard_parallel: bool = True,
+        shard_threads: int = 0,
     ):
         self.config = config
         self.object_size = object_size
@@ -63,12 +65,17 @@ class StagedAggregator:
             from ..parallel.streaming import StreamingAggregator
 
             self._device = ShardedAggregator(config.vect, object_size, mesh=mesh, kernel=kernel)
-            # flush() submits micro-batches here; drain()/finalize() sync
+            # flush() submits micro-batches here; drain()/finalize() sync.
+            # On a multi-device mesh the pipeline runs shard-parallel (one
+            # fold worker per device, per-shard staging rings + donated
+            # accumulators) unless [aggregation] shard_parallel = false
             self._stream = StreamingAggregator(
                 self._device,
                 staging_buffers=staging_buffers,
                 dispatch_ahead=dispatch_ahead,
                 max_batch=self.batch_size,
+                shard_parallel=shard_parallel,
+                shard_threads=shard_threads,
             )
             # tiny unit part stays on host
             self._unit_acc = np.zeros(

@@ -72,6 +72,8 @@ class UpdatePhase(PhaseState):
             kernel=settings.aggregation.kernel,
             dispatch_ahead=settings.aggregation.dispatch_ahead,
             staging_buffers=settings.aggregation.staging_buffers,
+            shard_parallel=settings.aggregation.shard_parallel,
+            shard_threads=settings.aggregation.shard_threads,
         )
         self._seed_dict = None
         self._resumed_models = 0

@@ -203,6 +203,9 @@ class RestServer:
                     payload["ingest"] = ingest
                     if ingest["saturated"]:
                         payload["status"] = "saturated"
+                streaming = self._streaming_health()
+                if streaming is not None:
+                    payload["pipeline"] = streaming
                 if self.health_extra is not None:
                     # role-specific sections (the edge runner reports its
                     # upstream link + envelope backlog here); an extra
@@ -220,6 +223,41 @@ class RestServer:
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)
             return 500, str(err).encode(), "text/plain"
+
+    def _streaming_health(self) -> dict | None:
+        """The streaming-fold ``pipeline`` section of /healthz, read from
+        the telemetry registry (no jax import on the REST path): the
+        global pipeline gauges plus, for shard-parallel folds, the
+        per-shard staging depth / in-flight folds / overlap ratio keyed by
+        shard index. ``None`` when no streaming pipeline ever ran in this
+        process (host aggregation) — the section simply doesn't appear."""
+        depth = self.registry.sample_value("xaynet_streaming_staging_depth")
+        if depth is None:
+            return None
+        reg = self.registry
+        section = {
+            "staging_depth": depth,
+            "inflight_folds": reg.sample_value("xaynet_streaming_inflight_folds") or 0,
+            "overlap_ratio": reg.sample_value("xaynet_streaming_overlap_ratio") or 0.0,
+            "degraded": bool(reg.sample_value("xaynet_streaming_degraded") or 0),
+        }
+        shards: dict[str, dict] = {}
+        for metric, field in (
+            ("xaynet_streaming_shard_staging_depth", "staging_depth"),
+            ("xaynet_streaming_shard_inflight_folds", "inflight_folds"),
+            ("xaynet_streaming_shard_overlap_ratio", "overlap_ratio"),
+        ):
+            family = reg.get(metric)
+            if family is None:
+                continue
+            for key, child in family.children():
+                shards.setdefault(key[0], {})[field] = child.value
+        if shards:
+            section["shards"] = {
+                k: shards[k]
+                for k in sorted(shards, key=lambda s: int(s) if s.isdigit() else -1)
+            }
+        return section
 
     async def _edge_route(self, method: str, path: str, body: bytes, headers: dict):
         """Edge-tier endpoints (served only with ``[edge] enabled = true``).

@@ -186,6 +186,16 @@ class AggregationSettings:
     # batch N+1 stages into one while batch N folds — >= dispatch_ahead + 1
     # for full overlap, minimum 2
     staging_buffers: int = 3
+    # shard-parallel streaming fold (device=True on a multi-device mesh):
+    # one fold worker per mesh device with per-shard staging rings and
+    # donated per-shard accumulators; drain() is the cross-shard barrier.
+    # false forces the legacy single FIFO fold worker (the mesh-sharded
+    # single-program fold); single-device meshes ignore the flag
+    shard_parallel: bool = True
+    # per-shard native fold thread budget (native-u64 kernel only): 0
+    # splits the process-wide budget (XAYNET_NATIVE_THREADS / 2x cores)
+    # across the shards; > 0 pins threads per shard
+    shard_threads: int = 0
     # device wire ingest (requires device=true): Update masked models are
     # parsed LAZILY (raw element block kept), and unpack + per-update
     # element validity + fold all run on the accelerator — the coordinator
@@ -431,6 +441,8 @@ class Settings:
             )
         if self.aggregation.wire_ingest and not self.aggregation.device:
             raise SettingsError("aggregation.wire_ingest requires aggregation.device = true")
+        if self.aggregation.shard_threads < 0:
+            raise SettingsError("aggregation.shard_threads must be >= 0 (0 = auto split)")
 
     @classmethod
     def default(cls) -> "Settings":
@@ -573,6 +585,12 @@ class Settings:
                     agg_raw.get("staging_buffers", base.aggregation.staging_buffers)
                 ),
                 wire_ingest=bool(agg_raw.get("wire_ingest", base.aggregation.wire_ingest)),
+                shard_parallel=bool(
+                    agg_raw.get("shard_parallel", base.aggregation.shard_parallel)
+                ),
+                shard_threads=int(
+                    agg_raw.get("shard_threads", base.aggregation.shard_threads)
+                ),
             ),
             ingest=IngestSettings(
                 enabled=bool(ingest_raw.get("enabled", base.ingest.enabled)),

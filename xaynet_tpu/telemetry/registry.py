@@ -224,6 +224,14 @@ class MetricFamily:
             raise MetricError(f"{self.name} has labels {self.labelnames}; use .labels(...)")
         return self._children[()]
 
+    def children(self) -> list[tuple[tuple[str, ...], object]]:
+        """Snapshot of the labeled children as ``(labelvalues, child)``
+        pairs, sorted by label values — the public enumeration surface for
+        readers that aggregate a family (/healthz sections, round
+        reports), so they never touch the internal storage layout."""
+        with self._lock:
+            return sorted(self._children.items())
+
     # unlabeled convenience proxies ----------------------------------------
 
     def inc(self, amount: float = 1.0) -> None:

@@ -25,6 +25,32 @@ from . import profiling
 logger = logging.getLogger("xaynet.telemetry")
 
 
+def _streaming_snapshot() -> Optional[dict]:
+    """Streaming-fold pipeline state for the round report, read from the
+    registry gauges (None when no streaming pipeline ever ran — host-mode
+    coordinators don't grow an empty section): the pipeline overlap ratio,
+    degraded flag, and — for shard-parallel folds — the per-shard overlap
+    ratios keyed by shard index."""
+    from .registry import get_registry
+
+    reg = get_registry()
+    overlap = reg.sample_value("xaynet_streaming_overlap_ratio")
+    if overlap is None:
+        return None
+    out = {
+        "overlap_ratio": round(overlap, 4),
+        "degraded": bool(reg.sample_value("xaynet_streaming_degraded") or 0),
+    }
+    family = reg.get("xaynet_streaming_shard_overlap_ratio")
+    if family is not None:
+        shards = {
+            key[0]: round(child.value, 4) for key, child in family.children()
+        }
+        if shards:
+            out["shard_overlap_ratio"] = shards
+    return out
+
+
 class RoundReporter:
     """Accumulates one round's telemetry and writes it as a JSON line."""
 
@@ -94,6 +120,9 @@ class RoundReporter:
             "kernels": profiling.drain_round_stats(),
             "events": self._events,
         }
+        streaming = _streaming_snapshot()
+        if streaming is not None:
+            report["streaming"] = streaming
         self.last_report = report
         if self.path:
             # a bad report path must never take the coordinator down: the

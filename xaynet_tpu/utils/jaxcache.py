@@ -1,0 +1,41 @@
+"""Persistent-XLA-cache policy shared by every bench entry point.
+
+On CPU the persistent compilation cache is a net negative for this fleet:
+the shared-container hosts migrate between machine types, so a cached CPU
+executable regularly fails XLA's machine-feature check and every load
+spews the multi-KB "CPU compilation doesn't match the machine type ...
+could lead to execution errors such as SIGILL" warning over the bench
+tail, while CPU kernels recompile in seconds anyway. Merely *not
+enabling* the cache is not enough — the image's sitecustomize (or an
+inherited ``JAX_COMPILATION_CACHE_DIR``) can switch it on before the
+bench runs — so this helper ACTIVELY disables it. Accelerator backends
+keep their cache (a brief tunnel-up window must not be spent recompiling
+kernels a previous capture already built).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def silence_cpu_cache(jax) -> bool:
+    """Disable the persistent XLA compilation cache when the backend is
+    CPU. Call right after importing jax (and pinning the platform), before
+    the first compile. Returns True when the cache was disabled. Never
+    raises — cache policy is an optimization, not a failure mode."""
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+    except Exception:
+        return False
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+    except Exception:
+        # very old/new jax without the master switch: clearing the cache
+        # dir reaches the same end
+        try:
+            jax.config.update("jax_compilation_cache_dir", "")
+        except Exception:
+            return False
+    return True

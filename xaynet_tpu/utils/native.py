@@ -18,7 +18,7 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -95,6 +95,24 @@ def load() -> Optional[ctypes.CDLL]:
         lib.xn_fold_planar_u64.restype = None
         lib.xn_fold_wire_u64.argtypes = list(lib.xn_fold_planar_u64.argtypes)
         lib.xn_fold_wire_u64.restype = None
+        # strided slice fold: pointers pre-offset to the slice start, plane
+        # and batch strides in ELEMENTS, explicit per-call thread budget
+        lib.xn_fold_planar_u64_strided.argtypes = [
+            u32p,
+            u32p,
+            u32p,
+            ctypes.c_uint64,  # width
+            ctypes.c_uint64,  # acc/out plane stride
+            ctypes.c_uint64,  # stack row (limb-plane) stride
+            ctypes.c_uint64,  # stack batch (update) stride
+            ctypes.c_uint32,  # n_limbs
+            ctypes.c_uint64,  # k
+            u32p,
+            ctypes.c_uint32,  # n_threads (0 = process default)
+        ]
+        lib.xn_fold_planar_u64_strided.restype = None
+        lib.xn_fold_threads.argtypes = []
+        lib.xn_fold_threads.restype = ctypes.c_uint32
         lib.xn_mod_sub.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_mod_sub.restype = None
         lib.xn_decode_f64.argtypes = [
@@ -174,3 +192,13 @@ def np_u8p(arr):
 
 def np_u32p(arr):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def np_u32p_at(arr, element_offset: int):
+    """Pointer to ``arr``'s buffer offset by ``element_offset`` uint32
+    elements — how the strided slice kernels address one shard's column
+    slice of a larger C-contiguous array without materializing a copy."""
+    return ctypes.cast(
+        ctypes.c_void_p(arr.ctypes.data + 4 * element_offset),
+        ctypes.POINTER(ctypes.c_uint32),
+    )
