@@ -424,6 +424,93 @@ def main() -> None:
         except Exception as e:  # diagnostics must never sink the headline
             print(f"streaming_vs_sync unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # --- sim headline: whole federated rounds as ONE jitted program -------
+    # A genuinely different workload from the fold headline above: per-
+    # participant ChaCha mask derivation + masked-model generation +
+    # aggregation + sum-mask reconstruction + unmask, all in-graph
+    # (xaynet_tpu/sim/, DESIGN §13), measured end-to-end (host fixed-point
+    # encode/decode included) in simulated participants per second. The
+    # series identity is (model size, participants, block, mesh) — a
+    # population-shape change starts a NEW series for tools/bench_gate.py.
+    sim_out = None
+    try:
+        from fractions import Fraction
+
+        from xaynet_tpu.parallel.mesh import make_mesh
+        from xaynet_tpu.sim import SimRound, SimSpec, seeds_for
+
+        sim_len, sim_p, sim_block = 1000, 2048, 256
+        sim_cfg = config.pair()
+        sim_seeds = seeds_for(sim_p, root=42)
+        sim_rng = np.random.default_rng(42)
+        sim_weights = sim_rng.uniform(-1, 1, (sim_p, sim_len)).astype(np.float32)
+        sim_scalar = Fraction(1, sim_p)
+        sim_legs = {}
+        meshes = {1: None}
+        if n_dev > 1:
+            # unlike the mesh8 FOLD leg (deliberately CPU-only: its point
+            # is the virtual-mesh production path), the sim mesh leg runs
+            # on real accelerators too — that is the only place the
+            # participant-axis sharding story produces a meaningful number
+            meshes[n_dev] = make_mesh()
+        for mesh_size, mesh in meshes.items():
+            simr = SimRound(SimSpec(sim_cfg, sim_len, block_size=sim_block), mesh=mesh)
+            simr.run(sim_seeds, sim_weights, scalar=sim_scalar)  # compile + warm
+            pps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                simr.run(sim_seeds, sim_weights, scalar=sim_scalar)
+                pps.append(sim_p / (time.perf_counter() - t0))
+            sim_legs[mesh_size] = {
+                "value": round(float(np.median(pps)), 2),
+                "unit": "participants/s",
+                "model_len": sim_len,
+                "participants": sim_p,
+                "block": sim_block,
+                "mesh": mesh_size,
+                "spread": {
+                    "median_of": 3,
+                    "min": round(min(pps), 2),
+                    "max": round(max(pps), 2),
+                },
+            }
+            print(
+                f"sim round (mesh={mesh_size}): {sim_legs[mesh_size]['value']:.2f} "
+                f"participants/s @n={sim_len} P={sim_p} block={sim_block}",
+                file=sys.stderr,
+            )
+        sim_out = sim_legs
+        # the sim series appends to BENCH_HISTORY.jsonl directly (same
+        # contract as the mesh8 fold series: the driver only captures the
+        # single fold-headline JSON line)
+        try:
+            hist = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+            )
+            # the gate follows the LATEST record's series per family: append
+            # the single-device leg last so the default sim gate tracks the
+            # leg that is meaningful on every host (the mesh leg only says
+            # something on real devices)
+            with open(hist, "a") as f:
+                for mesh_size, leg in sorted(sim_legs.items(), reverse=True):
+                    record = {
+                        "ts": time.time(),
+                        "source": "bench.py:sim",
+                        "parsed": {
+                            "metric": (
+                                f"sim round throughput @{sim_len} params "
+                                "(in-graph federated round)"
+                            ),
+                            "platform": platform,
+                            **leg,
+                        },
+                    }
+                    f.write(json.dumps(record) + "\n")
+        except Exception as e:  # history append must never sink the bench
+            print(f"BENCH_HISTORY sim append failed: {e}", file=sys.stderr)
+    except Exception as e:  # the sim leg must never sink the fold headline
+        print(f"sim leg unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+
     # scale CPU smoke runs to the 25M-param metric so the number is comparable
     scale = model_len / 25_000_000
     scaled_ups = ups * scale
@@ -469,6 +556,7 @@ def main() -> None:
                 "shard_threads": shard_threads,
                 "streaming_vs_sync": streaming_vs_sync,
                 "mesh8": mesh8_out,
+                "sim": sim_out,
                 "spread": {
                     "median_of": reps,
                     "min": round(min(rep_ups) * scale, 2),

@@ -174,3 +174,87 @@ def test_gate_mesh8_series_is_gated_independently(tmp_path, capsys):
     )
     path = _write(tmp_path, base + [first_mesh, regressed])
     assert _run(path) == 1  # 20 < 34 * 0.9, within the mesh=8 series
+
+
+# --- sim headline family (participants/s) -----------------------------------
+
+
+SIM_METRIC = "sim round throughput @1000 params (in-graph federated round)"
+
+
+def _sim_rec(ts, value, metric=SIM_METRIC, **config):
+    parsed = {"metric": metric, "value": value, "unit": "participants/s"}
+    parsed.update(config)
+    return {"ts": ts, "parsed": parsed}
+
+
+def test_sim_series_gates_independently_of_fold_headline(tmp_path):
+    """A healthy fold headline must not mask a sim regression (and vice
+    versa): the two families gate as separate series in one default run."""
+    fold_ok = [_rec(1, 30.0), _rec(2, 31.0)]
+    sim_ok = [
+        _sim_rec(3, 500.0, participants=2048, block=256, mesh=1),
+        _sim_rec(4, 520.0, participants=2048, block=256, mesh=1),
+    ]
+    assert _run(_write(tmp_path, fold_ok + sim_ok)) == 0
+
+    sim_bad = _sim_rec(5, 100.0, participants=2048, block=256, mesh=1)
+    assert _run(_write(tmp_path, fold_ok + sim_ok + [sim_bad])) == 1
+
+    # and a fold regression still fails even with a healthy sim series
+    fold_bad = _rec(6, 10.0)
+    assert _run(_write(tmp_path, fold_ok + sim_ok + [fold_bad])) == 1
+
+
+def test_sim_population_shape_change_is_a_new_series(tmp_path, capsys):
+    """participants/block/mesh are series identity for the sim headline —
+    doubling the population is a different experiment, not a regression."""
+    path = _write(
+        tmp_path,
+        [
+            _sim_rec(1, 500.0, participants=2048, block=256, mesh=1),
+            _sim_rec(2, 180.0, participants=8192, block=512, mesh=1),
+        ],
+    )
+    assert _run(path) == 0
+    assert "NEW series" in capsys.readouterr().err
+
+
+def test_explicit_metric_prefix_gates_single_family(tmp_path):
+    """--metric-prefix keeps the old single-family behavior: a sim
+    regression is invisible when only the fold family is requested."""
+    records = [
+        _rec(1, 30.0),
+        _rec(2, 31.0),
+        _sim_rec(3, 500.0, participants=2048, block=256),
+        _sim_rec(4, 100.0, participants=2048, block=256),
+    ]
+    path = _write(tmp_path, records)
+    assert _run(path, "--metric-prefix", bench_gate.HEADLINE_PREFIX) == 0
+    assert (
+        _run(path, "--metric-prefix", bench_gate.SIM_PREFIX, "--unit", "participants/s")
+        == 1
+    )
+
+
+def test_metric_prefix_infers_unit_for_known_families(tmp_path):
+    """A bare --metric-prefix for the sim family must infer participants/s
+    (not fall back to updates/s, match nothing, and soft-pass a regression)."""
+    records = [
+        _sim_rec(1, 500.0, participants=2048, block=256),
+        _sim_rec(2, 100.0, participants=2048, block=256),
+    ]
+    path = _write(tmp_path, records)
+    assert _run(path, "--metric-prefix", bench_gate.SIM_PREFIX) == 1
+
+
+def test_unknown_metric_prefix_without_unit_is_an_error(tmp_path):
+    """An unknown family must demand --unit, not silently default to
+    updates/s, match zero records, and soft-pass a regression."""
+    import pytest
+
+    path = _write(tmp_path, [_rec(1, 10.0, metric="long-haul soak", unit="rounds/s")])
+    with pytest.raises(SystemExit) as exc:
+        _run(path, "--metric-prefix", "long-haul soak")
+    assert exc.value.code == 2  # argparse usage error
+    assert _run(path, "--metric-prefix", "long-haul soak", "--unit", "rounds/s") == 0

@@ -93,3 +93,20 @@ def test_shakespeare_lstm_example_smoke():
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
     assert "eval loss" in r.stdout
+
+
+def test_sim_quickstart_example_smoke():
+    """The sim quickstart (DESIGN §13) runs a whole-round program twice and
+    must report exactly one program invocation per round."""
+    r = _run_example(
+        [
+            "examples/sim_quickstart.py",
+            "-p", "64",
+            "-l", "50",
+            "-b", "16",
+            "--rounds", "2",
+        ]
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    assert "program invocations: 2" in r.stdout
+    assert "participants/s" in r.stdout

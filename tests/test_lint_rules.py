@@ -313,3 +313,53 @@ def test_parallel_tree_is_clean_under_sync_rule():
     for path in sorted(target.rglob("*.py")):
         problems.extend(xn_lint.check_file(path))
     assert problems == []
+
+
+def test_host_roundtrip_rejected_in_sim_program_bodies(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "from xaynet_tpu.ops import limbs as host_limbs\n"
+        "def _prog_round(x):\n"
+        "    a = np.asarray(x)\n"
+        "    b = host_limbs.limbs_to_int(a)\n"
+        "    c = int(b)\n"
+        "    d = x.block_until_ready()\n"
+        "    e = x.item()\n"
+        "    return a, b, c, d, e\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/sim/foo.py", source)
+    assert sum("host round-trip in sim program body" in p for p in problems) == 5
+
+
+def test_host_roundtrip_allowlist_and_host_boundary_pass(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "from xaynet_tpu.ops import limbs as host_limbs\n"
+        "def _prog_round(x):\n"
+        "    return np.asarray(x)  # lint: sync-ok\n"
+        "def run(x):\n"
+        "    # the host boundary lives outside _prog* bodies\n"
+        "    v = np.asarray(x)\n"
+        "    return host_limbs.limbs_to_int(v)\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/sim/foo.py", source)
+    assert not any("host round-trip" in p for p in problems)
+
+
+def test_sim_roundtrip_rule_scoped_to_sim_tree(tmp_path, monkeypatch):
+    source = (
+        "import numpy as np\n"
+        "def _prog_round(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    for rel in ("xaynet_tpu/ops/foo.py", "xaynet_tpu/server/foo.py", "tools/foo.py"):
+        problems = _check(tmp_path, monkeypatch, rel, source)
+        assert not any("host round-trip" in p for p in problems), rel
+
+
+def test_sim_tree_is_clean_under_roundtrip_rule():
+    target = REPO / "xaynet_tpu" / "sim"
+    problems = []
+    for path in sorted(target.rglob("*.py")):
+        problems.extend(xn_lint.check_file(path))
+    assert problems == []

@@ -1,0 +1,99 @@
+"""The differential oracle: sim round vs the in-process production server.
+
+Acceptance property (ISSUE 8 / DESIGN §13): for seeded
+(mask config x model size x participant count) combinations, the sim
+round's unmasked global model is BYTE-identical to the production round
+with the same injected mask seeds — on a single device and on the
+8-virtual-device CPU mesh. The production leg is the real coordinator
+state machine + SDK participant FSMs; only the transport is in-process.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.mask.config import GroupType
+from xaynet_tpu.parallel.mesh import make_mesh
+from xaynet_tpu.sim import OracleCase, OracleMismatch, run_oracle_case, run_production_round
+from xaynet_tpu.sim.oracle import run_sim_round
+
+# three seeded combinations, one per finite-group family, distinct model
+# sizes and populations (the nightly sweep in tools/sim_check.py walks a
+# larger menu)
+CASES = [
+    OracleCase(group_type=GroupType.INTEGER, model_length=13, n_update=3, seed=101, block_size=2),
+    OracleCase(group_type=GroupType.PRIME, model_length=37, n_update=4, seed=202, block_size=4),
+    OracleCase(group_type=GroupType.POWER2, model_length=64, n_update=5, seed=303, block_size=3),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.describe())
+def test_sim_matches_production_round_single_device_and_mesh(case):
+    production = run_production_round(case)
+    report = run_oracle_case(case, production_model=production)
+    assert report.identical and report.max_abs_diff == 0.0
+    assert report.production_sha == report.sim_sha
+
+    if len(jax.devices()) > 1:
+        mesh_report = run_oracle_case(case, mesh=make_mesh(), production_model=production)
+        assert mesh_report.identical
+        assert mesh_report.legs["mesh"] == len(jax.devices())
+
+
+def test_oracle_detects_divergence():
+    """A corrupted production model must trip OracleMismatch — the oracle
+    is only worth its name if it actually fails on a byte flip."""
+    case = CASES[0]
+    sim_model = run_sim_round(case).global_model
+    corrupted = sim_model.copy()
+    corrupted[0] = np.nextafter(corrupted[0], np.inf)  # single-ULP flip
+    with pytest.raises(OracleMismatch, match="diverged"):
+        run_oracle_case(case, production_model=corrupted)
+
+
+def test_mask_seed_injection_surface():
+    """PetSettings.mask_seed: validated, serialized, and optional."""
+    from xaynet_tpu.core.crypto.sign import SigningKeyPair
+    from xaynet_tpu.sdk.state_machine import PetSettings
+
+    keys = SigningKeyPair.derive_from_seed(b"\x01" * 32)
+    with pytest.raises(ValueError, match="32 bytes"):
+        PetSettings(keys=keys, mask_seed=b"short")
+    s = PetSettings(keys=keys, mask_seed=b"\x07" * 32)
+    assert s.mask_seed == b"\x07" * 32
+    assert PetSettings(keys=keys).mask_seed is None
+
+
+def test_mask_seed_survives_save_restore():
+    from xaynet_tpu.core.crypto.sign import SigningKeyPair
+    from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine
+    from xaynet_tpu.sdk.traits import ModelStore, XaynetClient
+
+    class _NullStore(ModelStore):
+        async def load_model(self):
+            return None
+
+    class _NullClient(XaynetClient):
+        async def get_round_params(self):
+            raise NotImplementedError
+
+        async def get_sums(self):
+            raise NotImplementedError
+
+        async def get_seeds(self, pk):
+            raise NotImplementedError
+
+        async def get_model(self):
+            raise NotImplementedError
+
+        async def send_message(self, data):
+            raise NotImplementedError
+
+    keys = SigningKeyPair.derive_from_seed(b"\x02" * 32)
+    sm = StateMachine(
+        PetSettings(keys=keys, mask_seed=b"\x09" * 32), _NullClient(), _NullStore()
+    )
+    restored = StateMachine.restore(sm.save(), _NullClient(), _NullStore())
+    assert restored.mask_seed == b"\x09" * 32

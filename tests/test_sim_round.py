@@ -1,0 +1,290 @@
+"""xaynet_tpu/sim: the in-graph federated round program.
+
+Golden-vector coverage pins the batched/vmap-compatible ops entry points
+(in-graph ChaCha rejection sampling, cursor handoff, batched mask
+derivation, population encode) byte-identical to the scalar
+``core/mask/*`` reference path; the round-level tests pin ``SimRound``
+byte-identical to the production host aggregation
+(``Masker``/``Aggregation``/``unmask_array``) across block shapes, fused
+and re-derived sum-mask phases, and the multi-device mesh.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xaynet_tpu.core.crypto.prng import StreamSampler
+from xaynet_tpu.core.mask.config import (
+    BoundType,
+    DataType,
+    GroupType,
+    MaskConfig,
+    ModelType,
+)
+from xaynet_tpu.core.mask.encode import clamp_scalar, encode_unit, encode_vect_limbs
+from xaynet_tpu.core.mask.masking import Aggregation, Masker
+from xaynet_tpu.core.mask.model import Scalar
+from xaynet_tpu.core.mask.seed import MaskSeed
+from xaynet_tpu.ops import chacha_jax, limbs as host_limbs
+from xaynet_tpu.ops.masking_jax import (
+    derive_mask_limbs_batch,
+    encode_models_batch,
+    seed_words,
+)
+from xaynet_tpu.parallel.mesh import make_mesh
+from xaynet_tpu.sim import SimRound, SimSpec, seeds_for
+
+CFG_INT = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3).pair()
+
+GROUPS = [GroupType.INTEGER, GroupType.PRIME, GroupType.POWER2]
+
+
+def _pair(group_type) -> "MaskConfig":
+    return MaskConfig(group_type, DataType.F32, BoundType.B0, ModelType.M3).pair()
+
+
+def _host_reference(cfg_pair, seeds, weights, scalar):
+    """The production host path: mask every model, aggregate, reconstruct
+    the sum mask, unmask — the function the sim must reproduce exactly."""
+    p, n = weights.shape
+    model_agg = Aggregation(cfg_pair, n)
+    mask_agg = Aggregation(cfg_pair, n)
+    for i in range(p):
+        masker = Masker(cfg_pair, seed=MaskSeed(seeds[i]))
+        seed, masked = masker.mask(Scalar.from_fraction(scalar), weights[i])
+        model_agg.validate_aggregation(masked)
+        model_agg.aggregate(masked)
+        mask = seed.derive_mask(n, cfg_pair)
+        mask_agg.validate_aggregation(mask)
+        mask_agg.aggregate(mask)
+    return np.asarray(model_agg.unmask_array(mask_agg.object), dtype=np.float64)
+
+
+# --- golden vectors: ops entry points vs the scalar reference ---------------
+
+
+def test_rolled_keystream_is_bit_identical_to_unrolled():
+    kw = jnp.asarray(np.frombuffer(np.random.default_rng(1).bytes(32), "<u4"))
+    a = chacha_jax.keystream_words(kw, jnp.uint32(7), 19)
+    b = chacha_jax.keystream_words_rolled(kw, jnp.uint32(7), 19)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("group_type", GROUPS)
+def test_ingraph_derivation_matches_stream_sampler_with_cursor_handoff(group_type):
+    """The in-graph unit draw, its byte-cursor handoff, and the vector
+    draw from that cursor — all bit-identical to the host sampler, with
+    deliberately tiny chunks so the while_loop runs multiple trips."""
+    import jax
+
+    cfg = _pair(group_type)
+    order_u, order_v = cfg.unit.order, cfg.vect.order
+    rng = np.random.default_rng(11)
+    seeds = [rng.bytes(32) for _ in range(3)]
+    count = 29
+
+    for seed in seeds:
+        smp = StreamSampler(seed)
+        ref_unit = smp.draw_limbs(1, order_u)[0]
+        ref_off = smp.consumed_bytes
+        ref_vect = smp.draw_limbs(count, order_v)
+
+        kw = jnp.asarray(np.frombuffer(seed, "<u4"))
+        unit, off = jax.jit(
+            lambda k: chacha_jax.derive_uniform_limbs_ingraph(k, jnp.int32(0), 1, order_u, 8)
+        )(kw)
+        assert np.array_equal(np.asarray(unit)[0], ref_unit)
+        assert int(off) == ref_off
+        vect, _ = jax.jit(
+            lambda k, o: chacha_jax.derive_uniform_limbs_ingraph(k, o, count, order_v, 16)
+        )(kw, off)
+        assert np.array_equal(np.asarray(vect), ref_vect)
+
+
+@pytest.mark.parametrize("group_type", GROUPS)
+def test_batched_mask_derivation_golden(group_type):
+    """derive_mask_limbs_batch rows == MaskSeed.derive_mask, byte for byte."""
+    cfg = _pair(group_type)
+    rng = np.random.default_rng(5)
+    seeds = [rng.bytes(32) for _ in range(5)]
+    n = 41
+    units, vects = derive_mask_limbs_batch(seeds, n, cfg)
+    units, vects = np.asarray(units), np.asarray(vects)
+    for i, s in enumerate(seeds):
+        ref = MaskSeed(s).derive_mask(n, cfg)
+        assert np.array_equal(units[i], ref.unit.data), f"unit row {i}"
+        assert np.array_equal(vects[i], ref.vect.data), f"vect row {i}"
+
+
+def test_encode_models_batch_golden():
+    """Population encode rows == the per-participant production encode."""
+    cfg = CFG_INT
+    rng = np.random.default_rng(6)
+    weights = rng.uniform(-1, 1, (4, 23)).astype(np.float32)
+    scalar = Fraction(1, 4)
+    unit, vect = encode_models_batch(weights, scalar, cfg)
+    s_clamped = clamp_scalar(scalar, cfg.unit)
+    for i in range(4):
+        ref = encode_vect_limbs(weights[i], s_clamped, cfg.vect)
+        assert np.array_equal(vect[i], ref), f"row {i}"
+    ref_unit_int = encode_unit(s_clamped, cfg.unit)
+    n_limb_u = host_limbs.n_limbs_for_order(cfg.unit.order)
+    assert np.array_equal(unit, host_limbs.int_to_limbs(ref_unit_int, n_limb_u))
+    with pytest.raises(ValueError):
+        encode_models_batch(weights[0], scalar, cfg)  # 1-D input
+
+
+def test_seed_words_roundtrip():
+    rng = np.random.default_rng(7)
+    seeds = [rng.bytes(32) for _ in range(3)]
+    words = seed_words(seeds)
+    assert words.shape == (3, 8) and words.dtype == np.uint32
+    for i, s in enumerate(seeds):
+        assert words[i].tobytes() == s
+
+
+# --- the whole-round program vs the production host path --------------------
+
+
+@pytest.mark.parametrize("group_type", GROUPS)
+def test_sim_round_byte_identical_to_host_aggregation(group_type):
+    cfg = _pair(group_type)
+    p, n = 5, 33
+    rng = np.random.default_rng(20)
+    seeds = [rng.bytes(32) for _ in range(p)]
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    scalar = Fraction(1, p)
+    ref = _host_reference(cfg, seeds, weights, scalar)
+
+    sim = SimRound(SimSpec(cfg, n, block_size=4))  # p=5 pads the last block
+    res = sim.run(seeds, weights, scalar=scalar)
+    assert res.global_model.tobytes() == ref.tobytes()
+    assert res.nb_models == p
+    assert sim.program_calls == 1
+
+
+def test_sim_round_block_shapes_and_rederived_sum_mask_agree():
+    """Block size never changes the bytes, and re-deriving the sum mask in
+    a standalone phase (fuse_mask_sum=False) matches the fused fold."""
+    cfg = CFG_INT
+    p, n = 7, 19
+    rng = np.random.default_rng(21)
+    seeds = [rng.bytes(32) for _ in range(p)]
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    scalar = Fraction(1, p)
+    ref = _host_reference(cfg, seeds, weights, scalar)
+
+    for spec in (
+        SimSpec(cfg, n, block_size=7),
+        SimSpec(cfg, n, block_size=3),
+        SimSpec(cfg, n, block_size=4, fuse_mask_sum=False),
+    ):
+        res = SimRound(spec).run(seeds, weights, scalar=scalar)
+        assert res.global_model.tobytes() == ref.tobytes(), spec
+
+
+def test_sim_round_mesh_sharded_byte_identical():
+    """The participant-axis mesh shard produces the same bytes as the
+    single-device program (modular partial sums commute)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device virtual mesh")
+    cfg = CFG_INT
+    p, n = 9, 27
+    rng = np.random.default_rng(22)
+    seeds = [rng.bytes(32) for _ in range(p)]
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    scalar = Fraction(1, p)
+
+    single = SimRound(SimSpec(cfg, n, block_size=4)).run(seeds, weights, scalar=scalar)
+    sim = SimRound(SimSpec(cfg, n, block_size=2), mesh=make_mesh())
+    meshed = sim.run(seeds, weights, scalar=scalar)
+    assert meshed.global_model.tobytes() == single.global_model.tobytes()
+    assert sim.program_calls == 1
+
+
+def test_sim_round_internals_expose_consistent_aggregates():
+    """return_internals surfaces the pre-unmask sums; masked - mask must
+    equal the returned unmasked model (in the group)."""
+    cfg = CFG_INT
+    p, n = 4, 11
+    rng = np.random.default_rng(23)
+    seeds = [rng.bytes(32) for _ in range(p)]
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    res = SimRound(SimSpec(cfg, n, block_size=4, return_internals=True)).run(
+        seeds, weights, scalar=Fraction(1, p)
+    )
+    assert res.internals is not None
+    ol = host_limbs.order_limbs_for(cfg.vect.order)
+    recon = host_limbs.mod_sub(
+        res.internals["masked_vect_sum"], res.internals["mask_vect_sum"], ol
+    )
+    assert np.array_equal(recon, res.model_vect_limbs)
+
+
+def test_sim_round_thousand_participants_single_program_call():
+    """Scale smoke (the DrJAX workload shape): >=1k participants in ONE
+    program invocation, global model equal to the quantized mean."""
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6).pair()
+    p, n = 1024, 64
+    sim = SimRound(SimSpec(cfg, n, block_size=128))
+    seeds = seeds_for(p, root=3)
+    rng = np.random.default_rng(4)
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    res = sim.run(seeds, weights, scalar=Fraction(1, p))
+    assert sim.program_calls == 1
+    assert res.nb_models == p
+    # fixed-point quantization: each update adds <= 1/exp_shift encode
+    # error, so the mean carries ~P/E before the 1/P scalar — bound 1e-6
+    expected = weights.astype(np.float64).mean(axis=0)
+    np.testing.assert_allclose(res.global_model, expected, atol=1e-6)
+
+
+def test_sim_round_input_validation():
+    cfg = CFG_INT
+    sim = SimRound(SimSpec(cfg, 8, block_size=4))
+    seeds = seeds_for(3)
+    weights = np.zeros((3, 8), np.float32)
+    with pytest.raises(ValueError, match="weights"):
+        sim.run(seeds, np.zeros((3, 9), np.float32))
+    with pytest.raises(ValueError, match="participant"):
+        sim.run([], np.zeros((0, 8), np.float32))
+    with pytest.raises(ValueError, match="seeds"):
+        sim.run(np.zeros((3, 4), np.uint32), weights)
+    with pytest.raises(ValueError, match="TooManyModels"):
+        # M3 caps at 10^3 models
+        big = 1001
+        SimRound(SimSpec(cfg, 8, block_size=512)).run(
+            seeds_for(big), np.zeros((big, 8), np.float32)
+        )
+    with pytest.raises(ValueError):
+        SimSpec(cfg, 0)
+    with pytest.raises(ValueError):
+        SimSpec(cfg, 8, block_size=0)
+
+
+@pytest.mark.slow  # sweep over bigger populations x mesh; minutes on CPU
+def test_sim_round_scale_sweep_byte_identity():
+    """Larger-population sweep: single-device vs mesh vs odd blocks stay
+    byte-identical on a 4k-element model."""
+    cfg = MaskConfig(GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6).pair()
+    p, n = 512, 4096
+    seeds = seeds_for(p, root=9)
+    rng = np.random.default_rng(10)
+    weights = rng.uniform(-1, 1, (p, n)).astype(np.float32)
+    scalar = Fraction(1, p)
+    base = SimRound(SimSpec(cfg, n, block_size=64)).run(seeds, weights, scalar=scalar)
+    alt = SimRound(SimSpec(cfg, n, block_size=96)).run(seeds, weights, scalar=scalar)
+    assert alt.global_model.tobytes() == base.global_model.tobytes()
+    import jax
+
+    if len(jax.devices()) > 1:
+        meshed = SimRound(SimSpec(cfg, n, block_size=64), mesh=make_mesh()).run(
+            seeds, weights, scalar=scalar
+        )
+        assert meshed.global_model.tobytes() == base.global_model.tobytes()

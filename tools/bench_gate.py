@@ -1,10 +1,15 @@
 """Bench regression gate: replay BENCH_HISTORY.jsonl, fail on regression.
 
 The perf trajectory (BENCH.md) must only move up: this gate replays the
-bench history, finds the HEADLINE series — masked-update aggregation
-throughput in updates/s — and exits 1 when the latest recorded round
-regresses more than ``--threshold`` (default 10%) against the best prior
-round. Wire it as a tier-2 check after appending a fresh bench round:
+bench history and exits 1 when, for any gated HEADLINE FAMILY, the latest
+recorded round regresses more than ``--threshold`` (default 10%) against
+the best prior round of the SAME series. Two families gate independently
+by default:
+
+  - the fold headline — masked-update aggregation throughput, updates/s;
+  - the sim headline — in-graph federated simulation, participants/s.
+
+Wire it as a tier-2 check after appending a fresh bench round:
 
   python bench.py ... && python tools/bench_gate.py
 
@@ -15,7 +20,8 @@ the gate must keep working as writers evolve.
 
 Usage:
   python tools/bench_gate.py [--history BENCH_HISTORY.jsonl]
-                             [--metric-prefix "masked-update aggregation throughput"]
+                             [--metric-prefix "masked-update aggregation throughput"
+                              --unit "updates/s"]
                              [--threshold 0.10] [--list]
 """
 
@@ -31,6 +37,10 @@ DEFAULT_HISTORY = os.path.join(
 )
 HEADLINE_PREFIX = "masked-update aggregation throughput"
 HEADLINE_UNIT = "updates/s"
+SIM_PREFIX = "sim round throughput"
+SIM_UNIT = "participants/s"
+# families gated independently when no explicit --metric-prefix is given
+DEFAULT_FAMILIES = ((HEADLINE_PREFIX, HEADLINE_UNIT), (SIM_PREFIX, SIM_UNIT))
 
 
 def extract(record: dict) -> tuple[str, float, str, str] | None:
@@ -39,18 +49,26 @@ def extract(record: dict) -> tuple[str, float, str, str] | None:
 
     ``config`` is the measurement-configuration fingerprint: the fold
     kernel plus the pinned thread counts (and mesh size) when the writer
-    recorded them. A kernel or thread-config change is a DIFFERENT
-    experiment — BENCH_r05 re-measured 29.46 updates/s where r03 recorded
-    ~49 on the same code purely from an implicit thread-default shift — so
-    the gate compares only within one exact (metric, config) series
-    instead of flagging the config change as a regression."""
+    recorded them — extended with the sim series' population/block shape.
+    A kernel or thread-config change is a DIFFERENT experiment —
+    BENCH_r05 re-measured 29.46 updates/s where r03 recorded ~49 on the
+    same code purely from an implicit thread-default shift — so the gate
+    compares only within one exact (metric, config) series instead of
+    flagging the config change as a regression."""
     for node in (record, record.get("parsed") or {}):
         metric = node.get("metric")
         value = node.get("value")
         unit = node.get("unit")
         if metric and isinstance(value, (int, float)):
             parts = []
-            for field in ("kernel", "native_threads", "shard_threads", "mesh"):
+            for field in (
+                "kernel",
+                "native_threads",
+                "shard_threads",
+                "mesh",
+                "participants",
+                "block",
+            ):
                 if node.get(field) is not None:
                     parts.append(f"{field}={node[field]}")
             return str(metric), float(value), str(unit or ""), ",".join(parts)
@@ -60,7 +78,7 @@ def extract(record: dict) -> tuple[str, float, str, str] | None:
 def load_series(
     path: str, metric_prefix: str, unit: str
 ) -> list[tuple[float, str, float, str]]:
-    """Chronological (ts, metric, value, config) for the headline series."""
+    """Chronological (ts, metric, value, config) for one headline family."""
     series = []
     with open(path) as f:
         for line in f:
@@ -81,40 +99,17 @@ def load_series(
     return series
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--history", default=DEFAULT_HISTORY)
-    ap.add_argument(
-        "--metric-prefix",
-        default=HEADLINE_PREFIX,
-        help="headline series selector (metric name prefix)",
-    )
-    ap.add_argument("--unit", default=HEADLINE_UNIT)
-    ap.add_argument(
-        "--threshold",
-        type=float,
-        default=0.10,
-        help="maximum tolerated fractional regression vs the best prior round",
-    )
-    ap.add_argument(
-        "--list", action="store_true", help="print the headline series and exit 0"
-    )
-    args = ap.parse_args()
-    if not (0.0 < args.threshold < 1.0):
-        ap.error("--threshold must be in (0, 1)")
-
-    series = load_series(args.history, args.metric_prefix, args.unit)
-    if args.list:
-        for ts, metric, value, config in series:
-            suffix = f"  [{config}]" if config else ""
-            print(f"{ts:.0f}  {value:10.2f} {args.unit}  {metric}{suffix}")
-        return 0
+def gate_family(
+    history: str, metric_prefix: str, unit: str, threshold: float
+) -> int:
+    """Gate one headline family; returns a process exit code."""
+    series = load_series(history, metric_prefix, unit)
     if len(series) < 2:
         # nothing to gate against: a fresh repo (or a renamed headline) must
         # not hard-fail CI, but say so loudly
         print(
-            f"bench-gate: only {len(series)} headline round(s) in "
-            f"{args.history}; nothing to compare",
+            f"bench-gate: only {len(series)} '{metric_prefix}' round(s) in "
+            f"{history}; nothing to compare",
             file=sys.stderr,
         )
         return 0
@@ -142,13 +137,13 @@ def main() -> int:
         return 0
     *prior, (_, _, latest, _) = series
     best_ts, best_metric, best, _best_cfg = max(prior, key=lambda item: item[2])
-    floor = best * (1.0 - args.threshold)
+    floor = best * (1.0 - threshold)
     verdict = {
         "latest": latest,
         "best_prior": best,
         "floor": round(floor, 3),
-        "threshold": args.threshold,
-        "unit": args.unit,
+        "threshold": threshold,
+        "unit": unit,
         "rounds": len(series),
         "metric": latest_metric,
         "config": latest_config,
@@ -157,16 +152,80 @@ def main() -> int:
         verdict["result"] = "REGRESSION"
         print(json.dumps(verdict))
         print(
-            f"bench-gate: FAIL — latest {latest:.2f} {args.unit} is "
+            f"bench-gate: FAIL — latest {latest:.2f} {unit} is "
             f"{(1 - latest / best) * 100:.1f}% below the best prior round "
             f"({best:.2f} @ ts {best_ts:.0f}, '{best_metric}'); "
-            f"tolerated: {args.threshold * 100:.0f}%",
+            f"tolerated: {threshold * 100:.0f}%",
             file=sys.stderr,
         )
         return 1
     verdict["result"] = "ok"
     print(json.dumps(verdict))
     return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", default=DEFAULT_HISTORY)
+    ap.add_argument(
+        "--metric-prefix",
+        default=None,
+        help="gate ONLY this headline family (metric name prefix); the "
+        "default gates every known family independently",
+    )
+    ap.add_argument("--unit", default=None)
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="maximum tolerated fractional regression vs the best prior round",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="print the headline series and exit 0"
+    )
+    args = ap.parse_args()
+    if not (0.0 < args.threshold < 1.0):
+        ap.error("--threshold must be in (0, 1)")
+
+    if args.metric_prefix is not None:
+        unit = args.unit
+        if unit is None:
+            # infer the unit for known families — a bare
+            # `--metric-prefix "sim round throughput"` must not fall back
+            # to updates/s, match zero records, and soft-pass a regression.
+            # Unknown prefixes must say their unit: a silent default would
+            # reintroduce exactly that match-nothing soft-pass for them.
+            unit = next(
+                (
+                    u
+                    for p, u in DEFAULT_FAMILIES
+                    if args.metric_prefix.startswith(p) or p.startswith(args.metric_prefix)
+                ),
+                None,
+            )
+            if unit is None:
+                ap.error(
+                    f"cannot infer the unit for metric prefix {args.metric_prefix!r}; "
+                    "pass --unit explicitly"
+                )
+        families = [(args.metric_prefix, unit)]
+    else:
+        if args.unit is not None:
+            ap.error("--unit without --metric-prefix is ambiguous")
+        families = list(DEFAULT_FAMILIES)
+
+    if args.list:
+        for prefix, unit in families:
+            for ts, metric, value, config in load_series(args.history, prefix, unit):
+                suffix = f"  [{config}]" if config else ""
+                print(f"{ts:.0f}  {value:10.2f} {unit}  {metric}{suffix}")
+        return 0
+
+    # every family gates independently; any regression fails the run
+    return max(
+        gate_family(args.history, prefix, unit, args.threshold)
+        for prefix, unit in families
+    )
 
 
 if __name__ == "__main__":

@@ -50,6 +50,16 @@ Checks:
     ring-buffer reuse, the native kernel's host-view materialization, a
     degraded-path acceptance resolve) must carry ``# lint: sync-ok`` on
     the offending line.
+  - host round-trips inside the simulation's jitted program bodies
+    (functions prefixed ``_prog`` under ``xaynet_tpu/sim``): the whole
+    point of ``sim.SimRound`` is that a federated round traces into ONE
+    device program, so ``np.asarray`` / ``block_until_ready`` (host
+    syncs) and Python-int limb math (``limbs_to_int``/``int_to_limbs``/
+    ``.item()``/``.tolist()``/``int()``) inside a program body silently
+    reintroduce the per-phase host round-trips the subsystem exists to
+    eliminate. The host boundary (encode before, decode after the
+    program) lives OUTSIDE ``_prog*`` functions; a deliberate in-body
+    materialization must carry ``# lint: sync-ok`` on the offending line.
   - silent broad-exception swallows (``except Exception: pass`` and
     friends) under ``xaynet_tpu/server`` and ``xaynet_tpu/storage``: a
     coordinator-side failure must be logged, metered, retried or
@@ -281,6 +291,27 @@ _WORKER_SYNC_PREFIXES = (
 # host; block_until_ready is an explicit device barrier
 _SYNC_CALLEES = frozenset({"asarray", "block_until_ready"})
 
+# simulation program bodies: functions with these name prefixes under
+# xaynet_tpu/sim are jitted whole-round program code — pure traced JAX
+_SIM_PROGRAM_PREFIXES = ("_prog",)
+
+# Python-int limb math: pulls group elements out of the graph one integer
+# at a time (the pattern the in-graph simulation exists to eliminate)
+_HOST_INT_CALLEES = frozenset(
+    {"limbs_to_int", "limbs_to_ints", "int_to_limbs", "ints_to_limbs", "item", "tolist", "int"}
+)
+
+
+def _is_host_roundtrip(node: ast.Call) -> bool:
+    """True for host syncs AND Python-int limb math (syntactic, any
+    spelling that resolves to one of the entry points)."""
+    if _is_blocking_sync(node):
+        return True
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _HOST_INT_CALLEES
+    return isinstance(func, ast.Name) and func.id in _HOST_INT_CALLEES
+
 
 def _is_blocking_sync(node: ast.Call) -> bool:
     """True for any spelling of ``np.asarray(...)`` /
@@ -372,6 +403,31 @@ def check_file(path: Path) -> list[str]:
 
     def line_of(node: ast.AST) -> str:
         return src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
+
+    # sim tree: host round-trips inside jitted program bodies reintroduce
+    # the per-phase host syncs the in-graph round exists to eliminate
+    if str(rel).startswith("xaynet_tpu/sim"):
+        flagged_sim: set[int] = set()
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not fn.name.startswith(_SIM_PROGRAM_PREFIXES):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_host_roundtrip(node)
+                    and node.lineno not in flagged_sim
+                ):
+                    flagged_sim.add(node.lineno)
+                    if "lint: sync-ok" not in line_of(node):
+                        problems.append(
+                            f"{rel}:{node.lineno}: host round-trip in sim program "
+                            f"body '{fn.name}' (np.asarray/block_until_ready/"
+                            "Python-int limb math must stay outside jitted round "
+                            "programs; move it to the host boundary or annotate a "
+                            "deliberate materialization with '# lint: sync-ok')"
+                        )
 
     # parallel tree: blocking host syncs inside fold-worker code paths
     # serialize the pipeline overlap; drain() is the sanctioned sync point

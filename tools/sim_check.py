@@ -1,0 +1,106 @@
+"""Differential oracle sweep: replay seeded rounds through the in-graph
+simulation AND the in-process production server; fail on any byte mismatch.
+
+The cheap nightly cross-check for docs/DESIGN.md §13: every combination
+drives ONE production round (real coordinator state machine + SDK
+participant FSMs, in-process transport, pinned mask seeds) and then checks
+the jitted whole-round program against it — single-device and, when the
+host exposes a multi-device (virtual) mesh, mesh-sharded — byte for byte
+on the float64 global model.
+
+Usage:
+  python tools/sim_check.py [--combos N] [--seed S] [--no-mesh] [--json]
+
+``--combos N`` draws N (mask config x model size x participant count)
+combinations from a seeded menu, so successive nightly runs with different
+``--seed`` values walk the config space deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the oracle compares CPU-reproducible byte streams; force the CPU backend
+# (and a virtual mesh) BEFORE jax initializes, like conftest.py does
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--combos", type=int, default=3, help="seeded combinations to replay")
+    ap.add_argument("--seed", type=int, default=0, help="menu + population root seed")
+    ap.add_argument("--no-mesh", action="store_true", help="skip the mesh-sharded sim leg")
+    ap.add_argument("--json", action="store_true", help="one JSON line per combination")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from xaynet_tpu.core.mask.config import GroupType
+    from xaynet_tpu.parallel.mesh import make_mesh
+    from xaynet_tpu.sim import OracleCase, OracleMismatch, run_oracle_case, run_production_round
+
+    rng = np.random.default_rng(args.seed)
+    groups = [GroupType.INTEGER, GroupType.PRIME, GroupType.POWER2]
+    lengths = [13, 64, 257, 600]
+    populations = [3, 4, 5, 7]
+
+    mesh = None
+    if not args.no_mesh and len(jax.devices()) > 1:
+        mesh = make_mesh()
+
+    failures = 0
+    for i in range(args.combos):
+        case = OracleCase(
+            group_type=groups[int(rng.integers(len(groups)))],
+            model_length=int(lengths[int(rng.integers(len(lengths)))]),
+            n_update=int(populations[int(rng.integers(len(populations)))]),
+            seed=int(rng.integers(1 << 30)),
+            block_size=int(rng.choice([2, 3, 4, 8])),
+        )
+        t0 = time.time()
+        outcome = {"case": case.describe(), "block": case.block_size}
+        try:
+            production = run_production_round(case)
+            report = run_oracle_case(case, production_model=production)
+            outcome["single_device"] = "byte-identical"
+            if mesh is not None:
+                run_oracle_case(case, mesh=mesh, production_model=production)
+                outcome["mesh"] = f"byte-identical (x{len(mesh.devices.flat)})"
+            outcome["sha256"] = report.sim_sha[:16]
+            outcome["seconds"] = round(time.time() - t0, 1)
+            outcome["result"] = "ok"
+        except OracleMismatch as err:
+            outcome["result"] = "MISMATCH"
+            outcome["error"] = str(err)
+            failures += 1
+        except Exception as err:  # infra failure: report, still fail the run
+            outcome["result"] = "ERROR"
+            outcome["error"] = f"{type(err).__name__}: {err}"
+            failures += 1
+        if args.json:
+            print(json.dumps(outcome))
+        else:
+            status = outcome["result"]
+            extra = outcome.get("error", outcome.get("seconds", ""))
+            print(f"[{i + 1}/{args.combos}] {outcome['case']}: {status} {extra}")
+
+    if failures:
+        print(f"sim-check: {failures}/{args.combos} combination(s) FAILED", file=sys.stderr)
+        return 1
+    print(f"sim-check: {args.combos} combination(s) byte-identical", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,61 @@ def keystream_words(key_words: jax.Array, block_start, nblocks: int) -> jax.Arra
     return jnp.stack(out, axis=-1)  # [nblocks, 16]
 
 
+def _quarter_rows(a, b, c, d):
+    """One quarter-round over whole state-matrix rows (``uint32[4, N]``)."""
+    a = a + b
+    d = _rotl(d ^ a, 16)
+    c = c + d
+    b = _rotl(b ^ c, 12)
+    a = a + b
+    d = _rotl(d ^ a, 8)
+    c = c + d
+    b = _rotl(b ^ c, 7)
+    return a, b, c, d
+
+
+def keystream_words_rolled(key_words: jax.Array, block_start, nblocks: int) -> jax.Array:
+    """``keystream_words``, bit-identical, with the round loop ROLLED.
+
+    The unrolled kernel above emits ~1k HLO ops (10 double rounds x 8
+    quarters x a dozen ops), which costs ~25s of XLA CPU compile time
+    *every time it is inlined into a new enclosing program*. That is fine
+    for the standalone jitted host-chunk kernels (compiled once per
+    process), but the in-graph derivation (``derive_uniform_limbs_ingraph``)
+    inlines the keystream into every simulation program variant. This
+    variant keeps the ChaCha state as the classic 4x4 word matrix (rows
+    ``uint32[4, nblocks]``), runs the column+diagonal double round as ONE
+    vectorized quarter over whole rows (diagonals via axis-0 rolls), and
+    folds the 10 double rounds under ``lax.fori_loop`` — ~25x fewer ops to
+    compile, same arithmetic per element, same output word order.
+    """
+    counters = jnp.asarray(block_start, dtype=_U32) + jnp.arange(nblocks, dtype=_U32)
+    r0 = jnp.stack([jnp.broadcast_to(_U32(c), (nblocks,)) for c in _CONSTANTS])
+    r1 = jnp.stack([jnp.broadcast_to(key_words[i], (nblocks,)) for i in range(4)])
+    r2 = jnp.stack([jnp.broadcast_to(key_words[i], (nblocks,)) for i in range(4, 8)])
+    zeros = jnp.zeros((nblocks,), dtype=_U32)
+    r3 = jnp.stack([counters, zeros, zeros, zeros])
+    init = (r0, r1, r2, r3)
+
+    def double_round(_, s):
+        a, b, c, d = s
+        a, b, c, d = _quarter_rows(a, b, c, d)  # column round
+        b = jnp.roll(b, -1, axis=0)
+        c = jnp.roll(c, -2, axis=0)
+        d = jnp.roll(d, -3, axis=0)
+        a, b, c, d = _quarter_rows(a, b, c, d)  # diagonal round
+        b = jnp.roll(b, 1, axis=0)
+        c = jnp.roll(c, 2, axis=0)
+        d = jnp.roll(d, 3, axis=0)
+        return a, b, c, d
+
+    a, b, c, d = jax.lax.fori_loop(0, 10, double_round, init)
+    out = jnp.concatenate(
+        [a + init[0], b + init[1], c + init[2], d + init[3]], axis=0
+    )  # [16, nblocks], row-major word order
+    return jnp.transpose(out)  # [nblocks, 16]
+
+
 def _words_to_bytes(words: jax.Array) -> jax.Array:
     """uint32[..., W] little-endian words -> uint8[..., W*4]."""
     b0 = (words & _U32(0xFF)).astype(jnp.uint8)
@@ -128,7 +183,31 @@ def _derive_chunk_impl(
     words = keystream_words(key_words, block_start, nblocks)
     stream = _words_to_bytes(words).reshape(-1)
     stream = jax.lax.dynamic_slice(stream, (intra,), (nbytes,))
+    out, csum = _chop_reject_scatter(out, base, stream, n_cand, bpn, out_limbs, order_tuple)
+    return out, csum[-1]
 
+
+def _chop_reject_scatter(
+    out: jax.Array,
+    base: jax.Array,
+    stream: jax.Array,
+    n_cand: int,
+    bpn: int,
+    out_limbs: int,
+    order_tuple: tuple[int, ...],
+) -> tuple[jax.Array, jax.Array]:
+    """Chop a keystream slice into ``n_cand`` fixed-width candidates, apply
+    the rejection rule, scatter accepted limbs at ``out[base + rank]``.
+
+    THE single source of truth for the acceptance criterion (little-endian
+    chop + lexicographic ``candidate < order``, bit-identical to the host
+    ``StreamSampler`` / the Rust reference) — both the host-chunked and the
+    fully-traced derivation paths call it, so the rule cannot silently
+    diverge between them. Rejected candidates and accepted ones past
+    ``len(out)`` are scatter-dropped. Returns ``(out, csum)`` where
+    ``csum[i]`` counts acceptances among attempts ``0..i`` (``csum[-1]`` =
+    acceptances in this chunk).
+    """
     cand_limbs = max(1, (bpn + 3) // 4)
     padded = jnp.zeros((n_cand, cand_limbs * 4), dtype=jnp.uint8)
     padded = padded.at[:, :bpn].set(stream.reshape(n_cand, bpn))
@@ -151,11 +230,10 @@ def _derive_chunk_impl(
         decided = decided | (col != o)
 
     count = out.shape[0]
-    rank = jnp.cumsum(lt.astype(jnp.int32)) - 1
-    slot = jnp.where(lt, base + rank, count)  # rejected -> dropped
+    csum = jnp.cumsum(lt.astype(jnp.int32))
+    slot = jnp.where(lt, base + csum - 1, count)  # rejected -> dropped
     out = out.at[slot].set(cand[:, :out_limbs], mode="drop")
-    n_accepted = rank[-1] + 1
-    return out, n_accepted
+    return out, csum
 
 
 _derive_chunk = partial(
@@ -211,6 +289,112 @@ def _derive_params(
         chunk_candidates = provision_candidates(count, order)
     chunk_candidates = max(64, min(chunk_candidates, _CHUNK_BYTES_CAP // bpn // max(1, n_seeds)))
     return bpn, out_limbs, order_cl, chunk_candidates
+
+
+def _chunk_step_traced(
+    out: jax.Array,
+    base: jax.Array,
+    key_words: jax.Array,
+    offset: jax.Array,
+    n_cand: int,
+    bpn: int,
+    out_limbs: int,
+    order_tuple: tuple[int, ...],
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One fixed-size chunk with a TRACED byte cursor: scatter accepted
+    candidates into ``out`` and advance ``offset`` with exact
+    ``StreamSampler`` semantics — the cursor stops at the byte after the
+    attempt that produced the ``count``-th acceptance, so a later draw on
+    the same stream (the unit -> vector handoff) resumes bit-identically.
+
+    Unlike ``_derive_chunk_impl`` (whose host caller syncs the accepted
+    count every chunk), this body is pure traced code: it composes under
+    ``lax.while_loop`` and ``vmap``, which is what makes a whole federated
+    round expressible as ONE jitted program (see ``xaynet_tpu.sim``).
+    Already-finished lanes keep scattering into dropped slots and freeze
+    their cursor, so running extra iterations under a batched while_loop is
+    harmless.
+    """
+    count = out.shape[0]
+    block_start = (offset // 64).astype(_U32)
+    intra = offset % 64
+    nbytes = n_cand * bpn
+    nblocks = nbytes // 64 + 2  # +2 covers any intra-block offset in [0, 64)
+    # rolled keystream (bit-identical): the unrolled kernel would cost
+    # ~25s of XLA CPU compile per enclosing program (see its docstring)
+    words = keystream_words_rolled(key_words, block_start, nblocks)
+    stream = _words_to_bytes(words).reshape(-1)
+    stream = jax.lax.dynamic_slice(stream, (intra,), (nbytes,))
+    out, csum = _chop_reject_scatter(out, base, stream, n_cand, bpn, out_limbs, order_tuple)
+    n_acc = csum[-1]
+    need = count - base
+    finishes = n_acc >= need
+    # attempt index (within this chunk) of the need-th acceptance; the
+    # cursor semantics are chunking-independent because attempts consume
+    # exactly bpn bytes each, accepted or not
+    pos = jnp.argmax(csum >= need)
+    new_offset = jnp.where(finishes, offset + (pos + 1) * bpn, offset + n_cand * bpn)
+    done = base >= count
+    new_base = jnp.minimum(base + n_acc, count)
+    return (
+        out,
+        jnp.where(done, base, new_base),
+        jnp.where(done, offset, new_offset),
+    )
+
+
+def provisioned_chunk(count: int, order: int, n_seeds: int = 1) -> int:
+    """The per-seed chunk size a batched in-graph derivation should use so
+    ``n_seeds`` concurrent lanes stay inside the shared
+    ``_CHUNK_BYTES_CAP`` device-memory budget (vmap multiplies the chunk
+    footprint by the lane count; the while_loop simply runs more
+    iterations when the cap bites)."""
+    return _derive_params(count, order, None, n_seeds)[3]
+
+
+def derive_uniform_limbs_ingraph(
+    key_words: jax.Array,
+    byte_offset: jax.Array,
+    count: int,
+    order: int,
+    chunk_candidates: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fully in-graph mask expansion: jit/vmap-composable, no host syncs.
+
+    Returns ``(uint32[count, L] limbs, int32 end cursor)`` — bit-identical
+    to the host ``StreamSampler`` draws from the same ``byte_offset``
+    (same keystream, same rejection rule, same acceptance order, same
+    consumed-bytes handoff). ``key_words`` is ``uint32[8]`` (the seed as
+    little-endian words) and may be batched via ``vmap``; ``byte_offset``
+    is a traced scalar. The chunk loop is a ``lax.while_loop`` (trip count
+    1 except vanishingly rarely, by the 2^-60 provisioning), so the whole
+    derivation lives inside a single jitted program — this is the kernel
+    the federated simulation (``xaynet_tpu.sim``) vmaps across its
+    participant axis.
+
+    Keystream byte offsets ride in int32: derivations beyond ~2^31 bytes
+    per seed (≈ 350M f32-config elements) are out of scope here — the
+    chunked host API (``derive_uniform_limbs``) covers those.
+    """
+    bpn, out_limbs, order_cl, chunk_candidates = _derive_params(count, order, chunk_candidates)
+    if count * bpn * 2 + 64 > 0x7FFFFFFF:
+        raise ValueError("in-graph derivation cursor would overflow int32; use the host API")
+
+    out0 = jnp.zeros((count, out_limbs), dtype=_U32)
+
+    def cond(carry):
+        return carry[1] < count
+
+    def body(carry):
+        out, base, offset = carry
+        return _chunk_step_traced(
+            out, base, key_words, offset, chunk_candidates, bpn, out_limbs, order_cl
+        )
+
+    out, _, offset = jax.lax.while_loop(
+        cond, body, (out0, jnp.int32(0), jnp.asarray(byte_offset, jnp.int32))
+    )
+    return out, offset
 
 
 def derive_uniform_limbs(

@@ -20,11 +20,15 @@ coordinator and sum participants run:
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.crypto.prng import StreamSampler
 from ..core.mask.config import MaskConfigPair
+from ..core.mask.encode import clamp_scalar, encode_unit, encode_vect_limbs
 from ..telemetry import profiling
 from . import chacha_jax, limbs as host_limbs, limbs_jax
 
@@ -37,6 +41,100 @@ def derive_mask_limbs(
     unit = sampler.draw_limbs(1, config.unit.order)[0]
     offset = sampler.consumed_bytes
     vect = chacha_jax.derive_uniform_limbs(seed, length, config.vect.order, byte_offset=offset)
+    return unit, vect
+
+
+def derive_mask_ingraph(
+    key_words: jax.Array,
+    length: int,
+    config: MaskConfigPair,
+    unit_chunk: int | None = None,
+    vect_chunk: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fully in-graph ``MaskSeed.derive_mask``: (unit [L1], vect [length, L]).
+
+    Pure traced code (no host syncs), composable under ``jit``/``vmap`` —
+    the per-participant kernel the federated simulation maps across its
+    participant axis. Keystream semantics are bit-identical to the scalar
+    ``core/mask/seed.py`` reference: one unit-order draw first, then the
+    vector draws resume at the traced in-graph byte cursor the unit draw
+    handed off. The chunk knobs bound per-lane device memory; pass
+    ``chacha_jax.provisioned_chunk(length, order, n_lanes)`` when vmapping
+    ``n_lanes`` participants so the batch stays inside the chunk budget.
+    """
+    unit, offset = chacha_jax.derive_uniform_limbs_ingraph(
+        key_words, jnp.int32(0), 1, config.unit.order, unit_chunk
+    )
+    vect, _ = chacha_jax.derive_uniform_limbs_ingraph(
+        key_words, offset, length, config.vect.order, vect_chunk
+    )
+    return unit[0], vect
+
+
+def seed_words(seeds: list[bytes]) -> np.ndarray:
+    """32-byte seeds -> ``uint32[B, 8]`` little-endian ChaCha key words."""
+    if not seeds:
+        return np.zeros((0, 8), dtype=np.uint32)
+    return np.stack([np.frombuffer(s, dtype="<u4") for s in seeds])
+
+
+@lru_cache(maxsize=32)
+def _mask_batch_fn(length: int, config: MaskConfigPair, lane_bucket: int):
+    unit_chunk = chacha_jax.provisioned_chunk(1, config.unit.order, lane_bucket)
+    vect_chunk = chacha_jax.provisioned_chunk(length, config.vect.order, lane_bucket)
+
+    def one(kw):
+        return derive_mask_ingraph(kw, length, config, unit_chunk, vect_chunk)
+
+    return jax.jit(jax.vmap(one))
+
+
+def derive_mask_limbs_batch(
+    seeds: list[bytes], length: int, config: MaskConfigPair
+) -> tuple[jax.Array, jax.Array]:
+    """``derive_mask_limbs`` for many seeds in ONE jitted program.
+
+    Returns (units ``uint32[B, L1]``, vects ``uint32[B, length, L]``);
+    every row is bit-identical to ``MaskSeed.derive_mask`` with that seed
+    (golden-pinned in tests/test_sim_round.py). Unlike ``sum_masks`` this
+    never walks the seeds on the host — unit draws, cursor handoffs and
+    vector draws are all in-graph — so it is the building block for
+    whole-round simulation rather than the Sum2 aggregate.
+
+    Compiled programs are cached per (length, config, pow2 lane bucket);
+    the lane bucket also scales the chunk budget so large batches don't
+    multiply the keystream footprint past the device-memory cap.
+    """
+    if not seeds:
+        raise ValueError("no seeds")
+    lane_bucket = 1 << (len(seeds) - 1).bit_length()
+    fn = _mask_batch_fn(length, config, lane_bucket)
+    return fn(jnp.asarray(seed_words(seeds)))
+
+
+def encode_models_batch(
+    weights: np.ndarray, scalar, config: MaskConfigPair
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fixed-point encode a population of models in ONE vectorized pass.
+
+    ``weights`` is ``[B, length]`` (every participant shares ``scalar``, the
+    homogeneous-simulation shape); returns (unit limbs ``uint32[L1]`` — the
+    encoded clamped scalar, identical for every lane — and vect limbs
+    ``uint32[B, length, L]``). Byte-identical to ``B`` independent
+    ``Masker.mask`` encodes because the fixed-point map is elementwise: the
+    flattened array goes through the SAME production ``encode_vect_limbs``
+    (double-double fast path for bounded f32, exact Fractions otherwise)
+    that a single participant runs, then reshapes. Pinned against the
+    scalar path in tests/test_sim_round.py.
+    """
+    weights = np.asarray(weights)
+    if weights.ndim != 2:
+        raise ValueError("weights must be [participants, length]")
+    s_clamped = clamp_scalar(scalar, config.unit)
+    flat = encode_vect_limbs(weights.reshape(-1), s_clamped, config.vect)
+    vect = flat.reshape(weights.shape[0], weights.shape[1], -1)
+    unit_int = encode_unit(s_clamped, config.unit)
+    unit = host_limbs.int_to_limbs(unit_int, host_limbs.n_limbs_for_order(config.unit.order))
     return unit, vect
 
 

@@ -24,30 +24,16 @@ from ..ops import limbs as host_limbs
 from ..ops.fold_jax import MAX_LAZY_BATCH, fold_planar_batch, p_mod_sub, wire_to_planar
 from ..telemetry import profiling
 from ..utils.kernels import FOLD_KERNELS
-from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple
+from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple, shard_map_compat
 
 logger = logging.getLogger(__name__)
 
 _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    """``jax.shard_map`` across jax versions.
-
-    Newer jax exposes it at top level with ``check_vma``; 0.4.x ships it in
-    ``jax.experimental.shard_map`` with the equivalent ``check_rep`` knob
-    (pallas_call's out_shape carries no vma/rep either way, so the check is
-    disabled in both).
-    """
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-        )
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    return _exp_shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
-    )
+# the cross-version shard_map shim lives in mesh.py (one shim for every
+# call site); the local alias keeps this module's call sites unchanged
+_shard_map = shard_map_compat
 
 # auto-calibration verdicts, process-wide: a long-running coordinator builds
 # a fresh aggregator every round but the (backend, shape, order) question has

@@ -81,6 +81,9 @@ class Participant:
         # dropped connection must not turn a participant into a dropout);
         # pass False to talk raw HTTP, or hand in a pre-built client
         retries: bool = True,
+        # deterministic Update-task mask seed (oracle/replay only — see
+        # PetSettings.mask_seed; None = the reference's random draw)
+        mask_seed: Optional[bytes] = None,
     ):
         if isinstance(client, str):
             client = HttpClient(client)
@@ -98,6 +101,7 @@ class Participant:
                 scalar=scalar,
                 max_message_size=max_message_size,
                 device_sum2=device_sum2,
+                mask_seed=mask_seed,
             )
             self._sm = StateMachine(settings, client, self._store, self._events)
         self._made_progress = False

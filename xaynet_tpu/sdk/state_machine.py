@@ -110,6 +110,13 @@ class PetSettings:
     # falling back to the host path (tests set this so a broken device
     # kernel cannot hide behind the fallback)
     device_sum2_strict: bool = False
+    # deterministic mask seed for the Update task (32 bytes). None (the
+    # default, and the only safe production value) draws a fresh random
+    # seed per update exactly like the reference; injecting a fixed seed
+    # makes the masked model and seed dictionary reproducible, which is
+    # what the differential oracle (xaynet_tpu.sim.oracle) needs to replay
+    # one round through both the server and the in-graph simulation.
+    mask_seed: Optional[bytes] = None
 
     def __post_init__(self):
         if self.max_message_size is not None and self.max_message_size < MIN_MESSAGE_SIZE:
@@ -117,6 +124,8 @@ class PetSettings:
                 f"max_message_size must be None or >= {MIN_MESSAGE_SIZE} "
                 "(header + chunk header + 1 byte of progress)"
             )
+        if self.mask_seed is not None and len(self.mask_seed) != 32:
+            raise ValueError("mask_seed must be exactly 32 bytes")
 
 
 @dataclass
@@ -159,6 +168,7 @@ class StateMachine:
         self.max_message_size = settings.max_message_size
         self.device_sum2 = settings.device_sum2
         self.device_sum2_strict = settings.device_sum2_strict
+        self.mask_seed = settings.mask_seed
         self.client = client
         self.model_store = model_store
         self.notify = notify or Notify()
@@ -305,7 +315,12 @@ class StateMachine:
             elif dt is DataType.F64:
                 model = model.astype(np.float64)
 
-        masker = Masker(self.round_params.mask_config)
+        if self.mask_seed is not None:
+            from ..core.mask.seed import MaskSeed
+
+            masker = Masker(self.round_params.mask_config, seed=MaskSeed(self.mask_seed))
+        else:
+            masker = Masker(self.round_params.mask_config)
         seed, masked_model = masker.mask(Scalar.from_fraction(self.scalar), model)
         local_seed_dict = {
             sum_pk: seed.encrypt(PublicEncryptKey(ephm_pk))
@@ -466,6 +481,7 @@ class StateMachine:
             "max_message_size": self.max_message_size,
             "device_sum2": self.device_sum2,
             "device_sum2_strict": self.device_sum2_strict,
+            "mask_seed": self.mask_seed.hex() if self.mask_seed else None,
             "phase": self.phase.value,
             "task": self.task.value,
             "sum_signature": self.sum_signature.hex() if self.sum_signature else None,
@@ -506,6 +522,9 @@ class StateMachine:
             # the save/restore round trip
             device_sum2=(None if d.get("device_sum2") is None else bool(d["device_sum2"])),
             device_sum2_strict=bool(d.get("device_sum2_strict", False)),
+            mask_seed=(
+                bytes.fromhex(d["mask_seed"]) if d.get("mask_seed") else None
+            ),
         )
         machine = cls(settings, client, model_store, notify)
         machine.phase = PhaseKind(d["phase"])

@@ -1,0 +1,30 @@
+"""In-graph federated PET simulation (DrJAX-style whole-round programs).
+
+``SimRound`` expresses an entire PET round — per-participant mask
+derivation, masked-model generation, sharded modular aggregation, sum-mask
+reconstruction, unmask — as ONE vmapped/jitted JAX program with no server,
+sockets, or Python loop between phases. Two payoffs:
+
+- a research workload: simulate thousands of participants per second on a
+  single device (or a mesh) without a coordinator process;
+- a differential oracle (``sim.oracle``): the same seeds driven through the
+  in-process production server path must produce a byte-identical global
+  model, turning every future server/kernel change into a
+  property-checkable one.
+
+See docs/DESIGN.md §13.
+"""
+
+from .round import SimResult, SimRound, SimSpec, seeds_for
+from .oracle import OracleCase, OracleMismatch, run_oracle_case, run_production_round
+
+__all__ = [
+    "SimResult",
+    "SimRound",
+    "SimSpec",
+    "seeds_for",
+    "OracleCase",
+    "OracleMismatch",
+    "run_oracle_case",
+    "run_production_round",
+]
