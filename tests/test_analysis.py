@@ -1,0 +1,492 @@
+"""tools/analysis: the pass-based static-analysis framework (ISSUE 9).
+
+Fixture-corpus tests per deep pass (positive finding, suppressed finding,
+baseline-masked finding), the PR-7 race-pattern acceptance fixture for the
+lock-discipline lint, the regression fixture proving the old `_prog*`
+name-prefix heuristic missed helpers one call deep (and the call-graph
+pass catches them), the result cache, `--changed` plumbing, and the
+self-gate: the real tree analyzes clean with the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis import (  # noqa: E402
+    Baseline,
+    CallGraph,
+    Finding,
+    ResultCache,
+    SourceCache,
+    SymbolTable,
+    check_file_info,
+    driver,
+    suppressed,
+)
+from tools.analysis import invariants, locks, metricscheck, purity  # noqa: E402
+
+
+def _graph(tmp_path, files: dict[str, str]) -> CallGraph:
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    cache = SourceCache(tmp_path)
+    infos = [cache.get(tmp_path / rel) for rel in files]
+    return CallGraph(SymbolTable(infos))
+
+
+# --- lock-discipline pass ---------------------------------------------------
+
+# The PR-7 access pattern, distilled: per-shard accumulators annotated as
+# guarded by the device-dispatch lock, a worker thread folding a shard and
+# writing the accumulator slot OUTSIDE the lock. The 1,425-trial stress
+# hunt becomes a compile-time finding.
+PR7_RACE = """
+import threading
+
+class Plan:
+    def __init__(self):
+        self.accs = [0, 0]  # guarded-by: _dispatch_lock
+        self._dispatch_lock = threading.Lock()
+
+class Pipeline:
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self._queue = []
+
+    def start(self):
+        self._worker = threading.Thread(target=self._worker_loop)
+
+    def _worker_loop(self):
+        for item in self._queue:
+            self._fold_shard(item)
+
+    def _fold_shard(self, item):
+        d, batch = item
+        plan = self.plan
+        new_acc = plan.accs[d] + batch   # read outside the lock
+        plan.accs[d] = new_acc           # torn-slice write outside the lock
+"""
+
+
+def test_lock_pass_reports_pr7_race_pattern(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/foo.py": PR7_RACE})
+    findings = locks.run(graph)
+    msgs = [f.message for f in findings]
+    assert any("Plan.accs" in m and "_dispatch_lock" in m for m in msgs)
+    # both the unlocked read and the unlocked write are reported
+    assert len([f for f in findings if "Plan.accs" in f.message]) >= 2
+
+
+def test_lock_pass_quiet_when_lock_held(tmp_path):
+    fixed = PR7_RACE.replace(
+        """        plan = self.plan
+        new_acc = plan.accs[d] + batch   # read outside the lock
+        plan.accs[d] = new_acc           # torn-slice write outside the lock""",
+        """        plan = self.plan
+        with plan._dispatch_lock:
+            new_acc = plan.accs[d] + batch
+            plan.accs[d] = new_acc""",
+    )
+    assert fixed != PR7_RACE
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/foo.py": fixed})
+    assert locks.run(graph) == []
+
+
+def test_lock_pass_suppression_requires_rationale(tmp_path):
+    bare = PR7_RACE.replace(
+        "plan.accs[d] = new_acc           # torn-slice write outside the lock",
+        "plan.accs[d] = new_acc  # lint: guarded-ok",
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/foo.py": bare})
+    store_findings = [f for f in locks.run(graph) if "missing its rationale" in f.message]
+    assert store_findings, "a bare guarded-ok must not suppress"
+
+    with_rationale = PR7_RACE.replace(
+        "plan.accs[d] = new_acc           # torn-slice write outside the lock",
+        "plan.accs[d] = new_acc  # lint: guarded-ok: single-owner slot",
+    ).replace(
+        "new_acc = plan.accs[d] + batch   # read outside the lock",
+        "new_acc = plan.accs[d] + batch  # lint: guarded-ok: single-owner slot",
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/foo.py": with_rationale})
+    assert locks.run(graph) == []
+
+
+def test_lock_pass_event_loop_guard(tmp_path):
+    source = """
+import threading
+
+class Controller:
+    def __init__(self):
+        self.depth = 0  # guarded-by: event-loop
+
+    def observe(self):
+        self.depth += 1
+
+def _sync_worker(ctl: Controller):
+    ctl.observe()
+
+async def _coro_worker(ctl: Controller):
+    ctl.observe()
+
+def spawn(ctl):
+    threading.Thread(target=_sync_worker, args=(ctl,))
+
+def spawn_loop_host(loop, ctl):
+    # a thread that runs an event loop: its coroutines execute ON the loop
+    threading.Thread(target=lambda: loop.run_until_complete(_coro_worker(ctl)))
+"""
+    graph = _graph(tmp_path, {"xaynet_tpu/ingest/foo.py": source})
+    findings = locks.run(graph)
+    # the sync chain is a foreign-thread touch; the coroutine chain is not
+    assert any("event-loop-confined" in f.message for f in findings)
+    assert all("_coro_worker" not in f.message for f in findings)
+
+
+# --- call-graph host-sync/purity pass ---------------------------------------
+
+# The old heuristic's documented false negative: tools/lint.py only walked
+# functions whose NAME starts with _prog, so a module-level helper called
+# FROM a program body escaped the purity check entirely.
+SIM_HELPER_LEAK = """
+import numpy as np
+import jax.numpy as jnp
+
+def leaky_helper(x):
+    return np.asarray(x)  # host sync, one call deep
+
+def traced_helper(x):
+    return jnp.asarray(x)  # trace-safe: jax.numpy, not numpy
+
+def _prog_round(x):
+    a = leaky_helper(x)
+    b = traced_helper(x)
+    return a, b
+"""
+
+
+def test_old_prefix_heuristic_misses_helper_one_call_deep(tmp_path):
+    """Regression fixture: the per-file rule (the pre-framework check)
+    reports NOTHING for a host sync inside a helper called from a _prog*
+    body — the false negative ISSUE 9 closes with the call-graph pass."""
+    path = tmp_path / "xaynet_tpu/sim/leak.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(SIM_HELPER_LEAK)
+    info = SourceCache(tmp_path).get(path)
+    assert not [f for f in check_file_info(info) if f.rule == "sync"]
+
+
+def test_callgraph_purity_pass_catches_the_helper(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/sim/leak.py": SIM_HELPER_LEAK})
+    findings = purity.run(graph)
+    assert any(
+        f.rule == "sync" and "leaky_helper" in f.message for f in findings
+    ), findings
+    # jnp.asarray is trace-safe and must NOT be flagged
+    assert not any("traced_helper" in f.message for f in findings)
+
+
+def test_bare_name_resolution_not_shadowed_by_out_of_scope_nested_def(tmp_path):
+    """A nested def in an UNRELATED method must not capture a bare-name
+    call (closure scoping is dot-boundary, not startswith) — otherwise a
+    module-level host-syncing helper called from a program body resolves
+    to the wrong function and the purity finding is silently lost."""
+    source = """
+import numpy as np
+
+def helper(x):
+    return np.asarray(x)  # the real callee: a host sync
+
+class SimRound:
+    def other(self):
+        def helper():  # same name, different (unreachable) scope
+            return 1
+        return helper()
+
+    def _prog_body(self, x):
+        return helper(x)  # must bind to the MODULE-level helper
+"""
+    findings = purity.run(_graph(tmp_path, {"xaynet_tpu/sim/shadow.py": source}))
+    assert any(
+        f.rule == "sync" and "'helper'" in f.message for f in findings
+    ), findings
+
+
+def test_purity_pass_cross_file_and_suppression(tmp_path):
+    files = {
+        "xaynet_tpu/sim/round.py": (
+            "from xaynet_tpu.ops.helpers import deep_helper\n"
+            "def _prog_round(x):\n"
+            "    return deep_helper(x)\n"
+        ),
+        "xaynet_tpu/ops/helpers.py": (
+            "def deep_helper(x):\n"
+            "    return x.item()\n"
+        ),
+    }
+    findings = purity.run(_graph(tmp_path, files))
+    assert any(
+        f.file == "xaynet_tpu/ops/helpers.py" and f.rule == "sync" for f in findings
+    ), findings
+
+    files["xaynet_tpu/ops/helpers.py"] = (
+        "def deep_helper(x):\n"
+        "    return x.item()  # lint: sync-ok\n"
+    )
+    assert purity.run(_graph(tmp_path, files)) == []
+
+
+def test_purity_fold_worker_leg(tmp_path):
+    source = """
+import threading
+import numpy as np
+
+class Pipe:
+    def start(self):
+        threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self.helper_with_odd_name()
+
+    def helper_with_odd_name(self):
+        return np.asarray([1])  # matches no worker prefix: old rule missed it
+
+    def drain(self):
+        return np.asarray([2])  # the sanctioned sync point
+"""
+    findings = purity.run(_graph(tmp_path, {"xaynet_tpu/parallel/pipe.py": source}))
+    assert any("helper_with_odd_name" in f.message for f in findings)
+    assert not any("'Pipe.drain'" in f.message for f in findings)
+
+
+# --- accounting-invariant pass ----------------------------------------------
+
+
+def test_invariant_pass_flags_unsanctioned_nb_models_mutation(tmp_path):
+    source = (
+        "def sneak_credit(agg, k):\n"
+        "    agg.nb_models += k\n"
+    )
+    findings = invariants.run(_graph(tmp_path, {"xaynet_tpu/server/sneak.py": source}))
+    assert any(f.rule == "invariant" and "nb_models" in f.message for f in findings)
+
+
+def test_invariant_pass_respects_whitelist_and_suppression(tmp_path):
+    # a whitelisted (file, qualname) site — mirrors the real masking.py entry
+    ok = (
+        "class Aggregation:\n"
+        "    def aggregate(self, obj):\n"
+        "        self.nb_models += 1\n"
+    )
+    findings = invariants.run(
+        _graph(tmp_path, {"xaynet_tpu/core/mask/masking.py": ok})
+    )
+    assert findings == []
+
+    suppressed_src = (
+        "def experiment(agg):\n"
+        "    agg.nb_models = 0  # lint: invariant-ok: scratch probe, not a round path\n"
+    )
+    findings = invariants.run(
+        _graph(tmp_path, {"xaynet_tpu/server/x.py": suppressed_src})
+    )
+    assert findings == []
+
+
+def test_invariant_pass_watches_edge_watermarks(tmp_path):
+    source = (
+        "def rewind(shared, edge):\n"
+        "    shared.edge_watermarks[edge] = 0\n"
+        "def wipe(shared):\n"
+        "    shared.edge_watermarks.clear()\n"
+    )
+    findings = invariants.run(_graph(tmp_path, {"xaynet_tpu/server/wm.py": source}))
+    assert len([f for f in findings if "watermark" in f.message]) == 2
+
+
+# --- metrics cross-check ----------------------------------------------------
+
+
+def _metrics_fixture(tmp_path, code: str, doc_rows: str):
+    src = tmp_path / "xaynet_tpu/mod.py"
+    src.parent.mkdir(parents=True, exist_ok=True)
+    src.write_text(code)
+    design = tmp_path / "DESIGN.md"
+    design.write_text(
+        "<!-- metrics-table:begin -->\n| Series | Type |\n|---|---|\n"
+        + doc_rows
+        + "\n<!-- metrics-table:end -->\n"
+    )
+    info = SourceCache(tmp_path).get(src)
+    return metricscheck.run([info], design)
+
+
+def test_metrics_parity_ok(tmp_path):
+    code = (
+        "from reg import get_registry\n"
+        "A = get_registry().counter('xaynet_foo_total', 'help')\n"
+        "B = get_registry().gauge('xaynet_bar_depth', 'help', ('shard',))\n"
+    )
+    rows = "| `xaynet_foo_total` | counter |\n| `xaynet_bar_depth{shard}` | gauge |"
+    assert _metrics_fixture(tmp_path, code, rows) == []
+
+
+def test_metrics_undocumented_and_stale_and_duplicate(tmp_path):
+    code = (
+        "from reg import get_registry\n"
+        "A = get_registry().counter('xaynet_foo_total', 'help')\n"
+        "B = get_registry().counter('xaynet_foo_total', 'help again')\n"
+    )
+    rows = "| `xaynet_gone_total` | counter |"
+    findings = _metrics_fixture(tmp_path, code, rows)
+    msgs = " | ".join(f.message for f in findings)
+    assert "registered more than once" in msgs
+    assert "not in the DESIGN.md metric tables" in msgs
+    assert "xaynet_gone_total" in msgs and "not registered" in msgs
+
+
+def test_metrics_brace_shorthand_expansion(tmp_path):
+    code = (
+        "from reg import get_registry\n"
+        "A = get_registry().gauge('xaynet_s_depth', 'h')\n"
+        "B = get_registry().gauge('xaynet_s_ratio', 'h')\n"
+    )
+    rows = "| `xaynet_s_{depth,ratio}` | gauge |"
+    assert _metrics_fixture(tmp_path, code, rows) == []
+
+
+# --- suppression / baseline mechanics ---------------------------------------
+
+
+def test_legacy_suppression_tokens_still_work():
+    assert suppressed("telemetry", "t = perf_counter()  # telemetry-exempt")
+    assert suppressed("sync", "x = np.asarray(y)  # lint: sync-ok")
+    assert not suppressed("guarded", "x = 1  # lint: guarded-ok")  # no rationale
+    assert suppressed("guarded", "x = 1  # lint: guarded-ok: single owner")
+
+
+def test_baseline_masks_known_findings(tmp_path):
+    f1 = Finding("sync", "a.py", 10, "host sync in helper")
+    f2 = Finding("sync", "a.py", 20, "host sync in helper")  # same key, 2nd slot
+    f3 = Finding("guarded", "b.py", 5, "unguarded access")
+    path = tmp_path / "baseline.json"
+    Baseline.write(path, [f1, f2])
+    baseline = Baseline.load(path)
+    new, masked = baseline.split([f1, f2, f3])
+    assert masked == [f1, f2] and new == [f3]
+    # one slot consumed per occurrence: a third identical finding is NEW
+    new, masked = baseline.split([f1, f2, Finding("sync", "a.py", 30, "host sync in helper")])
+    assert len(masked) == 2 and len(new) == 1
+
+
+def test_baseline_masked_findings_do_not_fail_the_driver(tmp_path, capsys):
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    (repo / "pkg" / "bad.py").write_text("import os\n")  # unused import
+    baseline = tmp_path / "baseline.json"
+    # first run fails, records the baseline, then passes
+    assert (
+        driver.run(repo, ["pkg"], use_cache=False, baseline_path=baseline) == 1
+    )
+    assert (
+        driver.run(
+            repo, ["pkg"], use_cache=False, baseline_path=baseline, update_baseline=True
+        )
+        == 0
+    )
+    assert driver.run(repo, ["pkg"], use_cache=False, baseline_path=baseline) == 0
+    out = capsys.readouterr()
+    assert "unused import" in out.out
+
+
+# --- result cache -----------------------------------------------------------
+
+
+def test_result_cache_roundtrip_and_invalidation(tmp_path):
+    cache_path = tmp_path / "cache.json"
+    cache = ResultCache(cache_path)
+    finding = Finding("fmt", "x.py", 3, "trailing whitespace")
+    cache.put_file("x.py", "key1", [finding])
+    cache.put_project("treekey", [])
+    cache.save()
+
+    fresh = ResultCache(cache_path)
+    assert fresh.get_file("x.py", "key1") == [finding]
+    assert fresh.get_file("x.py", "key2") is None  # content changed
+    assert fresh.get_project("treekey") == []
+    assert fresh.get_project("other") is None
+
+    disabled = ResultCache(cache_path, enabled=False)
+    assert disabled.get_file("x.py", "key1") is None
+
+
+def test_cached_run_is_fast_and_identical(tmp_path, capsys):
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    (repo / "pkg" / "a.py").write_text("import os\nx = 1\n")
+    baseline = tmp_path / "baseline.json"
+    rc1 = driver.run(repo, ["pkg"], baseline_path=baseline)
+    first = capsys.readouterr().out
+    rc2 = driver.run(repo, ["pkg"], baseline_path=baseline)
+    second = capsys.readouterr().out
+    assert (rc1, first) == (rc2, second)
+    assert (repo / ".lint-cache.json").exists()
+
+
+# --- --changed mode ---------------------------------------------------------
+
+
+def test_changed_files_sees_worktree_and_commit_diffs(tmp_path):
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        import pytest
+
+        pytest.skip("git unavailable")
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=repo, check=True, capture_output=True,
+            env={"HOME": str(tmp_path), "PATH": "/usr/bin:/bin:/usr/local/bin",
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    (repo / "a.py").write_text("x = 1\n")
+    git("add", "a.py")
+    git("commit", "-qm", "seed")
+    (repo / "a.py").write_text("x = 2\n")  # modified vs HEAD
+    (repo / "b.py").write_text("y = 1\n")  # untracked
+    changed = driver.changed_files(repo)
+    assert changed is not None and {"a.py", "b.py"} <= changed
+
+
+# --- the self-gate ----------------------------------------------------------
+
+
+def test_repo_tree_analyzes_clean_with_checked_in_baseline(capsys):
+    """The acceptance gate: the real tree passes --strict with zero
+    unsuppressed findings (and the checked-in baseline is empty, so they
+    are not baseline-masked either)."""
+    baseline = json.loads((REPO / "tools" / "analysis" / "baseline.json").read_text())
+    assert baseline["findings"] == {}, "the checked-in baseline must stay empty"
+    rc = driver.run(REPO, strict=True)
+    out = capsys.readouterr()
+    assert rc == 0, f"tree not clean:\n{out.out}"
+
+
+def test_strict_cli_flag_parses():
+    assert driver.main(["--strict"], repo=REPO) == 0

@@ -1,0 +1,6 @@
+"""Repo tooling (lint gate, bench gates, soak drivers).
+
+An ``__init__`` so ``tools.analysis`` is importable as a package from
+``tools/lint.py`` and the tests; the scripts in this directory remain
+directly runnable (``python tools/<script>.py``).
+"""

@@ -1,0 +1,43 @@
+"""Pass-based, cross-file static analysis for the xaynet-tpu tree.
+
+Replaces the flat rule list that used to live in ``tools/lint.py``
+(ISSUE 9): a shared per-file AST/symbol-table cache (:mod:`cache`), a
+project-wide call-graph builder (:mod:`callgraph`), a rule registry with
+per-rule suppression and a checked-in baseline (:mod:`core`), the ported
+per-file rules (:mod:`filerules`) and four deep passes:
+
+- :mod:`locks` — `# guarded-by:` lock-discipline race lint;
+- :mod:`purity` — call-graph host-sync/purity (sim programs and fold
+  workers), closing the name-prefix heuristics' false negatives;
+- :mod:`invariants` — sanctioned mutation sites of ``nb_models`` and the
+  per-edge seed watermark;
+- :mod:`metricscheck` — code <-> docs/DESIGN.md metric-table parity.
+
+``tools/lint.py`` remains the CLI (tier-1/CI invocation unchanged);
+docs/DESIGN.md §14 documents conventions and how to add a rule.
+"""
+
+from .cache import FileInfo, ResultCache, SourceCache
+from .callgraph import CallGraph, SymbolTable, thread_entry_points
+from .core import RULES, Baseline, Finding, Rule, suppressed
+from .driver import DEFAULT_TARGETS, Analyzer, main, run
+from .filerules import check_file_info
+
+__all__ = [
+    "Analyzer",
+    "Baseline",
+    "CallGraph",
+    "DEFAULT_TARGETS",
+    "FileInfo",
+    "Finding",
+    "ResultCache",
+    "RULES",
+    "Rule",
+    "SourceCache",
+    "SymbolTable",
+    "check_file_info",
+    "main",
+    "run",
+    "suppressed",
+    "thread_entry_points",
+]

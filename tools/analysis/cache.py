@@ -1,0 +1,235 @@
+"""Shared per-file AST/source cache and the persistent result cache.
+
+:class:`FileInfo` is the one parse of a file every pass shares: source
+text, split lines, the AST, the module's dotted name and its import
+table. :class:`SourceCache` memoizes them per run so the per-file rules,
+the call-graph builder and the deep passes never re-parse.
+
+:class:`ResultCache` persists *findings* between runs, keyed by content
+hash and invalidated by a digest of the analyzer's own sources — so the
+full-tree gate after a no-op edit costs one stat+hash sweep, not a
+re-analysis (ISSUE 9's "full-tree gate stays under a few seconds").
+The cache file lives at ``<repo>/.lint-cache.json`` and is gitignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+
+from .core import Finding
+
+
+def _sha1(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+class FileInfo:
+    """One parsed file: source, lines, AST, module identity, imports.
+
+    Reading + hashing is eager (the result cache keys on it); decoding,
+    parsing and the import table are lazy, so a cache hit never pays for
+    ``ast.parse``.
+    """
+
+    def __init__(self, repo: Path, path: Path):
+        self.repo = Path(repo)
+        self.path = Path(path)
+        self.rel = self.path.relative_to(self.repo).as_posix()
+        self._raw = self.path.read_bytes()
+        self.content_key = _sha1(self._raw)
+        self._loaded = False
+        self._problems: list[Finding] = []  # load/parse failures
+        self._text: str | None = None
+        self._lines: list[str] = []
+        self._tree: ast.Module | None = None
+        self._imports: dict[str, str] | None = None
+        # dotted module name ("xaynet_tpu.parallel.streaming"); packages
+        # drop the trailing __init__
+        parts = list(Path(self.rel).with_suffix("").parts)
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.module = ".".join(parts)
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            self._text = self._raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            self._problems.append(
+                Finding("encoding", self.rel, 1, f"not valid UTF-8: {e}")
+            )
+            return
+        self._lines = self._text.splitlines()
+        try:
+            self._tree = ast.parse(self._text, filename=self.rel)
+        except SyntaxError as e:
+            self._problems.append(
+                Finding("syntax", self.rel, e.lineno or 1, f"syntax error: {e.msg}")
+            )
+
+    @property
+    def problems(self) -> list[Finding]:
+        self._load()
+        return self._problems
+
+    @property
+    def text(self) -> str | None:
+        self._load()
+        return self._text
+
+    @property
+    def lines(self) -> list[str]:
+        self._load()
+        return self._lines
+
+    @property
+    def tree(self) -> ast.Module | None:
+        self._load()
+        return self._tree
+
+    @property
+    def imports(self) -> dict[str, str]:
+        if self._imports is None:
+            self._imports = self._import_table()
+        return self._imports
+
+    def line(self, lineno: int) -> str:
+        self._load()
+        return self._lines[lineno - 1] if 0 < lineno <= len(self._lines) else ""
+
+    def _import_table(self) -> dict[str, str]:
+        """local name -> dotted target ("np" -> "numpy", "limbs_jax" ->
+        "xaynet_tpu.ops.limbs_jax", "mod_add" -> "x.ops.limbs_jax.mod_add").
+        Relative imports resolve against this file's package."""
+        table: dict[str, str] = {}
+        if self.tree is None:
+            return table
+        pkg_parts = self.module.split(".") if self.module else []
+        if not self.rel.endswith("__init__.py"):
+            pkg_parts = pkg_parts[:-1]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    table[local] = alias.name if alias.asname else local
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    prefix = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    table[local] = f"{prefix}.{alias.name}" if prefix else alias.name
+        return table
+
+
+class SourceCache:
+    """Per-run FileInfo memo (the shared AST/symbol-table cache)."""
+
+    def __init__(self, repo: Path):
+        self.repo = Path(repo)
+        self._files: dict[str, FileInfo] = {}
+
+    def get(self, path: Path) -> FileInfo:
+        key = str(path)
+        info = self._files.get(key)
+        if info is None:
+            info = self._files[key] = FileInfo(self.repo, path)
+        return info
+
+
+def tool_digest() -> str:
+    """Digest of the analyzer's own sources — any change to a rule or a
+    pass invalidates every cached result."""
+    here = Path(__file__).resolve().parent
+    h = hashlib.sha1()
+    for p in sorted(here.glob("*.py")) + [here.parent / "lint.py"]:
+        if p.exists():
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """mtime/hash-keyed persistent findings cache.
+
+    ``files``: rel -> {"key": content sha1, "findings": [...]} for the
+    per-file rules. ``project``: one entry keyed by the digest of every
+    analyzed file (plus docs/DESIGN.md) for the cross-file passes.
+    """
+
+    VERSION = 1
+
+    def __init__(self, path: Path, enabled: bool = True):
+        self.path = Path(path)
+        self.enabled = enabled
+        self.digest = tool_digest()
+        self._dirty = False
+        self._data = {"version": self.VERSION, "tool": self.digest, "files": {}, "project": {}}
+        if enabled and self.path.exists():
+            try:
+                data = json.loads(self.path.read_text())
+            except (OSError, json.JSONDecodeError):
+                data = None
+            if (
+                isinstance(data, dict)
+                and data.get("version") == self.VERSION
+                and data.get("tool") == self.digest
+            ):
+                self._data = data
+
+    # -- per-file findings -------------------------------------------------
+
+    def get_file(self, rel: str, content_key: str) -> list[Finding] | None:
+        if not self.enabled:
+            return None
+        entry = self._data["files"].get(rel)
+        if not entry or entry.get("key") != content_key:
+            return None
+        return [Finding.from_json(obj) for obj in entry["findings"]]
+
+    def put_file(self, rel: str, content_key: str, findings: list[Finding]) -> None:
+        if not self.enabled:
+            return
+        self._data["files"][rel] = {
+            "key": content_key,
+            "findings": [f.to_json() for f in findings],
+        }
+        self._dirty = True
+
+    # -- whole-tree pass results -------------------------------------------
+
+    def get_project(self, tree_key: str) -> list[Finding] | None:
+        if not self.enabled:
+            return None
+        entry = self._data["project"]
+        if entry.get("key") != tree_key:
+            return None
+        return [Finding.from_json(obj) for obj in entry["findings"]]
+
+    def put_project(self, tree_key: str, findings: list[Finding]) -> None:
+        if not self.enabled:
+            return
+        self._data["project"] = {
+            "key": tree_key,
+            "findings": [f.to_json() for f in findings],
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not (self.enabled and self._dirty):
+            return
+        try:
+            self.path.write_text(json.dumps(self._data))
+        except OSError:
+            pass  # a read-only checkout just loses the speedup

@@ -1,0 +1,496 @@
+"""Project-wide symbol table and call graph.
+
+Conservative, name-based resolution over the shared per-file ASTs:
+
+- every ``def``/``async def``/``lambda`` becomes a :class:`FuncInfo` with
+  a qualname and (when lexically inside a class) its class;
+- call *and bare reference* edges — passing ``self._worker`` to
+  ``Thread(target=...)`` or ``_prog_derive`` to ``jax.vmap`` is an edge,
+  which is what lets reachability see through higher-order wrappers
+  (``vmap``/``scan``/``shard_map``/executor ``submit``/``map``);
+- attribute calls resolve through a small flow-insensitive type sketch:
+  ``self.x`` types recorded from ``self.x = ClassName(...)`` assignments
+  and annotations, parameter/return annotations, and local
+  ``v = ClassName(...)`` / ``v = self.x`` assignments. Receivers typed to
+  an *external* module (numpy, jax, stdlib) produce no edge;
+- unresolvable attribute calls fall back to a global method-name match,
+  dropped entirely when more than :data:`AMBIGUITY_CUTOFF` definitions
+  share the name (a ``.get``/``.close`` edge to thirty classes would make
+  reachability meaningless). This trades a sliver of soundness for a
+  usable signal; docs/DESIGN.md §14 records the limitation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .cache import FileInfo
+
+AMBIGUITY_CUTOFF = 6
+
+# Ubiquitous object-lifecycle/container verbs are excluded from the
+# *untyped-receiver* fallback: `t.start()` on a stdlib Thread must not
+# edge into every project class with a `start`. Typed receivers (the
+# attr/local sketch) still resolve these precisely.
+_FALLBACK_STOPLIST = frozenset(
+    {
+        "start", "stop", "run", "close", "join", "get", "put", "append",
+        "clear", "update", "pop", "read", "write", "send", "recv",
+        "acquire", "release", "set", "inc", "dec", "labels", "observe",
+        "items", "values", "keys", "encode", "decode", "copy", "add",
+    }
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class FuncInfo:
+    """One function/method/lambda definition."""
+
+    __slots__ = ("node", "name", "qualname", "cls", "file", "uid", "returns")
+
+    def __init__(self, node, name: str, qualname: str, cls: Optional[str], file: FileInfo):
+        self.node = node
+        self.name = name
+        self.qualname = qualname
+        self.cls = cls  # nearest lexically-enclosing class, or None
+        self.file = file
+        self.uid = f"{file.rel}::{qualname}"
+        self.returns = None  # simple return-annotation class name, or None
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.returns = _ann_name(node.returns)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<FuncInfo {self.uid}>"
+
+
+def _ann_name(ann) -> Optional[str]:
+    """Best-effort class name out of an annotation node (``Foo``,
+    ``"Foo"``, ``mod.Foo``, ``Optional[Foo]`` -> ``Foo``)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Subscript):  # Optional[Foo] / weakref.ref[Foo]
+        base = _ann_name(ann.value)
+        if base in ("Optional", "ref"):
+            return _ann_name(ann.slice)
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):  # Foo | None
+        return _ann_name(ann.left) or _ann_name(ann.right)
+    return None
+
+
+def iter_owned_nodes(fn_node):
+    """Walk a function's body, NOT descending into nested defs/lambdas
+    (those are their own FuncInfos)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    if isinstance(fn_node, ast.Lambda):
+        stack = [fn_node.body]
+    for default in getattr(getattr(fn_node, "args", None), "defaults", []) or []:
+        stack.append(default)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SymbolTable:
+    """Indexes over every FuncInfo and class in the analyzed tree."""
+
+    def __init__(self, files: list[FileInfo]):
+        self.files = [f for f in files if f.tree is not None and f.rel.endswith(".py")]
+        self.functions: list[FuncInfo] = []
+        self.by_uid: dict[str, FuncInfo] = {}
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        # (rel, class) -> {method name -> FuncInfo}
+        self.class_methods: dict[tuple[str, str], dict[str, FuncInfo]] = {}
+        # module -> {top-level function name -> FuncInfo}
+        self.module_funcs: dict[str, dict[str, FuncInfo]] = {}
+        # class simple name -> [(rel, class)]
+        self.classes: dict[str, list[tuple[str, str]]] = {}
+        # (rel, class) -> {self attr -> class simple name}
+        self.attr_types: dict[tuple[str, str], dict[str, str]] = {}
+        # FuncInfo containing each ast function node (for parent lookups)
+        self.node_owner: dict[int, FuncInfo] = {}
+        for f in self.files:
+            self._index_file(f)
+
+    # -- construction ------------------------------------------------------
+
+    def _index_file(self, f: FileInfo) -> None:
+        def visit(node, qual: list[str], cls: Optional[str], depth: int):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    self.classes.setdefault(child.name, []).append((f.rel, child.name))
+                    self.class_methods.setdefault((f.rel, child.name), {})
+                    self.attr_types.setdefault((f.rel, child.name), {})
+                    visit(child, qual + [child.name], child.name, depth)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = ".".join(qual + [child.name])
+                    fi = FuncInfo(child, child.name, qn, cls, f)
+                    self._add(fi, depth, qual)
+                    visit(child, qual + [child.name], cls, depth + 1)
+                elif isinstance(child, ast.Lambda):
+                    # lambdas hide anywhere (args to Thread/vmap/map, defaults)
+                    name = f"<lambda:{child.lineno}>"
+                    fi = FuncInfo(child, name, ".".join(qual + [name]), cls, f)
+                    self._add(fi, depth + 1, qual)
+                    visit(child, qual, cls, depth + 1)
+                else:
+                    visit(child, qual, cls, depth)
+
+        visit(f.tree, [], None, 0)
+        # self-attribute type sketch: self.x = ClassName(...) / self.x: T
+        for (rel, cls), methods in self.class_methods.items():
+            if rel != f.rel:
+                continue
+            sketch = self.attr_types[(rel, cls)]
+            for fi in methods.values():
+                args = getattr(fi.node, "args", None)
+                param_types: dict[str, str] = {}
+                if args is not None:
+                    for a in args.args + args.posonlyargs + args.kwonlyargs:
+                        ann = _ann_name(a.annotation)
+                        if ann:
+                            param_types[a.arg] = ann
+                for node in iter_owned_nodes(fi.node):
+                    target = None
+                    value = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        target, value = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        target, value = node.target, node.value
+                        if isinstance(target, ast.Attribute) and _is_self(target.value):
+                            ann = _ann_name(node.annotation)
+                            if ann:
+                                sketch.setdefault(target.attr, ann)
+                    if not (isinstance(target, ast.Attribute) and _is_self(target.value)):
+                        continue
+                    if isinstance(value, ast.Call):
+                        cname = _call_class_name(value)
+                        if cname:
+                            sketch.setdefault(target.attr, cname)
+                    elif isinstance(value, ast.Name) and value.id in param_types:
+                        # self.plan = plan, with `plan: Plan` in the signature
+                        sketch.setdefault(target.attr, param_types[value.id])
+
+    def _add(self, fi: FuncInfo, depth: int, qual: list[str]) -> None:
+        self.functions.append(fi)
+        self.by_uid[fi.uid] = fi
+        self.by_name.setdefault(fi.name, []).append(fi)
+        self.node_owner[id(fi.node)] = fi
+        if fi.cls is not None and qual and qual[-1] == fi.cls:
+            self.class_methods.setdefault((fi.file.rel, fi.cls), {})[fi.name] = fi
+        elif depth == 0 and not qual:
+            self.module_funcs.setdefault(fi.file.module, {})[fi.name] = fi
+
+    # -- queries -----------------------------------------------------------
+
+    def method(self, rel: str, cls: str, name: str) -> Optional[FuncInfo]:
+        return self.class_methods.get((rel, cls), {}).get(name)
+
+    def methods_named(self, name: str) -> list[FuncInfo]:
+        return [fi for fi in self.by_name.get(name, []) if fi.cls is not None]
+
+    def class_named(self, name: str) -> list[tuple[str, str]]:
+        return self.classes.get(name, [])
+
+
+def _is_self(node) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _call_class_name(call: ast.Call) -> Optional[str]:
+    """``ClassName(...)`` / ``mod.ClassName(...)`` -> "ClassName" when it
+    looks like a class construction (CapWord heuristic)."""
+    func = call.func
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    if name and name[:1].isupper():
+        return name
+    return None
+
+
+class CallGraph:
+    """Edges (including bare references) between FuncInfos."""
+
+    def __init__(self, symbols: SymbolTable):
+        self.symbols = symbols
+        self._types_memo: dict[str, dict[str, str]] = {}
+        self.edges: dict[str, set[str]] = {}
+        for fi in symbols.functions:
+            self.edges[fi.uid] = self._edges_of(fi)
+
+    # -- per-function local type sketch ------------------------------------
+
+    def _local_types(self, fi: FuncInfo) -> dict[str, str]:
+        """variable -> class simple name, from annotations and trivial
+        assignments (flow-insensitive: last writer wins is fine here)."""
+        memo = self._types_memo.get(fi.uid)
+        if memo is not None:
+            return memo
+        types: dict[str, str] = {}
+        self._types_memo[fi.uid] = types
+        node = fi.node
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in args.args + args.posonlyargs + args.kwonlyargs:
+                ann = _ann_name(a.annotation)
+                if ann:
+                    types[a.arg] = ann
+        cls_sketch = (
+            self.symbols.attr_types.get((fi.file.rel, fi.cls), {}) if fi.cls else {}
+        )
+        for sub in iter_owned_nodes(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t, v = sub.targets[0], sub.value
+                if not isinstance(t, ast.Name):
+                    continue
+                if isinstance(v, ast.IfExp):  # X(...) if cond else None
+                    v = v.body if not _is_none(v.body) else v.orelse
+                if isinstance(v, ast.Call):
+                    cname = _call_class_name(v)
+                    if cname:
+                        types[t.id] = cname
+                        continue
+                    callee = v.func
+                    # self = ref() — the weakref-deref worker idiom: calling
+                    # a ref[T]-typed name yields a T
+                    if isinstance(callee, ast.Name) and callee.id in types:
+                        types[t.id] = types[callee.id]
+                        continue
+                    # v = self.meth(...) with a return annotation
+                    if (
+                        isinstance(callee, ast.Attribute)
+                        and _is_self(callee.value)
+                        and fi.cls
+                    ):
+                        m = self.symbols.method(fi.file.rel, fi.cls, callee.attr)
+                        if m and m.returns:
+                            types[t.id] = m.returns
+                elif isinstance(v, ast.Attribute) and _is_self(v.value):
+                    cname = cls_sketch.get(v.attr)
+                    if cname:
+                        types[t.id] = cname
+        return types
+
+    # -- edge construction -------------------------------------------------
+
+    def _resolve_class_method(self, cname: str, meth: str, near: FileInfo) -> list[FuncInfo]:
+        """Methods named ``meth`` on classes named ``cname`` (same file
+        preferred, then anywhere)."""
+        hits = []
+        for rel, cls in self.symbols.class_named(cname):
+            m = self.symbols.method(rel, cls, meth)
+            if m is not None:
+                hits.append(m)
+        same = [m for m in hits if m.file.rel == near.rel]
+        return same or hits
+
+    def _resolve_name(self, name: str, fi: FuncInfo) -> list[FuncInfo]:
+        """A bare ``Name`` in fi's body: closure-visible nested defs,
+        module functions, then the import table."""
+        # closure scoping: a bare name binds to a def whose PARENT is one
+        # of fi's enclosing *function* scopes (dot-boundary match — a bald
+        # startswith would let `Cls.other.helper` shadow a module-level
+        # `helper` called from `Cls.body`; class scopes don't leak into
+        # methods, so the parent must itself be a FuncInfo)
+        parts = fi.qualname.split(".")
+        scopes = {".".join(parts[:i]) for i in range(1, len(parts) + 1)}
+        for cand in self.symbols.by_name.get(name, []):
+            if cand.file.rel != fi.file.rel or cand.uid == fi.uid:
+                continue
+            parent = cand.qualname.rsplit(".", 1)[0] if "." in cand.qualname else ""
+            if (
+                parent
+                and parent in scopes
+                and f"{cand.file.rel}::{parent}" in self.symbols.by_uid
+            ):
+                return [cand]
+        mod = self.symbols.module_funcs.get(fi.file.module, {})
+        if name in mod:
+            return [mod[name]]
+        dotted = fi.file.imports.get(name)
+        if dotted:
+            mod_name, _, attr = dotted.rpartition(".")
+            target = self.symbols.module_funcs.get(mod_name, {}).get(attr)
+            if target is not None:
+                return [target]
+            return []  # external import — no project edge
+        return []
+
+    def _resolve_attr_call(self, node: ast.Attribute, fi: FuncInfo, types: dict) -> list[FuncInfo]:
+        meth = node.attr
+        recv = node.value
+        # self.meth()
+        if _is_self(recv) and fi.cls:
+            m = self.symbols.method(fi.file.rel, fi.cls, meth)
+            if m is not None:
+                return [m]
+            return self._fallback(meth)
+        # NAME.meth() — typed local, imported module, or fallback
+        if isinstance(recv, ast.Name):
+            cname = types.get(recv.id)
+            if cname:
+                hits = self._resolve_class_method(cname, meth, fi.file)
+                if hits:
+                    return hits
+                return []  # typed to a class without that method: no edge
+            dotted = fi.file.imports.get(recv.id)
+            if dotted is not None:
+                target = self.symbols.module_funcs.get(dotted, {}).get(meth)
+                return [target] if target is not None else []
+            return self._fallback(meth)
+        # self.attr.meth() — via the class attr sketch
+        if (
+            isinstance(recv, ast.Attribute)
+            and _is_self(recv.value)
+            and fi.cls is not None
+        ):
+            cname = self.symbols.attr_types.get((fi.file.rel, fi.cls), {}).get(recv.attr)
+            if cname:
+                hits = self._resolve_class_method(cname, meth, fi.file)
+                if hits:
+                    return hits
+                return []
+        return self._fallback(meth)
+
+    def _fallback(self, meth: str) -> list[FuncInfo]:
+        if meth in _FALLBACK_STOPLIST:
+            return []
+        cands = self.symbols.methods_named(meth)
+        if 0 < len(cands) <= AMBIGUITY_CUTOFF:
+            return cands
+        return []
+
+    def _edges_of(self, fi: FuncInfo) -> set[str]:
+        out: set[str] = set()
+        types = self._local_types(fi)
+        for node in iter_owned_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Name):
+                    for t in self._resolve_name(func.id, fi):
+                        out.add(t.uid)
+                elif isinstance(func, ast.Attribute):
+                    for t in self._resolve_attr_call(func, fi, types):
+                        out.add(t.uid)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                # bare reference: f passed to vmap/scan/Thread/submit/...
+                for t in self._resolve_name(node.id, fi):
+                    out.add(t.uid)
+            elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                # self.meth / obj.meth referenced without a call
+                parent_is_call = False  # handled above when it IS the callee
+                if not parent_is_call and _is_self(node.value) and fi.cls:
+                    m = self.symbols.method(fi.file.rel, fi.cls, node.attr)
+                    if m is not None:
+                        out.add(m.uid)
+            elif isinstance(node, _FUNC_NODES):
+                # owning a nested def/lambda counts as referencing it
+                owner = self.symbols.node_owner.get(id(node))
+                if owner is not None:
+                    out.add(owner.uid)
+        return out
+
+    # -- reachability ------------------------------------------------------
+
+    def reachable(self, roots: Iterable[FuncInfo], through_async: bool = True) -> set[str]:
+        """Transitive closure over call/reference edges.
+
+        ``through_async=False`` stops at coroutine boundaries: entering an
+        ``async def`` means execution moved onto an event loop (whatever
+        thread hosts it), so event-loop-confinement checks must not follow
+        the edge. Lock-discipline checks DO follow it — coroutine code
+        races against worker threads on lock-guarded state just fine.
+        """
+        seen: set[str] = set()
+        stack = [r.uid for r in roots]
+        while stack:
+            uid = stack.pop()
+            if uid in seen:
+                continue
+            seen.add(uid)
+            for nxt in self.edges.get(uid, ()):
+                if not through_async:
+                    fi = self.symbols.by_uid.get(nxt)
+                    if fi is not None and isinstance(fi.node, ast.AsyncFunctionDef):
+                        continue
+                stack.append(nxt)
+        return seen
+
+
+def thread_entry_points(graph: CallGraph) -> list[FuncInfo]:
+    """Worker-thread entry points, project-wide:
+
+    - ``threading.Thread(target=X)`` (any spelling of ``Thread``);
+    - ``<executor>.submit(X, ...)`` / ``<executor>.map(X, ...)``;
+    - ``loop.run_in_executor(pool, X, ...)``.
+
+    ``X`` resolves like any reference (names, ``self.meth``, lambdas);
+    lambdas become entries themselves so their bodies are analyzed.
+    Memoized per graph (several passes ask).
+    """
+    memo = getattr(graph, "_entries_memo", None)
+    if memo is not None:
+        return memo
+    symbols = graph.symbols
+    entries: list[FuncInfo] = []
+
+    def resolve_target(expr, fi: FuncInfo) -> list[FuncInfo]:
+        if isinstance(expr, ast.Lambda):
+            owner = symbols.node_owner.get(id(expr))
+            return [owner] if owner is not None else []
+        if isinstance(expr, ast.Name):
+            return graph._resolve_name(expr.id, fi)
+        if isinstance(expr, ast.Attribute):
+            return graph._resolve_attr_call(expr, fi, graph._local_types(fi))
+        return []
+
+    for fi in symbols.functions:
+        for node in iter_owned_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            callee = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            targets: list = []
+            if callee == "Thread":
+                targets = [kw.value for kw in node.keywords if kw.arg == "target"]
+            elif callee in ("submit", "run_in_executor") and node.args:
+                idx = 1 if callee == "run_in_executor" and len(node.args) > 1 else 0
+                targets = [node.args[idx]]
+            elif callee == "map" and isinstance(func, ast.Attribute) and node.args:
+                # executor .map only — builtin map(fn, ...) runs inline
+                targets = [node.args[0]]
+            for t_expr in targets:
+                entries.extend(resolve_target(t_expr, fi))
+    # dedupe, stable order
+    seen: set[str] = set()
+    out = []
+    for e in entries:
+        if e.uid not in seen:
+            seen.add(e.uid)
+            out.append(e)
+    graph._entries_memo = out
+    return out

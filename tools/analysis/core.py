@@ -1,0 +1,191 @@
+"""Findings, rules, suppression and baseline — the analysis data model.
+
+Every check in the framework is a registered :class:`Rule` with a stable
+name. A rule's findings can be silenced three ways, in order of intent:
+
+- **fix the code** (the default expectation);
+- **per-line suppression** — ``# lint: <token>-ok`` on the offending
+  line, where ``<token>`` is the rule's suppression token. Rules marked
+  ``rationale_required`` additionally demand a human-readable reason on
+  the same line (``# lint: guarded-ok: single-owner shard buffer``) —
+  a bare token does NOT suppress them;
+- **baseline** — a checked-in JSON file of known findings
+  (``tools/analysis/baseline.json``) for gradual adoption: baselined
+  findings are reported as *masked* and don't fail the gate, new ones do.
+
+Baseline keys deliberately exclude line numbers so unrelated edits above
+a known finding don't churn the file.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One registered check."""
+
+    name: str
+    token: str  # per-line suppression token: `# lint: <token>-ok`
+    doc: str
+    rationale_required: bool = False
+    legacy_tokens: tuple[str, ...] = ()  # pre-framework spellings
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(
+    name: str,
+    doc: str,
+    token: str | None = None,
+    rationale_required: bool = False,
+    legacy_tokens: tuple[str, ...] = (),
+) -> Rule:
+    r = Rule(name, token or name, doc, rationale_required, tuple(legacy_tokens))
+    RULES[name] = r
+    return r
+
+
+# --- the rule inventory (docs/DESIGN.md §14 mirrors this) -------------------
+
+rule("encoding", "file is not valid UTF-8")
+rule("syntax", "file does not parse")
+rule("fmt", "tabs in indentation / trailing whitespace / missing final newline / long lines")
+rule("star-import", "`from x import *`")
+rule("unused-import", "module-scope import never referenced")
+rule("mutable-default", "list/dict/set literal as a default argument")
+rule("bare-except", "`except:` without an exception type")
+rule("dup-key", "duplicate literal key in a dict display")
+rule(
+    "telemetry",
+    "raw time.perf_counter() in the hot-path trees (must flow through "
+    "xaynet_tpu.telemetry)",
+    legacy_tokens=("telemetry-exempt",),
+)
+rule("unbounded", "bare unbounded asyncio.Queue() in the coordinator trees")
+rule("device-put", "direct jax.device_put in the coordinator trees")
+rule("swallow", "silent broad-exception swallow in the coordinator/storage trees")
+rule("raw-http", "raw HTTP/socket transport call in the SDK tree")
+rule("fold", "direct masked_add/fold call in the edge tree")
+rule(
+    "sync",
+    "blocking host sync / host round-trip in fold-worker or jitted sim "
+    "program code (lexical prefix rule AND the call-graph purity pass)",
+)
+rule(
+    "guarded",
+    "read/write of a `# guarded-by:` attribute from worker-thread-reachable "
+    "code outside its lock",
+    rationale_required=True,
+)
+rule(
+    "invariant",
+    "mutation of nb_models / the per-edge seed watermark outside the "
+    "sanctioned accounting sites (the nb_models == seed-watermark unmask "
+    "linchpin, docs/DESIGN.md §9–§11)",
+    rationale_required=True,
+)
+rule(
+    "metrics",
+    "xaynet_* metric registered more than once, or code <-> DESIGN.md "
+    "metric-table drift",
+)
+
+
+def suppressed(rule_name: str, line: str) -> bool:
+    """True when ``line`` carries a valid suppression for ``rule_name``.
+
+    For ``rationale_required`` rules the ``# lint: <token>-ok`` marker must
+    be followed by a non-empty rationale (after ``:``/``—``/``-``/spaces);
+    a bare marker does not count.
+    """
+    r = RULES[rule_name]
+    marker = f"lint: {r.token}-ok"
+    if marker in line:
+        if not r.rationale_required:
+            return True
+        rest = line[line.index(marker) + len(marker):]
+        return bool(rest.strip(" \t:—–-.,()"))
+    return any(tok in line for tok in r.legacy_tokens)
+
+
+def suppression_pending_rationale(rule_name: str, line: str) -> bool:
+    """True when the line carries the rule's marker but no rationale (only
+    meaningful for rationale-required rules — used to improve messages)."""
+    r = RULES[rule_name]
+    marker = f"lint: {r.token}-ok"
+    return r.rationale_required and marker in line and not suppressed(rule_name, line)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def legacy(self) -> str:
+        """The pre-framework one-line format (what CI logs and the older
+        tests grep)."""
+        return f"{self.file}:{self.line}: {self.message}"
+
+    def key(self) -> str:
+        """Baseline identity: rule + file + message, no line number."""
+        return f"{self.rule}|{self.file}|{self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Finding":
+        return cls(obj["rule"], obj["file"], int(obj["line"]), obj["message"])
+
+
+class Baseline:
+    """Checked-in known findings; keys are :meth:`Finding.key` with counts
+    (several identical findings in one file consume several slots)."""
+
+    def __init__(self, counts: dict[str, int]):
+        self.counts = dict(counts)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls({})
+        data = json.loads(path.read_text())
+        return cls({str(k): int(v) for k, v in (data.get("findings") or {}).items()})
+
+    @staticmethod
+    def write(path: Path, findings: list[Finding]) -> None:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.key()] = counts.get(f.key(), 0) + 1
+        path.write_text(
+            json.dumps(
+                {"version": 1, "findings": dict(sorted(counts.items()))}, indent=2
+            )
+            + "\n"
+        )
+
+    def split(self, findings: list[Finding]) -> tuple[list[Finding], list[Finding]]:
+        """(new, masked): masked findings consume baseline slots per key."""
+        budget = dict(self.counts)
+        new: list[Finding] = []
+        masked: list[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule)):
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                masked.append(f)
+            else:
+                new.append(f)
+        return new, masked

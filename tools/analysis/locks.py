@@ -1,0 +1,176 @@
+"""Lock-discipline race lint (`# guarded-by:` annotations).
+
+Convention (docs/DESIGN.md §14): a shared mutable attribute of a threaded
+class is annotated where it is initialized::
+
+    self._pending = []  # guarded-by: _lock
+    self.max_occupancy = 0  # guarded-by: event-loop
+
+``_lock`` names a lock attribute; the special guard ``event-loop`` marks
+asyncio-confined state that NO thread may touch. The pass then flags any
+read or write of a guarded attribute from a function *reachable from a
+worker-thread entry point* (``Thread(target=...)``, executor
+``submit``/``map`` — see :func:`callgraph.thread_entry_points`) that is
+not lexically inside a ``with <lock>:`` block for the matching lock.
+This is exactly the access pattern behind the PR-7 torn-shard-slice race
+(concurrent donating jit calls on per-shard accumulators), turned into a
+compile-time finding.
+
+Scope and honesty limits (deliberate, documented):
+
+- accesses are matched on ``self.<attr>`` plus ``<var>.<attr>`` where the
+  receiver's class is known from the type sketch (parameter annotations,
+  ``v = ClassName(...)``); untyped receivers are not matched;
+- lock matching is lexical and name-based: any ``with`` whose context
+  expression *ends in* the guard name counts (``with self._lock:``,
+  ``with plan._device_dispatch_lock:``). ``.acquire()``/``.release()``
+  pairs do NOT count — convert them or suppress with a rationale;
+- suppression requires a rationale: ``# lint: guarded-ok: <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import CallGraph, _is_self, iter_owned_nodes, thread_entry_points
+from .core import Finding, suppressed, suppression_pending_rationale
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w\-]*)")
+EVENT_LOOP_GUARDS = ("event-loop", "asyncio-loop")
+
+
+def collect_guarded(graph: CallGraph) -> dict[tuple[str, str], dict[str, str]]:
+    """(rel, class) -> {attr: guard} from ``# guarded-by:`` annotations on
+    ``self.<attr> = ...`` initialization lines."""
+    out: dict[tuple[str, str], dict[str, str]] = {}
+    for (rel, cls), methods in graph.symbols.class_methods.items():
+        gmap: dict[str, str] = {}
+        for fi in methods.values():
+            info = fi.file
+            for node in iter_owned_nodes(fi.node):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and _is_self(t.value):
+                        m = GUARDED_RE.search(info.line(t.lineno))
+                        if m:
+                            gmap[t.attr] = m.group(1)
+        if gmap:
+            out[(rel, cls)] = gmap
+    return out
+
+
+def _held_locks(fn_node) -> dict[int, frozenset]:
+    """node id -> set of lock names lexically held at that node (terminal
+    names of ``with`` context expressions)."""
+    held_at: dict[int, frozenset] = {}
+
+    def terminal_name(expr) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    def walk(node, held: frozenset):
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                names = set()
+                for item in child.items:
+                    n = terminal_name(item.context_expr)
+                    if n:
+                        names.add(n)
+                child_held = held | frozenset(names)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # separate FuncInfo, analyzed on its own
+            held_at[id(child)] = child_held
+            walk(child, child_held)
+
+    held_at[id(fn_node)] = frozenset()
+    walk(fn_node, frozenset())
+    return held_at
+
+
+def run(graph: CallGraph) -> list[Finding]:
+    symbols = graph.symbols
+    guarded = collect_guarded(graph)
+    if not guarded:
+        return []
+    # guard lookup by class simple name (for typed non-self receivers)
+    by_class_name: dict[str, list[tuple[tuple[str, str], dict[str, str]]]] = {}
+    for key, gmap in guarded.items():
+        by_class_name.setdefault(key[1], []).append((key, gmap))
+
+    entries = thread_entry_points(graph)
+    reach = graph.reachable(entries)
+    # event-loop confinement stops at coroutine boundaries: a thread that
+    # RUNS an asyncio loop (the SDK's in-process federation, run_until_
+    # complete) executes its coroutines ON the loop — only a sync-only
+    # chain from a thread entry to the access is a foreign-thread touch
+    reach_sync = graph.reachable(entries, through_async=False)
+    findings: list[Finding] = []
+
+    for fi in symbols.functions:
+        if fi.uid not in reach:
+            continue
+        own_guards = guarded.get((fi.file.rel, fi.cls or ""), {})
+        types = graph._local_types(fi)
+        held_at = _held_locks(fi.node)
+        flagged: set[tuple[int, str]] = set()
+        for node in iter_owned_nodes(fi.node):
+            if not (isinstance(node, ast.Attribute) and isinstance(node.ctx, (ast.Load, ast.Store, ast.Del))):
+                continue
+            attr = node.attr
+            guard = None
+            cls_label = fi.cls
+            if _is_self(node.value) and attr in own_guards:
+                if fi.name == "__init__":
+                    continue  # construction happens-before thread start
+                guard = own_guards[attr]
+            elif isinstance(node.value, ast.Name):
+                cname = types.get(node.value.id)
+                if cname:
+                    for (rel_cls, gmap) in by_class_name.get(cname, []):
+                        if attr in gmap:
+                            guard = gmap[attr]
+                            cls_label = cname
+                            break
+            if guard is None:
+                continue
+            held = held_at.get(id(node), frozenset())
+            is_loop_guard = guard in EVENT_LOOP_GUARDS
+            if is_loop_guard and fi.uid not in reach_sync:
+                continue  # only reachable through a coroutine: loop context
+            if not is_loop_guard and guard in held:
+                continue
+            key = (node.lineno, attr)
+            if key in flagged:
+                continue
+            flagged.add(key)
+            line = fi.file.line(node.lineno)
+            if suppressed("guarded", line):
+                continue
+            pending = suppression_pending_rationale("guarded", line)
+            if is_loop_guard:
+                msg = (
+                    f"'{cls_label}.{attr}' is event-loop-confined (guarded-by: "
+                    f"{guard}) but '{fi.qualname}' is reachable from a "
+                    "worker-thread entry point — marshal through "
+                    "call_soon_threadsafe or move the access onto the loop"
+                )
+            else:
+                msg = (
+                    f"unguarded access to '{cls_label}.{attr}' (guarded-by: "
+                    f"{guard}) in worker-thread-reachable '{fi.qualname}' — "
+                    f"hold 'with {guard}:' around the access or annotate "
+                    "'# lint: guarded-ok: <rationale>'"
+                )
+            if pending:
+                msg += " [suppression present but missing its rationale]"
+            findings.append(Finding("guarded", fi.file.rel, node.lineno, msg))
+    return findings

@@ -1,0 +1,152 @@
+"""Metrics cross-check: code <-> docs/DESIGN.md metric-table parity.
+
+Registration sites are ``<registry>.counter/gauge/histogram("xaynet_...",
+...)`` calls under ``xaynet_tpu/`` (lookups — ``get``/``sample_value`` —
+don't count). The documentation side is every markdown table row between
+``<!-- metrics-table:begin -->`` / ``<!-- metrics-table:end -->`` markers
+in docs/DESIGN.md; inside those rows, backticked metric tokens support
+two shorthands::
+
+    `xaynet_streaming_{staging_depth,inflight_folds}`   brace expansion
+    `xaynet_messages_total{phase,outcome}`              trailing label set
+
+Checks (rule ``metrics``):
+  1. every ``xaynet_*`` family is registered exactly once (the registry is
+     idempotent at runtime, but two independent registration sites with
+     the same name mean two modules think they own the family);
+  2. every registered family appears in the DESIGN metric tables;
+  3. every documented family is actually registered (no stale doc rows).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .cache import FileInfo
+from .core import Finding, suppressed
+
+_REG_METHODS = frozenset({"counter", "gauge", "histogram"})
+_BEGIN = "<!-- metrics-table:begin -->"
+_END = "<!-- metrics-table:end -->"
+_TOKEN_RE = re.compile(r"`(xaynet_[a-z0-9_{},]+)`")
+
+
+def registrations(files: list[FileInfo]) -> dict[str, list[tuple[str, int]]]:
+    """metric name -> [(rel, line)] registration sites under xaynet_tpu/."""
+    out: dict[str, list[tuple[str, int]]] = {}
+    for info in files:
+        if not info.rel.startswith("xaynet_tpu/") or info.tree is None:
+            continue
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in _REG_METHODS or not node.args:
+                continue
+            first = node.args[0]
+            if (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("xaynet_")
+            ):
+                out.setdefault(first.value, []).append((info.rel, node.lineno))
+    return out
+
+
+def _expand(token: str) -> list[str]:
+    """Brace shorthand -> concrete family names. A trailing ``{...}`` after
+    a complete name is a label set (stripped); a ``{a,b}`` group mid-token
+    — or right after a trailing ``_`` — expands."""
+    m = re.search(r"\{([^{}]*)\}", token)
+    if m is None:
+        return [token]
+    before, group, after = token[: m.start()], m.group(1), token[m.end():]
+    if not after and not before.endswith("_"):  # trailing -> label set
+        return [before]
+    return [name for part in group.split(",") for name in _expand(before + part + after)]
+
+
+def documented(design_text: str) -> dict[str, int]:
+    """metric name -> first documenting line, from marked table rows."""
+    out: dict[str, int] = {}
+    active = False
+    for i, line in enumerate(design_text.splitlines(), 1):
+        if _BEGIN in line:
+            active = True
+            continue
+        if _END in line:
+            active = False
+            continue
+        if not active or not line.lstrip().startswith("|"):
+            continue
+        for token in _TOKEN_RE.findall(line):
+            for name in _expand(token):
+                out.setdefault(name, i)
+    return out
+
+
+def run(files: list[FileInfo], design_path) -> list[Finding]:
+    findings: list[Finding] = []
+    regs = registrations(files)
+    try:
+        design_text = design_path.read_text()
+    except OSError:
+        return [
+            Finding("metrics", "docs/DESIGN.md", 1, "docs/DESIGN.md is unreadable")
+        ]
+    docs = documented(design_text)
+    if not docs:
+        return [
+            Finding(
+                "metrics",
+                "docs/DESIGN.md",
+                1,
+                "no marked metric tables found (expected "
+                f"'{_BEGIN}' ... '{_END}' around the §6 series table)",
+            )
+        ]
+    by_rel: dict[str, FileInfo] = {f.rel: f for f in files}
+    for name, sites in sorted(regs.items()):
+        if len(sites) > 1:
+            for rel, line in sites[1:]:
+                info = by_rel.get(rel)
+                if info and suppressed("metrics", info.line(line)):
+                    continue
+                # no line number in the message: baseline keys must stay
+                # stable when unrelated edits shift the first site
+                findings.append(
+                    Finding(
+                        "metrics",
+                        rel,
+                        line,
+                        f"metric '{name}' is registered more than once "
+                        f"(first in {sites[0][0]}) — one module owns a "
+                        "family; import its symbol instead",
+                    )
+                )
+        if name not in docs:
+            rel, line = sites[0]
+            info = by_rel.get(rel)
+            if info and suppressed("metrics", info.line(line)):
+                continue
+            findings.append(
+                Finding(
+                    "metrics",
+                    rel,
+                    line,
+                    f"metric '{name}' is not in the DESIGN.md metric tables "
+                    "(add a row inside the metrics-table markers, §6)",
+                )
+            )
+    for name, line in sorted(docs.items()):
+        if name not in regs:
+            findings.append(
+                Finding(
+                    "metrics",
+                    "docs/DESIGN.md",
+                    line,
+                    f"documented metric '{name}' is not registered anywhere "
+                    "under xaynet_tpu/ (stale table row?)",
+                )
+            )
+    return findings

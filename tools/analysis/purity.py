@@ -1,0 +1,176 @@
+"""Call-graph host-sync/purity pass.
+
+Replaces the reach of the two name-prefix heuristics in
+:mod:`filerules` with real reachability (shared rule name ``sync``,
+shared ``# lint: sync-ok`` annotation):
+
+- **sim leg** — roots are the jitted program bodies (functions named
+  ``_prog*`` under ``xaynet_tpu/sim``); anything transitively reachable
+  from them, *in any file*, may not host-sync (``np.asarray`` — numpy's,
+  not ``jnp.asarray``'s trace-safe cousin — ``block_until_ready``,
+  ``.item()``, ``.tolist()``) or do Python-int limb math
+  (``limbs_to_int``/``int_to_limbs``/...). Bare ``int()`` stays a
+  lexical-only check in :mod:`filerules`: trace-time ``int(shape)`` is
+  legitimate in shared ops code, so flagging it across the closure would
+  drown the signal.
+- **fold-worker leg** — roots are the worker-thread entry points whose
+  target lives under ``xaynet_tpu/parallel``; reachable functions *in
+  that tree* may not ``asarray``/``block_until_ready`` outside
+  ``drain()``/``_drain*`` (the sanctioned sync points).
+
+Sites already covered lexically by the per-file prefix rules are skipped
+here (one finding per site, not two); everything the old heuristic missed
+— a helper defined outside the ``_prog*`` body, a worker-reachable method
+whose name matches no prefix — now surfaces.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, FuncInfo, iter_owned_nodes, thread_entry_points
+from .core import Finding, suppressed
+from .filerules import _SIM_PROGRAM_PREFIXES, _WORKER_SYNC_PREFIXES
+
+_HOST_LIMB_CALLEES = frozenset(
+    {"limbs_to_int", "limbs_to_ints", "int_to_limbs", "ints_to_limbs", "item", "tolist"}
+)
+
+
+def _callee_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _receiver_module(node: ast.Call, fi: FuncInfo) -> str | None:
+    """Dotted module of an attribute call's receiver, via the file's import
+    table (``np.asarray`` -> "numpy", ``jnp.asarray`` -> "jax.numpy");
+    None when the receiver is not a plain imported-module name."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return fi.file.imports.get(func.value.id)
+    if isinstance(func, ast.Name):
+        dotted = fi.file.imports.get(func.id)
+        if dotted and "." in dotted:
+            return dotted.rsplit(".", 1)[0]
+    return None
+
+
+def _is_numpy_asarray(node: ast.Call, fi: FuncInfo) -> bool:
+    """``asarray`` spellings that resolve to numpy (the host sync), not
+    ``jax.numpy`` (trace-safe). Unknown receivers count as numpy — a bare
+    ``x.asarray()`` in reachable code deserves a look, not a pass."""
+    if _callee_name(node) != "asarray":
+        return False
+    mod = _receiver_module(node, fi)
+    if mod is None:
+        return True
+    return not mod.startswith("jax")
+
+
+def _lexically_covered_sim(fi: FuncInfo) -> bool:
+    """Already checked by the per-file ``_prog*`` rule (which walks nested
+    defs too): the site's enclosing-def chain hits a ``_prog*`` function in
+    a sim file."""
+    if not fi.file.rel.startswith("xaynet_tpu/sim"):
+        return False
+    return any(
+        part.startswith(_SIM_PROGRAM_PREFIXES) for part in fi.qualname.split(".")
+    )
+
+
+def _lexically_covered_worker(fi: FuncInfo) -> bool:
+    if not fi.file.rel.startswith("xaynet_tpu/parallel"):
+        return False
+    return fi.name.startswith(_WORKER_SYNC_PREFIXES)
+
+
+def run(graph: CallGraph) -> list[Finding]:
+    symbols = graph.symbols
+    findings: list[Finding] = []
+
+    # --- sim leg ----------------------------------------------------------
+    sim_roots = [
+        fi
+        for fi in symbols.functions
+        if fi.file.rel.startswith("xaynet_tpu/sim")
+        and fi.name.startswith(_SIM_PROGRAM_PREFIXES)
+    ]
+    sim_reach = graph.reachable(sim_roots)
+    root_names = {fi.uid: fi for fi in sim_roots}
+
+    for fi in symbols.functions:
+        if fi.uid not in sim_reach or _lexically_covered_sim(fi):
+            continue
+        flagged: set[int] = set()
+        for node in iter_owned_nodes(fi.node):
+            if not isinstance(node, ast.Call) or node.lineno in flagged:
+                continue
+            callee = _callee_name(node)
+            bad = (
+                callee == "block_until_ready"
+                or callee in _HOST_LIMB_CALLEES
+                or _is_numpy_asarray(node, fi)
+            )
+            if not bad:
+                continue
+            flagged.add(node.lineno)
+            if suppressed("sync", fi.file.line(node.lineno)):
+                continue
+            root_hint = "a sim program body" if fi.uid not in root_names else f"'{fi.name}'"
+            findings.append(
+                Finding(
+                    "sync",
+                    fi.file.rel,
+                    node.lineno,
+                    f"host round-trip in '{fi.qualname}', which is reachable "
+                    f"from {root_hint} (jitted sim round programs must stay "
+                    "pure all the way down the call graph — the name-prefix "
+                    "rule only sees the `_prog*` body itself; move the "
+                    f"'{callee}' to the host boundary or annotate "
+                    "'# lint: sync-ok')",
+                )
+            )
+
+    # --- fold-worker leg --------------------------------------------------
+    worker_roots = [
+        fi
+        for fi in thread_entry_points(graph)
+        if fi.file.rel.startswith("xaynet_tpu/parallel")
+    ]
+    worker_reach = graph.reachable(worker_roots)
+
+    for fi in symbols.functions:
+        if (
+            fi.uid not in worker_reach
+            or not fi.file.rel.startswith("xaynet_tpu/parallel")
+            or _lexically_covered_worker(fi)
+            or fi.name.startswith(("drain", "_drain"))
+        ):
+            continue
+        flagged = set()
+        for node in iter_owned_nodes(fi.node):
+            if not isinstance(node, ast.Call) or node.lineno in flagged:
+                continue
+            callee = _callee_name(node)
+            if callee not in ("asarray", "block_until_ready"):
+                continue
+            flagged.add(node.lineno)
+            if suppressed("sync", fi.file.line(node.lineno)):
+                continue
+            findings.append(
+                Finding(
+                    "sync",
+                    fi.file.rel,
+                    node.lineno,
+                    f"blocking host sync in '{fi.qualname}', which is "
+                    "reachable from a fold-worker entry point despite "
+                    "matching no worker name prefix (synchronize in drain(), "
+                    "or annotate a deliberate barrier with '# lint: sync-ok')",
+                )
+            )
+    return findings

@@ -22,7 +22,13 @@ Usage:
   python tools/bench_gate.py [--history BENCH_HISTORY.jsonl]
                              [--metric-prefix "masked-update aggregation throughput"
                               --unit "updates/s"]
-                             [--threshold 0.10] [--list]
+                             [--threshold 0.10] [--list] [--with-analysis]
+
+``--with-analysis`` additionally runs the static-analysis gate
+(tools/analysis, same checks as ``python tools/lint.py --strict``) through
+its persistent result cache — in CI the lint job has already warmed
+``.lint-cache.json`` for the checkout, so the bench leg re-verifies the
+tree for effectively free instead of re-analyzing it.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ import argparse
 import json
 import os
 import sys
+from pathlib import Path
 
 DEFAULT_HISTORY = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_HISTORY.jsonl"
@@ -183,6 +190,11 @@ def main() -> int:
     ap.add_argument(
         "--list", action="store_true", help="print the headline series and exit 0"
     )
+    ap.add_argument(
+        "--with-analysis",
+        action="store_true",
+        help="also run the static-analysis gate, reusing its result cache",
+    )
     args = ap.parse_args()
     if not (0.0 < args.threshold < 1.0):
         ap.error("--threshold must be in (0, 1)")
@@ -221,10 +233,24 @@ def main() -> int:
                 print(f"{ts:.0f}  {value:10.2f} {unit}  {metric}{suffix}")
         return 0
 
+    analysis_rc = 0
+    if args.with_analysis:
+        repo = Path(__file__).resolve().parent.parent
+        if str(repo) not in sys.path:
+            sys.path.insert(0, str(repo))
+        from tools.analysis import driver as analysis_driver
+
+        # cached (content-hash keyed): a warm .lint-cache.json from the
+        # lint job makes this a sub-second re-verification
+        analysis_rc = analysis_driver.run(repo, strict=True)
+
     # every family gates independently; any regression fails the run
     return max(
-        gate_family(args.history, prefix, unit, args.threshold)
-        for prefix, unit in families
+        analysis_rc,
+        *(
+            gate_family(args.history, prefix, unit, args.threshold)
+            for prefix, unit in families
+        ),
     )
 
 

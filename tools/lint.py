@@ -1,557 +1,59 @@
-"""Self-contained lint gate (no third-party deps).
+"""Self-contained lint gate (no third-party deps) — CLI for tools/analysis.
 
 The reference CI runs fmt + clippy (.github/workflows/rust.yml); this is
 the equivalent gate for the Python tree, runnable in any environment with
 a bare interpreter — including the build image, which has no ruff/pyflakes
 and no network to fetch them.
 
-Checks:
-  - files parse (syntax errors fail the gate);
-  - unused imports (module scope; ``__init__.py`` re-export indexes are
-    exempt, ``import x as x`` / ``__all__`` mark intentional re-exports);
-  - ``from x import *``;
-  - mutable default arguments (list/dict/set literals);
-  - bare ``except:`` clauses;
-  - duplicate literal keys in dict displays;
-  - tabs in indentation, trailing whitespace, missing final newline;
-  - lines over 120 characters (URLs exempt);
-  - raw ``time.perf_counter()`` in the hot-path trees (``xaynet_tpu/parallel``,
-    ``xaynet_tpu/server``): timing there must flow through
-    ``xaynet_tpu.telemetry`` (profiling hooks / histogram timers) so it shows
-    up on ``GET /metrics`` and in round reports. Annotate a deliberate
-    exception with ``# telemetry-exempt`` on the offending line.
-  - bare unbounded ``asyncio.Queue()`` construction under
-    ``xaynet_tpu/server`` and ``xaynet_tpu/ingest``: every coordinator-side
-    queue must either carry a maxsize or sit behind the admission-controlled
-    intake. Annotate a deliberate exception (e.g. the request channel whose
-    bound lives upstream, or a shutdown sentinel channel) with
-    ``# lint: unbounded-ok`` on the offending line.
-  - direct ``jax.device_put`` under ``xaynet_tpu/server`` and
-    ``xaynet_tpu/ingest``: update-batch staging must flow through the
-    streaming pipeline's buffer ring (``parallel.streaming``) so host
-    staging overlaps the in-flight folds and the per-batch pad/stack
-    allocations stay dead. Annotate a deliberate exception (tiny
-    non-update tensors) with ``# lint: device-put-ok`` on the offending
-    line.
-  - raw HTTP/socket transport calls under ``xaynet_tpu/sdk``
-    (``urllib.request.urlopen``, ``socket.create_connection``,
-    ``asyncio.open_connection``, bare ``socket()``): every coordinator
-    conversation must flow through the client layer so the resilient
-    wrapper's retry/Retry-After/typed-error semantics apply. The one
-    legitimate transport (``HttpClient._request``) is annotated with
-    ``# lint: raw-http-ok``.
-  - blocking host syncs (``np.asarray`` / ``block_until_ready``) inside
-    fold-worker code paths under ``xaynet_tpu/parallel`` (functions whose
-    names mark the worker/submit/fold call graph — see
-    ``_WORKER_SYNC_PREFIXES``): the streaming pipeline's whole point is
-    that the only sanctioned synchronization point is ``drain()`` (exempt
-    by name), so a stray sync in a worker or submit path silently
-    serializes the overlap. A deliberate sync (a transfer barrier before
-    ring-buffer reuse, the native kernel's host-view materialization, a
-    degraded-path acceptance resolve) must carry ``# lint: sync-ok`` on
-    the offending line.
-  - host round-trips inside the simulation's jitted program bodies
-    (functions prefixed ``_prog`` under ``xaynet_tpu/sim``): the whole
-    point of ``sim.SimRound`` is that a federated round traces into ONE
-    device program, so ``np.asarray`` / ``block_until_ready`` (host
-    syncs) and Python-int limb math (``limbs_to_int``/``int_to_limbs``/
-    ``.item()``/``.tolist()``/``int()``) inside a program body silently
-    reintroduce the per-phase host round-trips the subsystem exists to
-    eliminate. The host boundary (encode before, decode after the
-    program) lives OUTSIDE ``_prog*`` functions; a deliberate in-body
-    materialization must carry ``# lint: sync-ok`` on the offending line.
-  - silent broad-exception swallows (``except Exception: pass`` and
-    friends) under ``xaynet_tpu/server`` and ``xaynet_tpu/storage``: a
-    coordinator-side failure must be logged, metered, retried or
-    re-raised — silently dropping it hides outages (the unmask-phase
-    pointer update did exactly this until a metric made it visible).
-    Narrow handlers (``except ValueError: pass``) are allowed; a
-    deliberate broad swallow (best-effort socket teardown) must carry
-    ``# lint: swallow-ok`` on the ``except`` line.
+The checks themselves live in the pass-based framework under
+``tools/analysis/`` (ISSUE 9): the classic per-file rules
+(``analysis/filerules.py`` — parse errors, unused imports, star imports,
+mutable defaults, bare excepts, duplicate dict keys, formatting, and the
+tree-scoped hot-path rules: perf_counter/telemetry, unbounded queues,
+device_put staging, SDK raw transports, edge fold accounting, worker/sim
+host-sync prefixes) plus the cross-file deep passes (lock-discipline
+``# guarded-by:`` race lint, call-graph host-sync/purity, accounting
+invariants, metrics <-> DESIGN.md parity). Suppressions are per-rule
+(``# lint: <rule>-ok``, rationale required for ``guarded``/``invariant``)
+and known findings can be baselined in ``tools/analysis/baseline.json``.
+docs/DESIGN.md §14 is the user guide.
 
-Usage: python tools/lint.py [paths...]   (default: the repo tree)
+Usage:
+  python tools/lint.py [paths...]          # classic: lint these paths
+  python tools/lint.py                     # full tree + deep passes
+  python tools/lint.py --strict            # CI gate: full tree + all passes, always
+  python tools/lint.py --changed           # only files off the merge-base
+  python tools/lint.py --json              # machine-readable findings
+  python tools/lint.py --update-baseline   # accept current findings
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-DEFAULT_TARGETS = [
-    "xaynet_tpu",
-    "tests",
-    "tools",
-    "examples",
-    "bench.py",
-    "__graft_entry__.py",
-    "conftest.py",
-]
-MAX_LINE = 120
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
+from tools.analysis import cache as _cache  # noqa: E402
+from tools.analysis import driver as _driver  # noqa: E402
+from tools.analysis import filerules as _filerules  # noqa: E402
 
-class _ImportVisitor(ast.NodeVisitor):
-    """Collects module-scope imports and every name used anywhere."""
-
-    def __init__(self):
-        self.imports: dict[str, tuple[int, str]] = {}  # local name -> (line, display)
-        self.used: set[str] = set()
-        self.star_imports: list[int] = []
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            local = alias.asname or alias.name.split(".")[0]
-            if alias.asname == alias.name:
-                continue  # `import x as x` is an explicit re-export
-            self.imports[local] = (node.lineno, alias.name)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return
-        for alias in node.names:
-            if alias.name == "*":
-                self.star_imports.append(node.lineno)
-                continue
-            if alias.asname == alias.name:
-                continue  # explicit re-export idiom
-            local = alias.asname or alias.name
-            self.imports[local] = (node.lineno, alias.name)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        # record the root name of attribute chains (module.attr)
-        root = node
-        while isinstance(root, ast.Attribute):
-            root = root.value
-        if isinstance(root, ast.Name):
-            self.used.add(root.id)
-        self.generic_visit(node)
-
-
-def _used_in_annotations(tree: ast.AST) -> set[str]:
-    """Names referenced inside *string* type annotations (``x: "Foo"``).
-
-    Only annotation positions count — a module name mentioned in a docstring
-    or assert message must NOT exempt a dead import.
-    """
-    out: set[str] = set()
-
-    def collect(ann) -> None:
-        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
-            try:
-                expr = ast.parse(ann.value, mode="eval")
-            except SyntaxError:
-                return
-            for n in ast.walk(expr):
-                if isinstance(n, ast.Name):
-                    out.add(n.id)
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AnnAssign):
-            collect(node.annotation)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            collect(node.returns)
-            for arg in (
-                node.args.args + node.args.posonlyargs + node.args.kwonlyargs
-                + ([node.args.vararg] if node.args.vararg else [])
-                + ([node.args.kwarg] if node.args.kwarg else [])
-            ):
-                collect(arg.annotation)
-    return out
-
-
-def _is_unbounded_queue(node: ast.Call) -> bool:
-    """True for ``asyncio.Queue()`` / ``Queue()`` constructed without a size,
-    or with a literal non-positive one (asyncio treats ``maxsize <= 0`` as
-    unbounded). Non-constant sizes are trusted — the rule is syntactic."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        is_queue = func.attr == "Queue" and (
-            isinstance(func.value, ast.Name) and func.value.id == "asyncio"
-        )
-    elif isinstance(func, ast.Name):
-        is_queue = func.id == "Queue"
-    else:
-        is_queue = False
-    if not is_queue:
-        return False
-    size = node.args[0] if node.args else None
-    if size is None:
-        for kw in node.keywords:
-            if kw.arg == "maxsize":
-                size = kw.value
-                break
-    if size is None:
-        return True
-    if isinstance(size, ast.Constant) and isinstance(size.value, (int, float)):
-        return size.value <= 0
-    if isinstance(size, ast.UnaryOp) and isinstance(size.op, ast.USub):
-        return isinstance(size.operand, ast.Constant)
-    return False
-
-
-def _is_silent_broad_swallow(node: ast.ExceptHandler) -> bool:
-    """True for a handler that (a) catches Exception/BaseException —
-    directly or inside a tuple — and (b) whose body does nothing but
-    ``pass``/``...``/``continue``. Narrow handlers and handlers that log,
-    meter, assign or re-raise are fine."""
-
-    def names(t) -> list:
-        if t is None:
-            return []
-        if isinstance(t, ast.Tuple):
-            return [n for elt in t.elts for n in names(elt)]
-        if isinstance(t, ast.Name):
-            return [t.id]
-        if isinstance(t, ast.Attribute):
-            return [t.attr]
-        return []
-
-    if not any(n in ("Exception", "BaseException") for n in names(node.type)):
-        return False
-    for stmt in node.body:
-        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
-            continue
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
-            continue  # docstring / Ellipsis
-        return False
-    return True
-
-
-# transport entry points that bypass the resilient client wrapper when
-# called directly from SDK code
-_RAW_HTTP_CALLEES = frozenset(
-    {"urlopen", "urlretrieve", "open_connection", "create_connection", "socket"}
-)
-
-
-def _is_raw_http_call(node: ast.Call) -> bool:
-    """True for direct transport constructions (urllib/socket/asyncio
-    streams) — syntactic, like the queue rule: any spelling that resolves
-    to one of the raw entry points counts."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr in _RAW_HTTP_CALLEES
-    return isinstance(func, ast.Name) and func.id in _RAW_HTTP_CALLEES
-
-
-# fold entry points that bypass the EdgeAggregator accounting path when
-# called directly from edge code: a modular add without the matching
-# member/seed-dict accounting ships an envelope whose nb_models disagrees
-# with its content and breaks the coordinator's nb_models == seed-watermark
-# unmask invariant (docs/DESIGN.md §11)
-_FOLD_CALLEES = frozenset(
-    {
-        "aggregate",
-        "aggregate_batch",
-        "aggregate_partial",
-        "fold_partial",
-        "mod_add",
-        "batch_mod_sum",
-        "fold_wire_batch_host",
-        "fold_planar_batch_host",
-        "masked_add",
-    }
-)
-
-
-def _is_fold_call(node: ast.Call) -> bool:
-    """True for any spelling that resolves to a masked-add/fold entry point
-    (syntactic, like the queue rule)."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr in _FOLD_CALLEES
-    return isinstance(func, ast.Name) and func.id in _FOLD_CALLEES
-
-
-# fold-worker call-graph function-name prefixes under xaynet_tpu/parallel:
-# the producers (submit_*), the per-batch/per-shard fold paths (_fold*,
-# fold*, _credit, _dispatch*, _retry*, _shard*), and the worker loops
-# (_process*, _worker*). drain()/_drain* are the sanctioned sync points and
-# deliberately NOT listed.
-_WORKER_SYNC_PREFIXES = (
-    "_process",
-    "_fold",
-    "fold",
-    "_dispatch",
-    "_credit",
-    "_retry",
-    "_shard",
-    "_worker",
-    "submit",
-    "_submit",
-)
-
-# host-blocking entry points: np.asarray materializes a device value on the
-# host; block_until_ready is an explicit device barrier
-_SYNC_CALLEES = frozenset({"asarray", "block_until_ready"})
-
-# simulation program bodies: functions with these name prefixes under
-# xaynet_tpu/sim are jitted whole-round program code — pure traced JAX
-_SIM_PROGRAM_PREFIXES = ("_prog",)
-
-# Python-int limb math: pulls group elements out of the graph one integer
-# at a time (the pattern the in-graph simulation exists to eliminate)
-_HOST_INT_CALLEES = frozenset(
-    {"limbs_to_int", "limbs_to_ints", "int_to_limbs", "ints_to_limbs", "item", "tolist", "int"}
-)
-
-
-def _is_host_roundtrip(node: ast.Call) -> bool:
-    """True for host syncs AND Python-int limb math (syntactic, any
-    spelling that resolves to one of the entry points)."""
-    if _is_blocking_sync(node):
-        return True
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr in _HOST_INT_CALLEES
-    return isinstance(func, ast.Name) and func.id in _HOST_INT_CALLEES
-
-
-def _is_blocking_sync(node: ast.Call) -> bool:
-    """True for any spelling of ``np.asarray(...)`` /
-    ``jax.block_until_ready(...)`` / ``x.block_until_ready()`` (syntactic,
-    like the other rules)."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr in _SYNC_CALLEES
-    return isinstance(func, ast.Name) and func.id in _SYNC_CALLEES
-
-
-def _is_device_put(node: ast.Call) -> bool:
-    """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
-    rule is syntactic, like the queue rule: any spelling that resolves to
-    the jax transfer entry point counts)."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr == "device_put"
-    return isinstance(func, ast.Name) and func.id == "device_put"
+DEFAULT_TARGETS = list(_driver.DEFAULT_TARGETS)
+MAX_LINE = _filerules.MAX_LINE
 
 
 def check_file(path: Path) -> list[str]:
-    problems: list[str] = []
-    rel = path.relative_to(REPO)
-    raw = path.read_bytes()
-    try:
-        text = raw.decode("utf-8")
-    except UnicodeDecodeError as e:
-        return [f"{rel}: not valid UTF-8: {e}"]
-
-    # --- format-level checks ----------------------------------------------
-    generated = "generated by" in text[:200]
-    if text and not text.endswith("\n"):
-        problems.append(f"{rel}:{text.count(chr(10)) + 1}: missing final newline")
-    for i, line in enumerate(text.splitlines(), 1):
-        stripped = line.rstrip("\n")
-        indent = stripped[: len(stripped) - len(stripped.lstrip())]
-        if "\t" in indent:
-            problems.append(f"{rel}:{i}: tab in indentation")
-        if stripped != stripped.rstrip():
-            problems.append(f"{rel}:{i}: trailing whitespace")
-        if len(stripped) > MAX_LINE and "http" not in stripped and not generated:
-            problems.append(f"{rel}:{i}: line longer than {MAX_LINE} chars ({len(stripped)})")
-
-    # --- AST checks --------------------------------------------------------
-    try:
-        tree = ast.parse(text, filename=str(rel))
-    except SyntaxError as e:
-        problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
-        return problems
-
-    visitor = _ImportVisitor()
-    visitor.visit(tree)
-
-    for line in visitor.star_imports:
-        problems.append(f"{rel}:{line}: star import")
-
-    if path.name != "__init__.py":  # __init__ files are re-export indexes
-        exported = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if isinstance(t, ast.Name) and t.id == "__all__":
-                        if isinstance(node.value, (ast.List, ast.Tuple)):
-                            for elt in node.value.elts:
-                                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                                    exported.add(elt.value)
-        string_refs = _used_in_annotations(tree)
-        for name, (line, display) in sorted(visitor.imports.items()):
-            if name in visitor.used or name in exported or name in string_refs:
-                continue
-            problems.append(f"{rel}:{line}: unused import '{display}'")
-
-    # hot-path trees: raw perf_counter timing bypasses the telemetry layer
-    hot_path = str(rel).startswith(("xaynet_tpu/parallel", "xaynet_tpu/server"))
-    # coordinator queue trees: unbounded queues defeat admission control
-    bounded_tree = str(rel).startswith(
-        ("xaynet_tpu/server", "xaynet_tpu/ingest", "xaynet_tpu/edge")
-    )
-    # edge tree: every fold must flow through the EdgeAggregator accounting
-    # path (admit/seal), never a direct masked_add
-    edge_tree = str(rel).startswith("xaynet_tpu/edge")
-    # coordinator/storage trees: silent broad swallows hide infrastructure
-    # failures from the resilience layer and the operator
-    no_swallow_tree = str(rel).startswith(("xaynet_tpu/server", "xaynet_tpu/storage"))
-    # SDK tree: raw transports bypass the resilient client wrapper
-    sdk_tree = str(rel).startswith("xaynet_tpu/sdk")
-    src_lines = text.splitlines()
-
-    def line_of(node: ast.AST) -> str:
-        return src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
-
-    # sim tree: host round-trips inside jitted program bodies reintroduce
-    # the per-phase host syncs the in-graph round exists to eliminate
-    if str(rel).startswith("xaynet_tpu/sim"):
-        flagged_sim: set[int] = set()
-        for fn in ast.walk(tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not fn.name.startswith(_SIM_PROGRAM_PREFIXES):
-                continue
-            for node in ast.walk(fn):
-                if (
-                    isinstance(node, ast.Call)
-                    and _is_host_roundtrip(node)
-                    and node.lineno not in flagged_sim
-                ):
-                    flagged_sim.add(node.lineno)
-                    if "lint: sync-ok" not in line_of(node):
-                        problems.append(
-                            f"{rel}:{node.lineno}: host round-trip in sim program "
-                            f"body '{fn.name}' (np.asarray/block_until_ready/"
-                            "Python-int limb math must stay outside jitted round "
-                            "programs; move it to the host boundary or annotate a "
-                            "deliberate materialization with '# lint: sync-ok')"
-                        )
-
-    # parallel tree: blocking host syncs inside fold-worker code paths
-    # serialize the pipeline overlap; drain() is the sanctioned sync point
-    if str(rel).startswith("xaynet_tpu/parallel"):
-        flagged: set[int] = set()
-        for fn in ast.walk(tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not fn.name.startswith(_WORKER_SYNC_PREFIXES):
-                continue
-            for node in ast.walk(fn):
-                if (
-                    isinstance(node, ast.Call)
-                    and _is_blocking_sync(node)
-                    and node.lineno not in flagged
-                ):
-                    if "lint: sync-ok" not in line_of(node):
-                        flagged.add(node.lineno)
-                        problems.append(
-                            f"{rel}:{node.lineno}: blocking host sync in fold-worker "
-                            f"code path '{fn.name}' (synchronize in drain(), or "
-                            "annotate a deliberate transfer barrier / host-kernel "
-                            "materialization with '# lint: sync-ok')"
-                        )
-                    else:
-                        flagged.add(node.lineno)
-
-    for node in ast.walk(tree):
-        if hot_path and isinstance(node, ast.Call):
-            func = node.func
-            callee = (
-                func.attr
-                if isinstance(func, ast.Attribute)
-                else func.id if isinstance(func, ast.Name) else ""
-            )
-            if callee == "perf_counter":
-                if "telemetry-exempt" not in line_of(node):
-                    problems.append(
-                        f"{rel}:{node.lineno}: raw perf_counter timing bypasses the "
-                        "telemetry registry (use xaynet_tpu.telemetry.profiling or a "
-                        "registry histogram timer)"
-                    )
-        if bounded_tree and isinstance(node, ast.Call) and _is_unbounded_queue(node):
-            if "lint: unbounded-ok" not in line_of(node):
-                problems.append(
-                    f"{rel}:{node.lineno}: unbounded asyncio.Queue() in the "
-                    "coordinator tree (pass a maxsize, or annotate a deliberate "
-                    "sentinel/upstream-bounded channel with '# lint: unbounded-ok')"
-                )
-        if sdk_tree and isinstance(node, ast.Call) and _is_raw_http_call(node):
-            if "lint: raw-http-ok" not in line_of(node):
-                problems.append(
-                    f"{rel}:{node.lineno}: raw HTTP/socket call in the SDK tree "
-                    "bypasses the resilient client wrapper (route coordinator "
-                    "traffic through sdk.client.HttpClient/ResilientClient, or "
-                    "annotate the transport itself with '# lint: raw-http-ok')"
-                )
-        if edge_tree and isinstance(node, ast.Call) and _is_fold_call(node):
-            if "lint: fold-ok" not in line_of(node):
-                problems.append(
-                    f"{rel}:{node.lineno}: direct masked_add/fold call in the edge "
-                    "tree bypasses the partial-aggregate accounting path (fold "
-                    "through EdgeAggregator.admit/seal, or annotate the accounting "
-                    "path's own fold site with '# lint: fold-ok')"
-                )
-        if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
-            if "lint: device-put-ok" not in line_of(node):
-                problems.append(
-                    f"{rel}:{node.lineno}: direct jax.device_put in the coordinator "
-                    "tree (stage update batches through the streaming pipeline's "
-                    "buffer ring — parallel.streaming — or annotate a deliberate "
-                    "non-update-tensor upload with '# lint: device-put-ok')"
-                )
-        if (
-            no_swallow_tree
-            and isinstance(node, ast.ExceptHandler)
-            and _is_silent_broad_swallow(node)
-        ):
-            if "lint: swallow-ok" not in line_of(node):
-                problems.append(
-                    f"{rel}:{node.lineno}: silent broad-exception swallow in the "
-                    "coordinator/storage tree (log, meter, retry or re-raise — or "
-                    "annotate a deliberate best-effort cleanup with "
-                    "'# lint: swallow-ok')"
-                )
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    problems.append(
-                        f"{rel}:{default.lineno}: mutable default argument in '{node.name}'"
-                    )
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append(f"{rel}:{node.lineno}: bare 'except:'")
-        elif isinstance(node, ast.Dict):
-            seen: set[object] = set()
-            for key in node.keys:
-                if isinstance(key, ast.Constant):
-                    marker = (type(key.value).__name__, key.value)
-                    if marker in seen:
-                        problems.append(
-                            f"{rel}:{key.lineno}: duplicate dict key {key.value!r}"
-                        )
-                    seen.add(marker)
-    return problems
+    """Per-file rules for one file, in the classic ``rel:line: message``
+    format. Reads the module-level ``REPO`` at call time (tests point it
+    at fixture trees to exercise the tree-scoped rules)."""
+    info = _cache.FileInfo(REPO, Path(path))
+    return [f.legacy() for f in _filerules.check_file_info(info)]
 
 
 def main(argv: list[str]) -> int:
-    targets = argv or DEFAULT_TARGETS
-    files: list[Path] = []
-    for t in targets:
-        p = (REPO / t) if not Path(t).is_absolute() else Path(t)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.exists():
-            files.append(p)
-    problems: list[str] = []
-    for f in files:
-        problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    print(f"lint: {len(files)} files, {len(problems)} problems", file=sys.stderr)
-    return 1 if problems else 0
+    return _driver.main(argv, repo=REPO)
 
 
 if __name__ == "__main__":

@@ -83,7 +83,7 @@ class AdmissionController:
         self.high_mark = min(capacity, math.ceil(high_watermark * capacity))
         self.low_mark = math.floor(low_watermark * capacity)
         self.retry_after_seconds = retry_after_seconds
-        self._saturated = False
+        self._saturated = False  # guarded-by: event-loop
         _SATURATED.set(0)
 
     @property

@@ -34,10 +34,10 @@ class UpdateCoalescer:
         self.request_tx = request_tx
         self.max_batch = max_batch
         self.linger_s = linger_s
-        self._buf: list[tuple[UpdateRequest, asyncio.Future, str]] = []
-        self._linger_task: Optional[asyncio.Task] = None
-        self.batches_sent = 0
-        self.members_sent = 0
+        self._buf: list[tuple[UpdateRequest, asyncio.Future, str]] = []  # guarded-by: event-loop
+        self._linger_task: Optional[asyncio.Task] = None  # guarded-by: event-loop
+        self.batches_sent = 0  # guarded-by: event-loop
+        self.members_sent = 0  # guarded-by: event-loop
 
     @property
     def pending(self) -> int:

@@ -44,7 +44,7 @@ class IntakeShard:
         self.index = index
         self.bound = bound
         self._queue: asyncio.Queue[bytes] = asyncio.Queue(maxsize=bound)
-        self.max_occupancy = 0
+        self.max_occupancy = 0  # guarded-by: event-loop
         self._hist = _OCCUPANCY.labels(shard=str(index))
 
     @property
@@ -89,7 +89,7 @@ class ShardedIntake:
             raise ValueError("need at least one shard")
         self.shards = [IntakeShard(i, bound_per_shard) for i in range(shards)]
         self.capacity = shards * bound_per_shard
-        self._rr = itertools.cycle(range(shards))
+        self._rr = itertools.cycle(range(shards))  # guarded-by: event-loop
 
     @property
     def occupancy(self) -> int:

@@ -88,7 +88,7 @@ class IngestPipeline:
             if settings.coalesce
             else None
         )
-        self._workers: list[asyncio.Task] = []
+        self._workers: list[asyncio.Task] = []  # guarded-by: event-loop
 
     # --- lifecycle --------------------------------------------------------
 

@@ -284,7 +284,9 @@ def _derive_params(
     bpn = (order.bit_length() + 7) // 8
     cand_limbs = max(1, (bpn + 3) // 4)
     out_limbs = host_limbs.n_limbs_for_order(order)
-    order_cl = tuple(int(x) for x in host_limbs.int_to_limbs(order, cand_limbs))
+    # trace-time limb math on the STATIC order int (a Python argument of
+    # the jitted derivation, never a traced value)
+    order_cl = tuple(int(x) for x in host_limbs.int_to_limbs(order, cand_limbs))  # lint: sync-ok
     if chunk_candidates is None:
         chunk_candidates = provision_candidates(count, order)
     chunk_candidates = max(64, min(chunk_candidates, _CHUNK_BYTES_CAP // bpn // max(1, n_seeds)))

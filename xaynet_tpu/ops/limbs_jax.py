@@ -24,7 +24,9 @@ _U32 = jnp.uint32
 
 
 def _as_order(order_limbs) -> np.ndarray:
-    return np.asarray(order_limbs, dtype=np.uint32)
+    # trace-time constant: the tiny host-side order tuple, never a traced
+    # value — not a device sync even inside a jitted caller
+    return np.asarray(order_limbs, dtype=np.uint32)  # lint: sync-ok
 
 
 def add_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:

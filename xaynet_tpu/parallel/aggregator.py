@@ -651,10 +651,10 @@ class ShardedAggregator:
                         # (the kernel reads host memory), so time it on the
                         # host view — on the CPU backend this is zero-copy
                         if host_staged is None:
-                            host_staged = np.asarray(staged)
+                            host_staged = np.asarray(staged)  # calibration host view  # lint: sync-ok
                         arg = host_staged
                     scratch = fold(scratch, arg)
-                    scratch = jax.block_until_ready(scratch)  # compile / first touch
+                    scratch = jax.block_until_ready(scratch)  # compile / first touch  # lint: sync-ok
                     scratch, dt = profiling.measure(lambda: fold(scratch, arg))
                     timings[name] = dt
                     profiling.record_calibration(name, dt)

@@ -100,7 +100,7 @@ class ShardPlan:
         self.order_limbs = host_limbs.order_limbs_for(agg.order)
         self.n_threads = shard_thread_budget(self.n_shards, shard_threads) if self.native else 0
         self._pool: ThreadPoolExecutor | None = None
-        self._warned_fallback = False
+        self._warned_fallback = False  # guarded-by: _device_dispatch_lock
         # serializes device folds issued from the D worker threads: jax's
         # dispatch/execution path is not reliably thread-safe for
         # concurrent donating jit calls on the virtual-device CPU backend
@@ -120,9 +120,13 @@ class ShardPlan:
             import jax
 
             self._serialize_device_folds = jax.default_backend() == "cpu"
+        # accs/spares carry a guarded-by annotation for the DEVICE fold
+        # path (the PR-7 torn-slice class: concurrent donating jit calls);
+        # the native path's slot accesses are per-shard-disjoint by
+        # construction and carry per-line `# lint: guarded-ok` rationales
         if self.native:
             if zero_accs:
-                self.accs = [
+                self.accs = [  # guarded-by: _device_dispatch_lock
                     np.zeros((agg.n_limbs, hi - lo), dtype=np.uint32)
                     for lo, hi in self.slices
                 ]
@@ -131,7 +135,7 @@ class ShardPlan:
                 self.accs = [
                     np.ascontiguousarray(acc_np[:, lo:hi]) for lo, hi in self.slices
                 ]
-            self.spares: list = [np.empty_like(a) for a in self.accs]
+            self.spares: list = [np.empty_like(a) for a in self.accs]  # guarded-by: _device_dispatch_lock
         else:
             import jax
             import jax.numpy as jnp
@@ -180,16 +184,20 @@ class ShardPlan:
                 stack_np.shape[0], self.agg.n_limbs, self.order_limbs
             ):
                 self._warn_fallback(stack_np.shape[0])
-            acc = self.accs[d]
+            # native slot accesses: shard d's buffers are owned by its
+            # single worker; slots are disjoint across shards and the
+            # host kernel performs no device dispatch
+            acc = self.accs[d]  # lint: guarded-ok: single-owner shard slot
             out = host_limbs.fold_planar_batch_host(
                 acc,
                 stack_np,
                 self.order_limbs,
-                out=self.spares[d],
+                out=self.spares[d],  # lint: guarded-ok: single-owner shard slot
                 n_threads=self.n_threads,
             )
-            self.spares[d] = acc if (out is not acc and acc.flags.writeable) else None
-            self.accs[d] = out
+            spare_back = acc if (out is not acc and acc.flags.writeable) else None
+            self.spares[d] = spare_back  # lint: guarded-ok: single-owner shard slot
+            self.accs[d] = out  # lint: guarded-ok: single-owner shard slot
         elif self.agg.kernel_used in ("pallas", "pallas-interpret"):
             from ..ops import fold_pallas
 
@@ -223,7 +231,10 @@ class ShardPlan:
                 import jax
 
                 new_acc = jax.block_until_ready(new_acc)  # lint: sync-ok
-        self.accs[d] = new_acc
+            # reassign INSIDE the lock: the slot write itself must not
+            # interleave with another shard's donating dispatch (the PR-7
+            # torn-slice hazard this lock exists for)
+            self.accs[d] = new_acc
 
     def fold_shard_slice(self, d: int, full_planar: np.ndarray) -> None:
         """Fold shard ``d``'s column slice straight out of a FULL staged
@@ -232,7 +243,7 @@ class ShardPlan:
         if not self.native:
             raise RuntimeError("slice folds are a native-kernel path")
         lo, hi = self.slices[d]
-        acc, spare = self.accs[d], self.spares[d]
+        acc, spare = self.accs[d], self.spares[d]  # lint: guarded-ok: single-owner shard slot
         if spare is None:
             spare = np.empty_like(acc)
         if host_limbs.fold_planar_slice_host(
@@ -245,7 +256,7 @@ class ShardPlan:
             n_threads=self.n_threads,
             acc_cols=hi - lo,
         ):
-            self.accs[d], self.spares[d] = spare, acc
+            self.accs[d], self.spares[d] = spare, acc  # lint: guarded-ok: single-owner shard slot
             return
         # u64 headroom exceeded (or library gone mid-round): copy the slice
         # and take the generic fold — exact, just not single-pass
@@ -271,8 +282,8 @@ class ShardPlan:
         )
 
     def _warn_fallback(self, k: int) -> None:
-        if not self._warned_fallback:
-            self._warned_fallback = True
+        if not self._warned_fallback:  # lint: guarded-ok: benign idempotent warn latch
+            self._warned_fallback = True  # lint: guarded-ok: benign idempotent warn latch
             logger.warning(
                 "native u64 headroom exceeded at K=%d (order ~2^%d); shard "
                 "folds taking the generic host path for oversized batches",
@@ -288,7 +299,8 @@ class ShardPlan:
         if not self.native:
             import jax
 
-            jax.block_until_ready(self.accs)
+            # lint: guarded-ok: drain barrier — workers quiesced behind the queue join
+            jax.block_until_ready(self.accs)  # lint: sync-ok  # lint: guarded-ok: drain barrier read
 
     def reassemble(self):
         """The global planar accumulator assembled from the per-shard
@@ -299,13 +311,13 @@ class ShardPlan:
         caller (drain) re-publishes this as ``agg.acc``; the plan is stale
         afterwards — rebuild before folding again."""
         if self.native:
-            return np.concatenate(self.accs, axis=1)
+            return np.concatenate(self.accs, axis=1)  # lint: guarded-ok: drain barrier read
         import jax
 
         return jax.make_array_from_single_device_arrays(
             (self.agg.n_limbs, self.agg.padded_length),
             self.agg._acc_sharding,
-            list(self.accs),
+            list(self.accs),  # lint: guarded-ok: drain barrier read
         )
 
     def close(self) -> None:

@@ -188,9 +188,13 @@ class _BatchJob:
         self.k = k
         self.ticket = ticket
         self.seq = seq
-        self.remaining = n_shards
-        self.failed = False
-        self.retried = False
+        self.remaining = n_shards  # guarded-by: _lock (the owning pipeline's)
+        self.failed = False  # guarded-by: _lock
+        self.retried = False  # guarded-by: _lock
+        # staged/global_release are NOT lock-guarded: after `remaining`
+        # hits zero under the lock, exactly ONE worker (the last shard)
+        # reaches the commit tail that touches them — ownership handoff
+        # through the counter, not mutual exclusion
         self.staged = None  # wire: the mesh-staged byte array (transfer barrier)
         self.global_release = None  # wire: (ring, buf) released at commit
 
@@ -288,20 +292,20 @@ class StreamingAggregator:
         self._sharded = n_dev > 1 and (shard_parallel is None or shard_parallel)
         self._n_shards = n_dev if self._sharded else 1
         self._shard_threads = shard_threads
-        self._plan = None  # shards.ShardPlan while per-shard accs are live
+        self._plan = None  # shards.ShardPlan while accs live  # guarded-by: _lock
         self._shard_queues: list[queue_mod.Queue] | None = None
         self._shard_workers: list[threading.Thread | None] = []
-        self._shard_rings: dict[int, _StagingRing] = {}
-        self._shard_stage_seconds = [0.0] * self._n_shards
-        self._shard_fold_seconds = [0.0] * self._n_shards
+        self._shard_rings: dict[int, _StagingRing] = {}  # guarded-by: _lock
+        self._shard_stage_seconds = [0.0] * self._n_shards  # guarded-by: _lock
+        self._shard_fold_seconds = [0.0] * self._n_shards  # guarded-by: _lock
         self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=dispatch_ahead)
-        self._rings: dict[str, _StagingRing] = {}  # lazy: planar / wire
-        self._pending: list[StreamTicket] = []  # wire tickets awaiting ok sync
-        self._in_flight_models = 0  # submitted, not yet folded (upper bound)
-        self._error: BaseException | None = None
-        self._poison_seq: int | None = None  # batch index that poisoned us
-        self._degraded = False  # sync fold path for the rest of the round
-        self._batch_seq = 0  # submit-order index (poisoning diagnostics)
+        self._rings: dict[str, _StagingRing] = {}  # lazy: planar / wire  # guarded-by: _lock
+        self._pending: list[StreamTicket] = []  # awaiting ok sync  # guarded-by: _lock
+        self._in_flight_models = 0  # submitted, not yet folded  # guarded-by: _lock
+        self._error: BaseException | None = None  # guarded-by: _lock
+        self._poison_seq: int | None = None  # poisoning batch index  # guarded-by: _lock
+        self._degraded = False  # sync path for the rest of the round  # guarded-by: _lock
+        self._batch_seq = 0  # submit-order index: producer-thread confined
         self._worker: threading.Thread | None = None
         self._closed = False
         self._lock = threading.Lock()  # worker-shared counters/pending
@@ -378,36 +382,47 @@ class StreamingAggregator:
     def degraded(self) -> bool:
         """True once a fold failure switched the pipeline to the
         synchronous fold path (the round still completes)."""
-        return self._degraded
+        with self._lock:
+            return self._degraded
 
     def _ring(self, kind: str) -> _StagingRing:
-        ring = self._rings.get(kind)
-        if ring is None:
-            agg = self.agg
-            if kind == "planar":
-                shape = (self.max_batch, agg.n_limbs, agg.padded_length)
-                dtype = np.uint32
-            else:  # raw wire bytes
-                shape = (self.max_batch, agg.padded_length * agg.config.bytes_per_number)
-                dtype = np.uint8
-            ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
-        return ring
+        with self._lock:
+            ring = self._rings.get(kind)
+            if ring is None:
+                agg = self.agg
+                if kind == "planar":
+                    shape = (self.max_batch, agg.n_limbs, agg.padded_length)
+                    dtype = np.uint32
+                else:  # raw wire bytes
+                    shape = (self.max_batch, agg.padded_length * agg.config.bytes_per_number)
+                    dtype = np.uint8
+                # first-call buffer allocation happens under the lock: once
+                # per kind, before any overlap exists to lose
+                ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
+            return ring
 
     def _poison_error(self) -> StreamingError:
         """The sticky error, always naming the poisoning batch and cause."""
-        cause = self._error
-        seq = self._poison_seq
+        with self._lock:
+            cause = self._error
+            seq = self._poison_seq
         where = f"batch {seq}" if seq is not None else "deferred sync"
         return StreamingError(
             f"streaming pipeline poisoned at {where}: "
             f"{type(cause).__name__}: {cause}"
         )
 
+    def _poisoned(self) -> BaseException | None:
+        """Locked read of the sticky error (producer-side checks)."""
+        with self._lock:
+            return self._error
+
     def _check(self, k: int) -> None:
         if self._closed:
             raise StreamingError("pipeline is closed")
-        if self._error is not None:
-            raise self._poison_error() from self._error
+        err = self._poisoned()
+        if err is not None:
+            raise self._poison_error() from err
         if k > self.max_batch:
             raise ValueError(f"batch of {k} exceeds max_batch={self.max_batch}")
         if self._window_start is None:
@@ -419,8 +434,9 @@ class StreamingAggregator:
         buf, payload, kind, k, ticket, seq = item
         with self._lock:
             self._in_flight_models += k
+            degraded = self._degraded
         BATCHES_TOTAL.labels(stage="staged").inc()
-        if not self._degraded:
+        if not degraded:
             self._ensure_worker()
             INFLIGHT_FOLDS.inc()
             self._queue.put(item)
@@ -432,8 +448,9 @@ class StreamingAggregator:
             # a caller-thread fold touches agg.acc — two unsynchronized
             # mutators would lose updates
             self._queue.join()
-            if self._error is not None:
-                raise self._poison_error() from self._error
+            err = self._poisoned()
+            if err is not None:
+                raise self._poison_error() from err
             self._fold_payload(payload, kind, k, ticket, defer_ok=False)
         except StreamingError:
             # already-poisoned pipeline: this batch just leaves flight
@@ -503,8 +520,9 @@ class StreamingAggregator:
         if self._sharded:
             return self._fold_planar_rows_now_sharded(rows)
         self._queue.join()
-        if self._error is not None:
-            raise self._poison_error() from self._error
+        err = self._poisoned()
+        if err is not None:
+            raise self._poison_error() from err
         if self._closed:
             raise StreamingError("pipeline is closed")
         import jax
@@ -728,7 +746,8 @@ class StreamingAggregator:
         if self._sharded:
             return self._drain_sharded()
         self._queue.join()
-        if self._error is not None:
+        err = self._poisoned()
+        if err is not None:
             # the pipeline is poisoned — PERMANENTLY: once the degraded
             # retry has also failed the accumulator no longer corresponds
             # to any consistent update set, so every later drain (finalize,
@@ -741,7 +760,7 @@ class StreamingAggregator:
                 self._in_flight_models -= sum(t.k for t in stale)
             for ticket in stale:
                 ticket._ok = None
-            raise self._poison_error() from self._error
+            raise self._poison_error() from err
         with self._lock:
             pending, self._pending = self._pending, []
         accepted = 0
@@ -785,21 +804,22 @@ class StreamingAggregator:
         if self._window_start is None:
             return
         wall = max(time.monotonic() - self._window_start, 1e-9)
-        shorter = min(self._stage_seconds, self._fold_seconds)
-        if shorter > 0:
-            overlap = (self._stage_seconds + self._fold_seconds - wall) / shorter
-            OVERLAP_RATIO.set(max(0.0, min(1.0, overlap)))
-        if self._sharded:
-            for d in range(self._n_shards):
-                s, f = self._shard_stage_seconds[d], self._shard_fold_seconds[d]
-                sh = min(s, f)
-                if sh > 0:
-                    ov = (s + f - wall) / sh
-                    SHARD_OVERLAP.labels(shard=str(d)).set(max(0.0, min(1.0, ov)))
-                self._shard_stage_seconds[d] = 0.0
-                self._shard_fold_seconds[d] = 0.0
-        self._stage_seconds = 0.0
-        self._fold_seconds = 0.0
+        with self._lock:  # the drain barrier already quiesced the workers
+            shorter = min(self._stage_seconds, self._fold_seconds)
+            if shorter > 0:
+                overlap = (self._stage_seconds + self._fold_seconds - wall) / shorter
+                OVERLAP_RATIO.set(max(0.0, min(1.0, overlap)))
+            if self._sharded:
+                for d in range(self._n_shards):
+                    s, f = self._shard_stage_seconds[d], self._shard_fold_seconds[d]
+                    sh = min(s, f)
+                    if sh > 0:
+                        ov = (s + f - wall) / sh
+                        SHARD_OVERLAP.labels(shard=str(d)).set(max(0.0, min(1.0, ov)))
+                    self._shard_stage_seconds[d] = 0.0
+                    self._shard_fold_seconds[d] = 0.0
+            self._stage_seconds = 0.0
+            self._fold_seconds = 0.0
         self._window_start = None
 
     # -- shard-parallel mode ----------------------------------------------
@@ -826,24 +846,31 @@ class StreamingAggregator:
                 if not isinstance(staged, jax.Array):
                     staged = jax.device_put(staged, agg._batch_sharding)
                 agg._resolve_kernel(staged)
-        if self._plan is None:
+        with self._lock:
+            plan = self._plan
+        if plan is None:
             from .shards import ShardPlan
 
-            self._plan = ShardPlan(agg, shard_threads=self._shard_threads)
-        return self._plan
+            # built outside the lock (device work); the single producer is
+            # the only creator, the lock just publishes the reference
+            plan = ShardPlan(agg, shard_threads=self._shard_threads)
+            with self._lock:
+                self._plan = plan
+        return plan
 
     def _shard_ring(self, d: int) -> _StagingRing:
-        ring = self._shard_rings.get(d)
-        if ring is None:
-            agg = self.agg
-            width = agg.padded_length // self._n_shards
-            ring = self._shard_rings[d] = _StagingRing(
-                self.staging_buffers,
-                (self.max_batch, agg.n_limbs, width),
-                np.uint32,
-                gauge=SHARD_STAGING_DEPTH.labels(shard=str(d)),
-            )
-        return ring
+        with self._lock:
+            ring = self._shard_rings.get(d)
+            if ring is None:
+                agg = self.agg
+                width = agg.padded_length // self._n_shards
+                ring = self._shard_rings[d] = _StagingRing(
+                    self.staging_buffers,
+                    (self.max_batch, agg.n_limbs, width),
+                    np.uint32,
+                    gauge=SHARD_STAGING_DEPTH.labels(shard=str(d)),
+                )
+            return ring
 
     def _ensure_shard_workers(self) -> None:
         if self._shard_queues is None:
@@ -941,8 +968,9 @@ class StreamingAggregator:
         no overlap; the batch still commits atomically)."""
         with self._lock:
             self._in_flight_models += job.k
+            degraded = self._degraded
         BATCHES_TOTAL.labels(stage="staged").inc()
-        if not self._degraded:
+        if not degraded:
             self._ensure_shard_workers()
             INFLIGHT_FOLDS.inc()
             for item, q in zip(items, self._shard_queues):
@@ -956,8 +984,9 @@ class StreamingAggregator:
             # degradation must land before caller-thread folds touch the
             # per-shard accumulators
             self._join_shard_queues()
-            if self._error is not None:
-                raise self._poison_error() from self._error
+            err = self._poisoned()
+            if err is not None:
+                raise self._poison_error() from err
             for i, (jb, d, payload, ring, buf) in enumerate(items):
                 try:
                     self._fold_shard_item(jb, d, payload)
@@ -1024,13 +1053,15 @@ class StreamingAggregator:
         job.global_release = (ring, buf)
         with self._lock:
             self._in_flight_models += k
+            degraded = self._degraded
         BATCHES_TOTAL.labels(stage="staged").inc()
-        if self._degraded:
+        if degraded:
             released = False
             try:
                 self._join_shard_queues()
-                if self._error is not None:
-                    raise self._poison_error() from self._error
+                err = self._poisoned()
+                if err is not None:
+                    raise self._poison_error() from err
                 ok_host = np.asarray(ok)  # acceptance sync (degraded path)  # lint: sync-ok
                 ticket.accepted = ok_host
                 for d, (lo, _hi) in enumerate(plan.slices):
@@ -1074,7 +1105,8 @@ class StreamingAggregator:
         reassigned only after the fold returns, so an exception here leaves
         it consistent (the per-shard retry relies on that); failures after
         the accumulator handoff raise ``_UnsafeFoldError``."""
-        plan = self._plan
+        with self._lock:
+            plan = self._plan
         if job.kind == "wire":
             piece = payload
             if plan.native:
@@ -1117,9 +1149,9 @@ class StreamingAggregator:
         )
         with self._lock:
             self._degraded = True
+            job.retried = True
         DEGRADED.set(1)
         DEGRADATIONS.inc()
-        job.retried = True
         try:
             self._fold_shard_item(job, d, payload)
             return True
@@ -1198,7 +1230,11 @@ class StreamingAggregator:
             ring, buf = job.global_release
             job.global_release = None
             try:
-                if job.staged is not None and not job.failed:
+                # commit-tail accesses: only the LAST shard (remaining hit
+                # zero under the lock above) executes this branch, so the
+                # job is single-owner here — ownership handoff through the
+                # counter, not mutual exclusion
+                if job.staged is not None and not job.failed:  # lint: guarded-ok: last-shard tail, single owner
                     import jax
 
                     # the wire bytes must be fully consumed by the mesh
@@ -1206,14 +1242,14 @@ class StreamingAggregator:
                     jax.block_until_ready(job.staged)  # lint: sync-ok
             except BaseException as e:
                 self._poison(e, job.seq)
-                job.failed = True
+                job.failed = True  # lint: guarded-ok: last-shard tail, single owner
             finally:
                 job.staged = None
                 ring.release(buf)
         INFLIGHT_FOLDS.dec()
-        outcome = (
-            "failed" if job.failed else ("folded-degraded" if job.retried else "folded")
-        )
+        failed = job.failed  # lint: guarded-ok: last-shard tail, single owner
+        retried = job.retried  # lint: guarded-ok: last-shard tail, single owner
+        outcome = "failed" if failed else ("folded-degraded" if retried else "folded")
         BATCHES_TOTAL.labels(stage=outcome).inc()
 
     def _fold_planar_rows_now_sharded(self, rows: list) -> None:
@@ -1223,8 +1259,9 @@ class StreamingAggregator:
         thread (deliberately synchronous, same rationale as the
         single-worker path: these rows already occupy device memory)."""
         self._join_shard_queues()
-        if self._error is not None:
-            raise self._poison_error() from self._error
+        err = self._poisoned()
+        if err is not None:
+            raise self._poison_error() from err
         if self._closed:
             raise StreamingError("pipeline is closed")
         import jax
@@ -1263,13 +1300,18 @@ class StreamingAggregator:
         shard's in-flight device folds complete, and the per-shard
         accumulators reassemble into the aggregator's global ``acc``."""
         self._join_shard_queues()
-        if self._error is not None:
+        # every worker is quiesced behind the queue join: the locked reads
+        # below are for the discipline (and for late poisons from close())
+        with self._lock:
+            err = self._error
+            plan = self._plan
+        if err is not None:
             with self._lock:
                 stale, self._pending = self._pending, []
                 self._in_flight_models -= sum(t.k for t in stale)
             for ticket in stale:
                 ticket._ok = None
-            raise self._poison_error() from self._error
+            raise self._poison_error() from err
         with self._lock:
             pending, self._pending = self._pending, []
         accepted = 0
@@ -1279,11 +1321,11 @@ class StreamingAggregator:
                 ticket._ok = None
                 ticket.accepted = ok_host
                 accepted += int(ok_host.sum())
-            if self._plan is not None:
+            if plan is not None:
                 # per-shard completion barrier (device folds dispatch
                 # asynchronously; their errors surface here, not in the
                 # workers)
-                self._plan.block_until_ready()
+                plan.block_until_ready()
         except Exception as e:
             with self._lock:
                 self._error = e
@@ -1295,11 +1337,12 @@ class StreamingAggregator:
             with self._lock:
                 self.agg.nb_models += accepted
                 self._in_flight_models -= sum(t.k for t in pending)
-        if self._plan is not None:
+        if plan is not None:
             # publish the per-shard accumulators back as the global acc;
             # the next submit re-decomposes (zero-copy for device plans)
-            self.agg.acc = self._plan.reassemble()
-            self._plan.close()
-            self._plan = None
+            self.agg.acc = plan.reassemble()
+            plan.close()
+            with self._lock:
+                self._plan = None
         self._publish_overlap()
         return accepted
