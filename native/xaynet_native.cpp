@@ -117,15 +117,37 @@ void chacha20_blocks8(const uint32_t key[8], uint64_t counter0, uint8_t out[512]
     XN_QUARTER8(w3, w4, w9, w14);
   }
   __m256i v[16] = {w0, w1, w2, w3, w4, w5, w6, w7, w8, w9, w10, w11, w12, w13, w14, w15};
-  alignas(32) uint32_t lanes[16][8];
-  for (int i = 0; i < 16; i++) {
-    v[i] = _mm256_add_epi32(v[i], s[i]);
-    _mm256_store_si256((__m256i*)lanes[i], v[i]);
-  }
-  // transpose: block l = words 0..15, lane l
-  for (int l = 0; l < 8; l++) {
-    uint32_t* dst = (uint32_t*)(out + l * 64);
-    for (int i = 0; i < 16; i++) dst[i] = lanes[i][l];
+  for (int i = 0; i < 16; i++) v[i] = _mm256_add_epi32(v[i], s[i]);
+  // transpose: block l = words 0..15, lane l. Two SIMD 8x8 32-bit
+  // transposes (words 0-7 -> first 32B of each block, words 8-15 -> second
+  // 32B) replace the 128 scalar stores the first version paid per 512B.
+  for (int half = 0; half < 2; half++) {
+    const __m256i* r = v + half * 8;
+    __m256i t0 = _mm256_unpacklo_epi32(r[0], r[1]);
+    __m256i t1 = _mm256_unpackhi_epi32(r[0], r[1]);
+    __m256i t2 = _mm256_unpacklo_epi32(r[2], r[3]);
+    __m256i t3 = _mm256_unpackhi_epi32(r[2], r[3]);
+    __m256i t4 = _mm256_unpacklo_epi32(r[4], r[5]);
+    __m256i t5 = _mm256_unpackhi_epi32(r[4], r[5]);
+    __m256i t6 = _mm256_unpacklo_epi32(r[6], r[7]);
+    __m256i t7 = _mm256_unpackhi_epi32(r[6], r[7]);
+    __m256i u0 = _mm256_unpacklo_epi64(t0, t2);
+    __m256i u1 = _mm256_unpackhi_epi64(t0, t2);
+    __m256i u2 = _mm256_unpacklo_epi64(t1, t3);
+    __m256i u3 = _mm256_unpackhi_epi64(t1, t3);
+    __m256i u4 = _mm256_unpacklo_epi64(t4, t6);
+    __m256i u5 = _mm256_unpackhi_epi64(t4, t6);
+    __m256i u6 = _mm256_unpacklo_epi64(t5, t7);
+    __m256i u7 = _mm256_unpackhi_epi64(t5, t7);
+    uint8_t* o = out + half * 32;
+    _mm256_storeu_si256((__m256i*)(o + 0 * 64), _mm256_permute2x128_si256(u0, u4, 0x20));
+    _mm256_storeu_si256((__m256i*)(o + 1 * 64), _mm256_permute2x128_si256(u1, u5, 0x20));
+    _mm256_storeu_si256((__m256i*)(o + 2 * 64), _mm256_permute2x128_si256(u2, u6, 0x20));
+    _mm256_storeu_si256((__m256i*)(o + 3 * 64), _mm256_permute2x128_si256(u3, u7, 0x20));
+    _mm256_storeu_si256((__m256i*)(o + 4 * 64), _mm256_permute2x128_si256(u0, u4, 0x31));
+    _mm256_storeu_si256((__m256i*)(o + 5 * 64), _mm256_permute2x128_si256(u1, u5, 0x31));
+    _mm256_storeu_si256((__m256i*)(o + 6 * 64), _mm256_permute2x128_si256(u2, u6, 0x31));
+    _mm256_storeu_si256((__m256i*)(o + 7 * 64), _mm256_permute2x128_si256(u3, u7, 0x31));
   }
 }
 
@@ -221,14 +243,61 @@ XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_
 
   uint64_t offset = byte_offset;
   uint64_t pos = 0;  // read cursor within buf
-  for (uint64_t got = 0; got < count;) {
+  uint64_t got = 0;
+
+  if (order_nbytes <= 8) {
+    // u64 fast path (every <= 2-limb order): one unaligned 8-byte load +
+    // mask + compare per candidate instead of the generic __int128
+    // reassembly, and accepted values store as one masked u64 (the spill
+    // byte is zero and the next accept overwrites it; only the LAST
+    // element stores exactly its width). The candidate loop — not the
+    // keystream — was ~80% of the sampler wall at bpn=7.
+    const uint64_t order64 = (uint64_t)order128;
+    const uint64_t vmask =
+        order_nbytes == 8 ? ~0ull : ((1ull << (8 * order_nbytes)) - 1);
+    const uint64_t out_bytes = count * order_nbytes;
+    while (got < count) {
+      if (avail - pos < order_nbytes + 8) {
+        uint64_t tail = avail - pos;
+        std::memmove(buf.data(), buf.data() + pos, tail);
+        chacha20_fill(key, next_block, CHUNK_BLOCKS, buf.data() + tail);
+        next_block += CHUNK_BLOCKS;
+        avail = tail + CHUNK_BLOCKS * 64;
+        pos = 0;
+      }
+      // candidates fully inside the buffer (8-byte loads stay in the +512
+      // slack); stop at `count` accepts so the cursor lands exactly on the
+      // byte after the count-th accepted attempt
+      const uint64_t n_here = (avail - pos - 8) / order_nbytes;
+      const uint8_t* p = buf.data() + pos;
+      uint64_t consumed = 0;
+      for (uint64_t i = 0; i < n_here; i++) {
+        uint64_t v;
+        std::memcpy(&v, p + i * order_nbytes, 8);
+        v &= vmask;
+        consumed += order_nbytes;
+        if (v < order64) {
+          if (got * order_nbytes + 8 <= out_bytes) {
+            std::memcpy(out + got * order_nbytes, &v, 8);
+          } else {
+            std::memcpy(out + got * order_nbytes, &v, order_nbytes);
+          }
+          got++;
+          if (got == count) break;
+        }
+      }
+      pos += consumed;
+      offset += consumed;
+    }
+    return offset;
+  }
+
+  for (; got < count;) {
     if (avail - pos < order_nbytes) {
-      // move the tail to the front, refill
+      // move the tail to the front, refill through the 8-way AVX2 kernel
       uint64_t tail = avail - pos;
       std::memmove(buf.data(), buf.data() + pos, tail);
-      for (uint64_t b = 0; b < CHUNK_BLOCKS; b++) {
-        chacha20_block(key, next_block + b, buf.data() + tail + b * 64);
-      }
+      chacha20_fill(key, next_block, CHUNK_BLOCKS, buf.data() + tail);
       next_block += CHUNK_BLOCKS;
       avail = tail + CHUNK_BLOCKS * 64;
       pos = 0;
@@ -242,6 +311,71 @@ XN_EXPORT uint64_t xn_sample_uniform(const uint8_t key_bytes[32], uint64_t byte_
       std::memcpy(out + got * order_nbytes, candidate, order_nbytes);
       got++;
     }
+  }
+  return offset;
+}
+
+// Fused sample+fold (the host twin of the Pallas mask kernel): draw `count`
+// uniform values below `order` from the keystream exactly like
+// xn_sample_uniform (same attempts, same acceptance, same end cursor) and
+// ADD each accepted value into the u64 accumulator `acc[count]` instead of
+// materializing the mask. Orders must fit 8 little-endian bytes; the CALLER
+// owns the lazy-reduction headroom (sum of all folded values per slot must
+// stay below 2^64 — reduce `acc` mod order between waves). Returns the end
+// byte cursor, or 0 when the order is out of range for this entry.
+XN_EXPORT uint64_t xn_sample_fold_u64(const uint8_t key_bytes[32], uint64_t byte_offset,
+                                      uint64_t count, const uint8_t* order_le,
+                                      uint32_t order_nbytes, uint64_t* acc) {
+  if (order_nbytes == 0 || order_nbytes > 8) return 0;
+  uint32_t key[8];
+  std::memcpy(key, key_bytes, 32);
+  uint64_t order64 = 0;
+  for (int i = (int)order_nbytes - 1; i >= 0; i--)
+    order64 = (order64 << 8) | order_le[i];
+  const uint64_t vmask =
+      order_nbytes == 8 ? ~0ull : ((1ull << (8 * order_nbytes)) - 1);
+
+  constexpr uint64_t CHUNK_BLOCKS = 1024;
+  std::vector<uint8_t> buf(CHUNK_BLOCKS * 64 + 512);
+  uint64_t avail = 0;
+  uint64_t next_block = byte_offset / 64;
+  uint64_t intra = byte_offset % 64;
+  if (intra) {
+    uint8_t first[64];
+    chacha20_block(key, next_block, first);
+    next_block++;
+    avail = 64 - intra;
+    std::memcpy(buf.data(), first + intra, avail);
+  }
+
+  uint64_t offset = byte_offset;
+  uint64_t pos = 0;
+  uint64_t got = 0;
+  while (got < count) {
+    if (avail - pos < order_nbytes + 8) {
+      uint64_t tail = avail - pos;
+      std::memmove(buf.data(), buf.data() + pos, tail);
+      chacha20_fill(key, next_block, CHUNK_BLOCKS, buf.data() + tail);
+      next_block += CHUNK_BLOCKS;
+      avail = tail + CHUNK_BLOCKS * 64;
+      pos = 0;
+    }
+    const uint64_t n_here = (avail - pos - 8) / order_nbytes;
+    const uint8_t* p = buf.data() + pos;
+    uint64_t consumed = 0;
+    for (uint64_t i = 0; i < n_here; i++) {
+      uint64_t v;
+      std::memcpy(&v, p + i * order_nbytes, 8);
+      v &= vmask;
+      consumed += order_nbytes;
+      if (v < order64) {
+        acc[got] += v;  // lazy: caller reduces mod order between waves
+        got++;
+        if (got == count) break;
+      }
+    }
+    pos += consumed;
+    offset += consumed;
   }
   return offset;
 }
@@ -726,7 +860,7 @@ XN_EXPORT uint64_t xn_count_ge(const uint32_t* limbs, uint64_t count, uint32_t n
   return bad;
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 6; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 7; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout

@@ -267,6 +267,56 @@ class Pipe:
     assert not any("'Pipe.drain'" in f.message for f in findings)
 
 
+# The Pallas-kernel leg (ISSUE 11): kernel bodies (*_kernel defs in ops
+# files importing pallas) must stay pure traced code down the call graph —
+# a host sync there lowers nowhere on real hardware, but interpret mode
+# would silently run it, so the CPU CI has to catch it statically.
+PALLAS_KERNEL_LEAK = """
+import numpy as np
+from jax.experimental import pallas as pl
+
+def _chunk_helper(x):
+    return np.asarray(x)  # host sync, one call deep from a kernel body
+
+def _my_fold_kernel(ref, out):
+    out[...] = _chunk_helper(ref[...])
+"""
+
+
+def test_purity_pallas_kernel_leg(tmp_path):
+    files = {"xaynet_tpu/ops/fold_pallas.py": PALLAS_KERNEL_LEAK}
+    findings = purity.run(_graph(tmp_path, files))
+    assert any(
+        f.rule == "sync" and "_chunk_helper" in f.message and "Pallas" in f.message
+        for f in findings
+    ), findings
+
+    # suppression: an annotated trace-time constant passes
+    files["xaynet_tpu/ops/fold_pallas.py"] = PALLAS_KERNEL_LEAK.replace(
+        "np.asarray(x)  # host sync, one call deep from a kernel body",
+        "np.asarray(x)  # lint: sync-ok",
+    )
+    leg = [
+        f
+        for f in purity.run(_graph(tmp_path, files))
+        if "_chunk_helper" in f.message
+    ]
+    assert leg == []
+
+
+def test_purity_pallas_leg_ignores_files_without_pallas_import(tmp_path):
+    """The *_kernel name alone (e.g. an XLA jit builder) must not root the
+    leg — only files that import jax.experimental.pallas hold kernel
+    bodies."""
+    source = (
+        "import numpy as np\n"
+        "def _aggregate_batch_kernel(acc, order_tuple):\n"
+        "    return np.asarray(order_tuple)\n"
+    )
+    findings = purity.run(_graph(tmp_path, {"xaynet_tpu/ops/limbs_x.py": source}))
+    assert not any("_aggregate_batch_kernel" in f.message for f in findings)
+
+
 # --- accounting-invariant pass ----------------------------------------------
 
 

@@ -144,7 +144,7 @@ def test_sharded_aggregator_full_round():
 def test_sum_masks_device():
     seeds = [bytes([i]) * 32 for i in range(1, 6)]
     n = 40
-    got_unit, got_vect = masking_jax.sum_masks(seeds, n, CFG.pair())
+    got_unit, got_vect = masking_jax.sum_masks(seeds, n, CFG.pair(), kernel="host-chunked")
 
     agg = Aggregation(CFG.pair(), n)
     for s in seeds:
@@ -159,7 +159,9 @@ def test_sum_masks_device_multi_group():
     protocol scale runs #updates/seed_batch of these)."""
     seeds = [bytes([i, i ^ 0x5A]) * 16 for i in range(1, 20)]
     n = 33
-    got_unit, got_vect = masking_jax.sum_masks(seeds, n, CFG.pair(), seed_batch=4)
+    got_unit, got_vect = masking_jax.sum_masks(
+        seeds, n, CFG.pair(), seed_batch=4, kernel="host-chunked"
+    )
 
     agg = Aggregation(CFG.pair(), n)
     for s in seeds:

@@ -42,6 +42,52 @@ def test_sim_matches_production_round_single_device_and_mesh(case):
         assert mesh_report.legs["mesh"] == len(jax.devices())
 
 
+PROMOTED_SUM2_CASES = [
+    # the promoted production sum2 path (ISSUE 11): sum participants run
+    # masking_jax.sum_masks with device_sum2 forced + strict and a PINNED
+    # route per leg. "batch" streams the mask planes through the shard
+    # pipeline on the DEFAULT mesh — all 8 virtual devices under the CI
+    # flags (mesh=8; degenerates to mesh=1 on a single device) — while the
+    # fused interpret route is single-device by construction (mesh=1), so
+    # the two legs cover both mesh shapes of the promoted pipeline.
+    OracleCase(
+        group_type=GroupType.INTEGER,
+        model_length=13,
+        n_update=3,
+        seed=101,
+        block_size=2,
+        device_sum2=True,
+        mask_kernel="batch",
+    ),
+    OracleCase(
+        group_type=GroupType.PRIME,
+        model_length=37,
+        n_update=4,
+        seed=202,
+        block_size=4,
+        device_sum2=True,
+        mask_kernel="fused-pallas-interpret",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "case", PROMOTED_SUM2_CASES, ids=lambda c: f"{c.mask_kernel}-{c.group_type.name}"
+)
+def test_oracle_covers_promoted_production_sum2(case):
+    """The production leg's sum2 runs the PROMOTED pipeline (strict — a
+    broken kernel trips the oracle instead of hiding in the host
+    fallback) and stays float64-byte-identical to the sim round."""
+    production = run_production_round(case)
+    report = run_oracle_case(case, production_model=production)
+    assert report.identical and report.max_abs_diff == 0.0
+    if len(jax.devices()) > 1:
+        mesh_report = run_oracle_case(
+            case, mesh=make_mesh(), production_model=production
+        )
+        assert mesh_report.identical
+
+
 def test_oracle_detects_divergence():
     """A corrupted production model must trip OracleMismatch — the oracle
     is only worth its name if it actually fails on a byte flip."""

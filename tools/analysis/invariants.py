@@ -49,6 +49,10 @@ NB_MODELS_SITES: dict[tuple[str, str], str] = {
     # paired with the in-flight decrement (counted_models() atomicity)
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.fold_planar_rows_now"):
         "caller-thread fold credit",
+    ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.fold_planar_stack_now"):
+        "caller-thread fold credit (stacked device batch, fused mask pipeline)",
+    ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._fold_pinned_stack"):
+        "the ONE shared caller-thread shard fan-out credit (stacked + row-chunked paths)",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._credit"):
         "worker fold credit + in-flight handoff under one lock",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._fold_payload"):
@@ -72,6 +76,8 @@ NB_MODELS_SITES: dict[tuple[str, str], str] = {
         "checkpoint resume restores the persisted count",
     ("xaynet_tpu/server/aggregation.py", "StagedAggregator.finalize"):
         "host handoff copies the device count verbatim",
+    ("xaynet_tpu/server/aggregation.py", "DeviceAggregation.__init__"):
+        "in-place unmask view copies the device count verbatim",
     # participant-side local mask aggregation (SDK): not the coordinator
     # invariant, but the same field name on the shared Aggregation type
     ("xaynet_tpu/sdk/state_machine.py", "StateMachine._aggregate_masks"):

@@ -17,6 +17,13 @@ shared ``# lint: sync-ok`` annotation):
   target lives under ``xaynet_tpu/parallel``; reachable functions *in
   that tree* may not ``asarray``/``block_until_ready`` outside
   ``drain()``/``_drain*`` (the sanctioned sync points).
+- **pallas-kernel leg** — roots are the Pallas kernel bodies (functions
+  named ``*_kernel`` under ``xaynet_tpu/ops``, the shapes
+  ``pl.pallas_call`` executes); anything transitively reachable from them,
+  in any file, may not host-sync or do Python-int limb math — a host
+  round-trip inside a kernel body fails at Mosaic lowering time on real
+  hardware, but the interpret route would silently run it, so the CPU CI
+  must catch it statically (``# lint: sync-ok`` allowlist honored).
 
 Sites already covered lexically by the per-file prefix rules are skipped
 here (one finding per site, not two); everything the old heuristic missed
@@ -131,6 +138,60 @@ def run(graph: CallGraph) -> list[Finding]:
                     f"from {root_hint} (jitted sim round programs must stay "
                     "pure all the way down the call graph — the name-prefix "
                     "rule only sees the `_prog*` body itself; move the "
+                    f"'{callee}' to the host boundary or annotate "
+                    "'# lint: sync-ok')",
+                )
+            )
+
+    # --- pallas-kernel leg ------------------------------------------------
+    # roots: ``*_kernel`` defs in ops files that import Pallas — the name
+    # alone would also catch selector helpers like ``_resolve_mask_kernel``
+    # (whose closure is the whole pipeline, not a kernel body)
+    kernel_roots = [
+        fi
+        for fi in symbols.functions
+        if fi.file.rel.startswith("xaynet_tpu/ops/")
+        and fi.name.endswith("_kernel")
+        and any(
+            mod.startswith("jax.experimental.pallas")
+            for mod in fi.file.imports.values()
+        )
+    ]
+    kernel_reach = graph.reachable(kernel_roots)
+    kernel_root_uids = {fi.uid for fi in kernel_roots}
+
+    for fi in symbols.functions:
+        if fi.uid not in kernel_reach or fi.uid in sim_reach:
+            # functions shared with the sim closure were already walked
+            # above — one finding per site, not two
+            continue
+        flagged = set()
+        for node in iter_owned_nodes(fi.node):
+            if not isinstance(node, ast.Call) or node.lineno in flagged:
+                continue
+            callee = _callee_name(node)
+            bad = (
+                callee == "block_until_ready"
+                or callee in _HOST_LIMB_CALLEES
+                or _is_numpy_asarray(node, fi)
+            )
+            if not bad:
+                continue
+            flagged.add(node.lineno)
+            if suppressed("sync", fi.file.line(node.lineno)):
+                continue
+            root_hint = (
+                f"'{fi.name}'" if fi.uid in kernel_root_uids else "a Pallas kernel body"
+            )
+            findings.append(
+                Finding(
+                    "sync",
+                    fi.file.rel,
+                    node.lineno,
+                    f"host round-trip in '{fi.qualname}', which is reachable "
+                    f"from {root_hint} (Pallas kernel bodies must stay pure "
+                    "traced code — a sync lowers nowhere on real hardware "
+                    "and the interpret route would silently run it; move the "
                     f"'{callee}' to the host boundary or annotate "
                     "'# lint: sync-ok')",
                 )

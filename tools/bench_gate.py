@@ -46,8 +46,19 @@ HEADLINE_PREFIX = "masked-update aggregation throughput"
 HEADLINE_UNIT = "updates/s"
 SIM_PREFIX = "sim round throughput"
 SIM_UNIT = "participants/s"
+# full-round-path families (tools/bench_round.py): the sum2 mask
+# derive+sum and unmask+decode walls recorded as element rates, so the
+# higher-is-better floor logic applies unchanged
+SUM2_PREFIX = "e2e sum2 mask throughput"
+UNMASK_PREFIX = "e2e unmask throughput"
+ELEMENTS_UNIT = "elements/s"
 # families gated independently when no explicit --metric-prefix is given
-DEFAULT_FAMILIES = ((HEADLINE_PREFIX, HEADLINE_UNIT), (SIM_PREFIX, SIM_UNIT))
+DEFAULT_FAMILIES = (
+    (HEADLINE_PREFIX, HEADLINE_UNIT),
+    (SIM_PREFIX, SIM_UNIT),
+    (SUM2_PREFIX, ELEMENTS_UNIT),
+    (UNMASK_PREFIX, ELEMENTS_UNIT),
+)
 
 
 def extract(record: dict) -> tuple[str, float, str, str] | None:

@@ -42,6 +42,12 @@ def main() -> None:
     ap.add_argument("--model-len", type=int, default=None)
     ap.add_argument("--batch", type=int, default=16, help="updates per staged batch")
     ap.add_argument("--sum2-seeds", type=int, default=None, help="seeds for the sum2 participant leg")
+    ap.add_argument(
+        "--mask-kernel",
+        default=None,
+        help="pin the sum2 mask derive+sum route (utils.kernels.MASK_KERNELS); "
+        "default: masking_jax's auto-calibrated winner",
+    )
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
     ap.add_argument(
         "--assert-flat-rss-mb",
@@ -283,51 +289,29 @@ def main() -> None:
     if n_batches <= 2:
         rss_warm = rss_end
     rss_peak = max(rss_peak, rss_end)
+    agg_kernel_used = staged.kernel_used if on_tpu else "host"
 
-    # 5. sum2 participant leg: derive + sum k_sum2 masks. On the
-    # accelerator this is the device ChaCha kernel; on CPU it is the path a
-    # real CPU sum participant takes (native AVX2 sampler + the single-pass
-    # host fold), not the device kernel emulated on the host.
+    # 5. sum2 participant leg: derive + sum k_sum2 masks through the
+    # PRODUCTION promoted pipeline (state_machine.py device_sum2 ->
+    # masking_jax.sum_masks): every route batches the derivations in-graph
+    # (or fuses them in the Pallas kernel) and streams the mask planes
+    # through the shard pipeline — the chunked per-seed StreamSampler loop
+    # this leg used to run stopped being representative of production when
+    # the fused mask pipeline landed.
+    from xaynet_tpu.ops import masking_jax
+
+    seeds = [bytes([i & 0xFF, i >> 8]) + b"\x33" * 30 for i in range(k_sum2)]
+    if (args.mask_kernel or "auto") == "auto":
+        # resolve the route BEFORE the wall: the probe race is a one-time
+        # process cost a long-running participant amortizes across rounds
+        masking_jax.calibrate_mask_kernel(seeds, model_len, config.pair())
     t0 = time.perf_counter()
-    if on_tpu:
-        # the production SDK device path (state_machine.py device_sum2):
-        # seeds derive in vmapped groups and fold per group
-        from xaynet_tpu.ops import masking_jax
-
-        seeds = [bytes([i & 0xFF, i >> 8]) + b"\x33" * 30 for i in range(k_sum2)]
-        _, mask_acc = masking_jax.sum_masks(seeds, model_len, config.pair())
-        jax.block_until_ready(mask_acc)
-    else:
-        from xaynet_tpu.core.crypto.prng import StreamSampler
-
-        # chunked: memory stays O(chunk * model_len) however many seeds the
-        # scenario asks for (--sum2-seeds 1000 at 25M params would need
-        # ~200 GB if materialized at once)
-        chunk = 8
-        mask_acc = None
-        for s0 in range(0, k_sum2, chunk):
-            host_masks = np.stack(
-                [
-                    StreamSampler(bytes([i & 0xFF, i >> 8]) + b"\x33" * 30).draw_limbs(
-                        model_len, order
-                    )
-                    for i in range(s0, min(s0 + chunk, k_sum2))
-                ]
-            )
-            if mask_acc is None:
-                mask_acc = host_limbs.batch_mod_sum(host_masks, ol)
-            else:
-                # fold batch + running accumulator in one read (native
-                # single-pass); tree fallback only for >2-limb orders
-                fast = host_limbs.fold_wire_batch_host(mask_acc, host_masks, ol)
-                mask_acc = (
-                    fast
-                    if fast is not None
-                    else host_limbs.mod_add(
-                        mask_acc, host_limbs.batch_mod_sum(host_masks, ol), ol
-                    )
-                )
+    _, mask_acc = masking_jax.sum_masks(
+        seeds, model_len, config.pair(), kernel=args.mask_kernel
+    )
+    jax.block_until_ready(mask_acc)
     t_sum2 = time.perf_counter() - t0
+    mask_kernel_used = masking_jax.resolved_mask_kernel() or "unknown"
 
     # 6. unmask + fixed-point decode to float
     t0 = time.perf_counter()
@@ -364,15 +348,51 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    result = {
-        "metric": "e2e update-phase throughput",
-        "value": round(ups, 2),
-        "unit": "updates/s",
+    # series identity for the regression gate: (metric, kernel, mesh,
+    # threads) — a kernel or mesh change starts a NEW series instead of
+    # reading as a regression (the BENCH_r05 lesson)
+    mesh_size = len(jax.devices())
+    native_threads = os.environ.get("XAYNET_NATIVE_THREADS")
+    common = {
         "platform": platform,
         # a forced smoke measured the DEVICE branch on cpu — never mix it
         # with genuine cpu-coordinator baselines in history comparisons
         **({"device_path_forced": True} if device_forced else {}),
+        **({"native_threads": int(native_threads)} if native_threads else {}),
         "model_len": model_len,
+        "mesh": mesh_size,
+    }
+    # the sum2 + unmask walls as their own gated families (higher-is-better
+    # element rates, so the gate's best-prior floor logic applies unchanged;
+    # the raw walls ride along for humans)
+    # the workload shape rides in the METRIC NAME (the fold headline's
+    # "@25M params" variant idiom): a 1M smoke and a 25M run are different
+    # series, not a regression of one another
+    extra_records = [
+        {
+            "metric": f"e2e sum2 mask throughput @{model_len} params ({k_sum2} seeds)",
+            "value": round(k_sum2 * model_len / max(t_sum2, 1e-9), 2),
+            "unit": "elements/s",
+            "kernel": mask_kernel_used,
+            "seeds": k_sum2,
+            "wall_s": round(t_sum2, 3),
+            **common,
+        },
+        {
+            "metric": f"e2e unmask throughput @{model_len} params",
+            "value": round(model_len / max(t_unmask, 1e-9), 2),
+            "unit": "elements/s",
+            "kernel": agg_kernel_used,
+            "wall_s": round(t_unmask, 3),
+            **common,
+        },
+    ]
+    result = {
+        "metric": "e2e update-phase throughput",
+        "value": round(ups, 2),
+        "unit": "updates/s",
+        "kernel": agg_kernel_used,
+        **common,
         "updates": n_batches * k_batch,
         "breakdown_s": {name: round(t, 3) for name, t in rows},
         "rss_mb": {
@@ -382,15 +402,19 @@ def main() -> None:
             "end": round(rss_end, 1),
         },
     }
-    print(json.dumps(result))
+    for rec in extra_records:
+        print(json.dumps(rec))
+    print(json.dumps(result))  # the machine-readable tail stays LAST
     if args.history:
         hist = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_HISTORY.jsonl"
         )
         with open(hist, "a") as f:
-            f.write(
-                json.dumps({"ts": round(time.time(), 3), "source": "bench_round", **result}) + "\n"
-            )
+            for rec in (*extra_records, result):
+                f.write(
+                    json.dumps({"ts": round(time.time(), 3), "source": "bench_round", **rec})
+                    + "\n"
+                )
     if args.assert_flat_rss_mb is not None and rss_growth > args.assert_flat_rss_mb:
         print(
             f"RSS NOT FLAT: grew {rss_growth:.1f} MB > allowed {args.assert_flat_rss_mb} MB",

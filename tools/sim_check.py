@@ -59,17 +59,27 @@ def main() -> int:
     if not args.no_mesh and len(jax.devices()) > 1:
         mesh = make_mesh()
 
+    # the production leg's sum2 route walks the promoted pipeline too:
+    # every third combo pins a MASK_KERNELS route (device_sum2 strict, so
+    # a broken kernel trips the sweep instead of hiding in the fallback)
+    sum2_routes = [None, None, "batch", "fused-pallas-interpret", "host-threaded"]
+
     failures = 0
     for i in range(args.combos):
+        route = sum2_routes[int(rng.integers(len(sum2_routes)))]
         case = OracleCase(
             group_type=groups[int(rng.integers(len(groups)))],
             model_length=int(lengths[int(rng.integers(len(lengths)))]),
             n_update=int(populations[int(rng.integers(len(populations)))]),
             seed=int(rng.integers(1 << 30)),
             block_size=int(rng.choice([2, 3, 4, 8])),
+            device_sum2=route is not None,
+            mask_kernel=route or "auto",
         )
         t0 = time.time()
         outcome = {"case": case.describe(), "block": case.block_size}
+        if route is not None:
+            outcome["sum2"] = route
         try:
             production = run_production_round(case)
             report = run_oracle_case(case, production_model=production)

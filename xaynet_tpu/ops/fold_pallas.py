@@ -1,14 +1,29 @@
-"""Pallas TPU kernel for the lazy-carry batch fold.
+"""Pallas TPU kernels: the lazy-carry batch fold and the fused mask pipeline.
 
-Fuses the whole aggregation fold (16-bit split -> K-sum -> carry propagate
--> modular reduce -> accumulate) into one kernel so the staged batch makes
-exactly one HBM->VMEM trip per tile with no intermediate HBM materialization.
-Grid: one program per model-axis tile; each program loops the K updates of
-its tile in VMEM.
-
+**Batch fold** (``fold_planar_batch_pallas``): fuses the whole aggregation
+fold (16-bit split -> K-sum -> carry propagate -> modular reduce ->
+accumulate) into one kernel so the staged batch makes exactly one HBM->VMEM
+trip per tile with no intermediate HBM materialization. Grid: one program
+per model-axis tile; each program loops the K updates of its tile in VMEM.
 Equivalent to ``fold_jax.fold_planar_batch`` (the XLA version, which remains
 the fallback and the CPU/interpret oracle). Layouts match: planar
 ``uint32[K, L, n]`` batch, ``uint32[L, n]`` accumulator.
+
+**Fused mask pipeline** (``mask_fold_planar_pallas``): the Sum2 hot loop —
+keystream generation -> lexicographic rejection sampling -> modular add —
+as ONE kernel over the planar mask accumulator. Each launch folds a whole
+seed group: per seed, the ChaCha keystream is generated and
+rejection-sampled with the exact ``StreamSampler`` semantics
+(``chacha_jax.derive_uniform_limbs_ingraph`` traced INSIDE the kernel body,
+so the acceptance rule has one source of truth) and the accepted limbs are
+modularly added straight into the accumulator held in VMEM — the per-seed
+mask itself is a kernel-local value and never materializes in HBM. The
+rejection cursor is inherently sequential along the keystream, so the fused
+kernel batches over SEEDS (the model axis of one mask cannot shard without
+deriving its prefix); the interpret route is the CPU/CI path and the real
+Mosaic lowering stays behind the mask-kernel auto-calibration race
+(``ops.masking_jax``), which falls back to the XLA batch route when the
+compile fails or loses.
 """
 
 from __future__ import annotations
@@ -139,3 +154,83 @@ def fold_planar_batch_pallas(
         interpret=interpret,
     )(acc, stack_planar)
     return out[:, :n] if padded_n != n else out
+
+
+# --- fused mask pipeline: keystream -> reject-sample -> modular add --------
+
+
+def _mask_fold_kernel(
+    kw_ref, off_ref, acc_ref, out_acc_ref, out_off_ref, *, count, order, chunk_candidates
+):
+    """Fold every seed's freshly-derived mask into the planar accumulator.
+
+    The whole body is pure traced code: the derivation reuses the in-graph
+    sampler (same keystream, same rejection rule, same count-th-accept
+    cursor handoff as the scalar ``StreamSampler``), the per-seed mask is a
+    loop-carried value (VMEM-resident, never written back), and only the
+    accumulator and the end cursors leave the kernel.
+    """
+    from . import chacha_jax
+    from .fold_jax import p_mod_add
+
+    kws = kw_ref[...]  # [B, 8] seed key words
+    offs = off_ref[...]  # [B] byte cursors (post unit draw)
+    acc = acc_ref[...]  # [L, count] planar mask accumulator
+
+    def one_seed(b, carry):
+        acc, ends = carry
+        kw = jax.lax.dynamic_index_in_dim(kws, b, keepdims=False)
+        mask, end = chacha_jax.derive_uniform_limbs_ingraph(
+            kw, offs[b], count, order, chunk_candidates
+        )
+        acc = p_mod_add(acc, jnp.transpose(mask), order)
+        return acc, ends.at[b].set(end)
+
+    acc, ends = jax.lax.fori_loop(
+        0, kws.shape[0], one_seed, (acc, jnp.zeros(kws.shape[0], jnp.int32))
+    )
+    out_acc_ref[...] = acc
+    out_off_ref[...] = ends
+
+
+@partial(
+    jax.jit,
+    static_argnames=("count", "order", "chunk_candidates", "interpret"),
+    donate_argnums=(0,),
+)
+def mask_fold_planar_pallas(
+    acc,
+    key_words,
+    byte_offsets,
+    count: int,
+    order: int,
+    chunk_candidates: int | None = None,
+    interpret: bool = False,
+):
+    """Derive + modularly fold a seed group's masks into ``acc`` in ONE kernel.
+
+    ``acc`` is the planar ``uint32[L, count]`` mask accumulator (donated),
+    ``key_words`` ``uint32[B, 8]``, ``byte_offsets`` ``int32[B]`` the
+    keystream cursors each seed's vector draw resumes at (the unit draw's
+    consumed-bytes handoff). Returns ``(new_acc, end_offsets int32[B])``;
+    every seed's contribution is bit-identical to
+    ``MaskSeed.derive_mask(...).vect`` folded with a modular add, but the
+    mask tensor itself never exists outside the kernel. ``chunk_candidates``
+    bounds the per-trip keystream footprint (tiny budgets force the
+    multi-trip rejection path — the golden tests pin that case).
+    """
+    if key_words.ndim != 2 or key_words.shape[1] != 8:
+        raise ValueError("key_words must be uint32[B, 8]")
+    b = key_words.shape[0]
+    out = pl.pallas_call(
+        partial(
+            _mask_fold_kernel, count=count, order=order, chunk_candidates=chunk_candidates
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(acc.shape, jnp.uint32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        ),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(key_words, jnp.asarray(byte_offsets, jnp.int32), acc)
+    return out

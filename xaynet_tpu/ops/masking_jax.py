@@ -15,11 +15,19 @@ coordinator and sum participants run:
 - ``unmask_vect_limbs``: modular subtract of the aggregated mask from the
   aggregated masked model (the Unmask-phase kernel);
 - ``sum_masks``: aggregate many seed-derived masks (the Sum2 participant hot
-  loop: #updates x model_length group elements).
+  loop: #updates x model_length group elements). Since the fused-pipeline
+  promotion this routes through one of the ``MASK_KERNELS``
+  (``utils.kernels``): the in-graph batched derive streamed through the
+  PR-7 shard pipeline, the fused Pallas keystream→reject→fold kernel, or
+  the pre-promotion host-chunked path — ``auto`` races them once per
+  process on a probe group and memoizes the winner, exactly like the fold
+  kernels' auto-calibration.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 from functools import lru_cache
 
 import jax
@@ -30,7 +38,24 @@ from ..core.crypto.prng import StreamSampler
 from ..core.mask.config import MaskConfigPair
 from ..core.mask.encode import clamp_scalar, encode_unit, encode_vect_limbs
 from ..telemetry import profiling
+from ..telemetry.registry import get_registry
+from ..utils.kernels import MASK_KERNELS
 from . import chacha_jax, limbs as host_limbs, limbs_jax
+
+logger = logging.getLogger(__name__)
+
+# Compiled-program cache bound for the pow2-lane batched derive (and the
+# other jitted mask-pipeline builders below). Each entry retains a full XLA
+# executable specialized on (length, config, lane bucket); an unbounded
+# cache on a long-running participant serving many round shapes would
+# retain one program per shape forever.
+_COMPILE_CACHE_MAX = 16
+
+MASK_DERIVE_COMPILE_CACHE = get_registry().gauge(
+    "xaynet_mask_derive_compile_cache",
+    "Compiled mask-derivation programs currently held by the bounded "
+    "pow2-lane lru caches (batched derive + unit-draw + planarize).",
+)
 
 
 def derive_mask_limbs(
@@ -78,15 +103,66 @@ def seed_words(seeds: list[bytes]) -> np.ndarray:
     return np.stack([np.frombuffer(s, dtype="<u4") for s in seeds])
 
 
-@lru_cache(maxsize=32)
+def derive_chunk_budgets(
+    length: int, config: MaskConfigPair, lanes: int
+) -> tuple[int, int]:
+    """(unit_chunk, vect_chunk) keystream budgets for ``lanes`` concurrent
+    in-graph derivations — the ONE provisioning rule shared by the batched
+    production derive and the simulation's participant-axis vmap."""
+    return (
+        chacha_jax.provisioned_chunk(1, config.unit.order, lanes),
+        chacha_jax.provisioned_chunk(length, config.vect.order, lanes),
+    )
+
+
+def _publish_compile_cache_gauge() -> None:
+    MASK_DERIVE_COMPILE_CACHE.set(
+        _mask_batch_fn.cache_info().currsize
+        + _unit_offsets_fn.cache_info().currsize
+        + _planarize_fn.cache_info().currsize
+    )
+
+
+@lru_cache(maxsize=_COMPILE_CACHE_MAX)
 def _mask_batch_fn(length: int, config: MaskConfigPair, lane_bucket: int):
-    unit_chunk = chacha_jax.provisioned_chunk(1, config.unit.order, lane_bucket)
-    vect_chunk = chacha_jax.provisioned_chunk(length, config.vect.order, lane_bucket)
+    unit_chunk, vect_chunk = derive_chunk_budgets(length, config, lane_bucket)
 
     def one(kw):
         return derive_mask_ingraph(kw, length, config, unit_chunk, vect_chunk)
 
     return jax.jit(jax.vmap(one))
+
+
+@lru_cache(maxsize=_COMPILE_CACHE_MAX)
+def _unit_offsets_fn(config: MaskConfigPair):
+    """Jitted batched unit draw: ``uint32[B, 8]`` key words ->
+    (unit limbs ``uint32[B, L1]``, byte cursors ``int32[B]`` the vector
+    draws resume at) — the in-graph replacement for the per-seed host
+    ``StreamSampler`` unit loop."""
+    unit_chunk = chacha_jax.provisioned_chunk(1, config.unit.order, 1)
+
+    def one(kw):
+        unit, off = chacha_jax.derive_uniform_limbs_ingraph(
+            kw, jnp.int32(0), 1, config.unit.order, unit_chunk
+        )
+        return unit[0], off
+
+    return jax.jit(jax.vmap(one))
+
+
+@lru_cache(maxsize=_COMPILE_CACHE_MAX)
+def _planarize_fn(length: int, padded: int):
+    """Jitted wire ``[B, len, L]`` -> planar padded ``[B, L, padded]``
+    relayout (the shard pipeline's batch shape), done on device so the
+    derived masks never round-trip the host before folding."""
+
+    def f(vects):
+        planar = jnp.transpose(vects, (0, 2, 1))
+        if padded != length:
+            planar = jnp.pad(planar, ((0, 0), (0, 0), (0, padded - length)))
+        return planar
+
+    return jax.jit(f)
 
 
 def derive_mask_limbs_batch(
@@ -145,28 +221,402 @@ def unmask_vect_limbs(
     return limbs_jax.mod_sub(masked, mask, host_limbs.order_limbs_for(order))
 
 
+# -- promoted Sum2 pipeline: kernel routing + auto-calibration --------------
+
+# auto verdicts, process-wide (the fold kernels' `_AUTO_KERNEL_CACHE` idiom):
+# a participant resolves the route once per (backend, shape) and every later
+# Sum2 leg reuses it
+_MASK_KERNEL_CACHE: dict[tuple, str] = {}
+# observability: the route the last sum_masks call actually took
+_LAST_MASK_KERNEL: str | None = None
+
+# auto-calibration probe: candidates race on a seed group derived at
+# min(length, _PROBE_LENGTH) elements. Unlike the fold race (which times the
+# real first batch it must fold anyway), re-deriving a 25M-element group per
+# candidate would triple the first Sum2 leg — the relative kernel speeds are
+# shape-stable well below that, so the probe caps the one-time cost.
+_PROBE_LENGTH = 1 << 18
+
+
+def resolved_mask_kernel() -> str | None:
+    """The mask kernel the last ``sum_masks`` call used (bench/telemetry)."""
+    return _LAST_MASK_KERNEL
+
+
+def calibrate_mask_kernel(
+    seeds, length: int, config: MaskConfigPair, seed_batch: int = 8, mesh=None
+) -> str:
+    """Resolve (and memoize) the auto route for this shape NOW.
+
+    ``sum_masks(kernel="auto")`` calibrates lazily inside its first call;
+    steady-state measurements (tools/bench_round.py) call this first so the
+    one-time probe race stays out of the per-round wall — exactly how a
+    long-running participant amortizes it."""
+    return _resolve_mask_kernel(seeds, length, config, seed_batch, mesh)
+
+
+def _acc_unit(unit_acc, group_unit: np.ndarray, ol_u: np.ndarray) -> np.ndarray:
+    """Fold one group's unit-limb sum into the running unit accumulator —
+    the ONE accumulate idiom every route shares."""
+    if unit_acc is None:
+        return group_unit
+    return host_limbs.mod_add(unit_acc[None, :], group_unit[None, :], ol_u)[0]
+
+
+def _host_sampler_threads(n_items: int, default_cap: int) -> int:
+    """Thread budget for the host sampler routes. An explicit
+    ``XAYNET_NATIVE_THREADS`` pin wins OUTRIGHT (bounded only by the item
+    count): it is the thread key the bench records in the gated
+    BENCH_HISTORY series, so the code silently second-guessing it would
+    relabel the experiment (the BENCH_r05 lesson) — and the operator who
+    pins it owns any memory trade. The default is the core count capped
+    at ``default_cap`` (the fused route passes a small cap because each
+    thread holds an ``8 * length``-byte u64 partial accumulator, ~200 MB
+    at 25M params)."""
+    env = os.environ.get("XAYNET_NATIVE_THREADS", "")
+    if env:
+        try:
+            return max(1, min(int(env), n_items))
+        except ValueError:
+            logger.warning("ignoring non-integer XAYNET_NATIVE_THREADS=%r", env)
+    return max(1, min(os.cpu_count() or 1, n_items, default_cap))
+
+
+def _mask_route(used: str, seeds, length, config, seed_batch, mesh):
+    if used == "host-chunked":
+        return _sum_masks(seeds, length, config, seed_batch)
+    if used == "host-threaded":
+        return _sum_masks_host_threaded(seeds, length, config, seed_batch)
+    if used in ("fused-pallas", "fused-pallas-interpret"):
+        return _sum_masks_fused(
+            seeds, length, config, seed_batch, interpret=used == "fused-pallas-interpret"
+        )
+    return _sum_masks_batched(seeds, length, config, seed_batch, mesh)
+
+
+def _resolve_mask_kernel(
+    seeds, length: int, config: MaskConfigPair, seed_batch: int, mesh
+) -> str:
+    backend = jax.default_backend()
+    bucket = min(max(1, seed_batch), len(seeds))
+    # the mesh is part of the verdict's identity: the batch route's cost is
+    # mesh-dependent, so a winner probed without a mesh must not be reused
+    # for mesh-sharded calls (and vice versa)
+    mesh_key = (
+        None
+        if mesh is None
+        else (tuple(mesh.devices.shape), tuple(int(d.id) for d in mesh.devices.flat))
+    )
+    key = (backend, length, config, bucket, mesh_key)
+    cached = _MASK_KERNEL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    probe_len = min(length, _PROBE_LENGTH)
+    probe = list(seeds[:bucket])
+    if backend == "cpu":
+        # the interpret route is the CPU/CI leg of the fused kernel — raced
+        # for real, so the fused pipeline stays continuously exercised and
+        # wins exactly when it is actually faster; the threaded native
+        # sampler is the CPU incumbent the in-graph routes must beat
+        candidates = ["host-threaded", "batch", "fused-pallas-interpret"]
+    else:
+        candidates = ["batch", "fused-pallas", "host-threaded"]
+    timings: dict[str, float] = {}
+    for name in candidates:
+        try:
+            fn = lambda name=name: _mask_route(name, probe, probe_len, config, seed_batch, mesh)
+            fn()  # compile / first touch
+            _, dt = profiling.measure(fn)
+            timings[name] = dt
+            profiling.record_calibration(f"mask-{name}", dt)
+        except Exception as e:  # Mosaic/compile failure -> keep the others
+            logger.warning(
+                "mask kernel %s unavailable: %s: %s", name, type(e).__name__, e
+            )
+    winner = min(timings, key=timings.get) if timings else "host-chunked"
+    _MASK_KERNEL_CACHE[key] = winner
+    logger.info(
+        "mask kernel auto-calibration (%s backend, probe %d): %s -> %s",
+        backend,
+        probe_len,
+        {k: round(v, 4) for k, v in timings.items()},
+        winner,
+    )
+    return winner
+
+
 def sum_masks(
-    seeds: list[bytes], length: int, config: MaskConfigPair, seed_batch: int = 8
+    seeds: list[bytes],
+    length: int,
+    config: MaskConfigPair,
+    seed_batch: int = 8,
+    kernel: str | None = None,
+    mesh=None,
 ) -> tuple[np.ndarray, jax.Array]:
     """Derive and modularly sum the masks of many seeds (Sum2 hot loop).
 
-    Returns (unit limbs, vector limbs) of the aggregated mask.
+    Returns (unit limbs, vector limbs) of the aggregated mask; every route
+    is bit-identical to folding ``MaskSeed.derive_mask`` per seed.
 
-    Seeds derive in groups of ``seed_batch`` through one vmapped keystream
-    kernel per chunk round (``chacha_jax.derive_uniform_limbs_batch``), then
-    each group folds with one ``batch_mod_sum`` pass — at the reference's
-    10k-updates scale that is #updates/seed_batch kernel series instead of
-    #updates (sum2.rs:170-193 is the per-seed loop this replaces). Device
-    memory is bounded by ``seed_batch * length`` mask elements.
+    ``kernel`` picks the route (``utils.kernels.MASK_KERNELS``; ``None``
+    honors ``XAYNET_MASK_KERNEL`` then defaults to ``auto``):
 
-    Device-synced timing is recorded as the ``mask_expand`` kernel op
-    (#seeds x length elements expanded and folded per call).
+    - ``batch`` — ALL of a seed group's derivations (unit draws, cursor
+      handoffs, vector draws) run in ONE jitted in-graph program
+      (``derive_mask_limbs_batch``), and the resulting mask planes stream
+      through the PR-7 shard pipeline (per-shard fold workers on a mesh);
+    - ``fused-pallas[-interpret]`` — the Pallas keystream→reject→fold
+      kernel: masks never materialize in HBM
+      (``fold_pallas.mask_fold_planar_pallas``);
+    - ``host-chunked`` — the pre-promotion path (host unit draws + chunked
+      device vector derivation + ``aggregate_batch`` folds);
+    - ``auto`` — races the candidates once per (backend, shape) on a probe
+      group and memoizes the winner process-wide.
+
+    Device memory is bounded by ``seed_batch * length`` mask elements
+    (``batch``), one mask's chunk budget (``fused``), and device-synced
+    timing is recorded as the ``mask_expand`` kernel op either way.
     """
     if not seeds:
         raise ValueError("no seeds to aggregate")
+    if kernel is None:
+        kernel = os.environ.get("XAYNET_MASK_KERNEL") or "auto"
+    if kernel not in MASK_KERNELS:
+        raise ValueError(f"kernel must be one of {MASK_KERNELS}, got {kernel!r}")
+    if kernel == "auto":
+        kernel = _resolve_mask_kernel(seeds, length, config, seed_batch, mesh)
+    global _LAST_MASK_KERNEL
+    _LAST_MASK_KERNEL = kernel
     return profiling.timed_kernel(
-        "mask_expand", len(seeds) * length, lambda: _sum_masks(seeds, length, config, seed_batch)
+        "mask_expand",
+        len(seeds) * length,
+        lambda: _mask_route(kernel, seeds, length, config, seed_batch, mesh),
     )
+
+
+def _sum_masks_batched(
+    seeds: list[bytes], length: int, config: MaskConfigPair, seed_batch: int, mesh
+) -> tuple[np.ndarray, np.ndarray]:
+    """The promoted route: one jitted in-graph program per seed group, mask
+    planes streamed through the PR-7 shard pipeline.
+
+    Each group's units/cursors/vectors derive in ONE compiled program (no
+    per-seed host loop), the group's wire-layout masks relayout to planar
+    on device, and the shard pipeline folds them into the (mesh-sharded)
+    planar accumulator — on a multi-device mesh each device folds its own
+    model-axis slice, so the aggregated mask is reduced on-shard exactly
+    like the update fold."""
+    from ..parallel.aggregator import ShardedAggregator
+    from ..parallel.streaming import StreamingAggregator
+
+    step = max(1, seed_batch)
+    agg = ShardedAggregator(config.vect, length, mesh=mesh, kernel="xla")
+    stream = StreamingAggregator(agg, max_batch=max(2, step))
+    ol_u = host_limbs.order_limbs_for(config.unit.order)
+    unit_acc: np.ndarray | None = None
+    try:
+        for g0 in range(0, len(seeds), step):
+            group = seeds[g0 : g0 + step]
+            units, vects = derive_mask_limbs_batch(group, length, config)
+            planar = _planarize_fn(length, agg.padded_length)(vects)
+            _publish_compile_cache_gauge()
+            stream.fold_planar_stack_now(planar)
+            group_unit = host_limbs.batch_mod_sum(np.asarray(units)[:, None, :], ol_u)[0]
+            unit_acc = _acc_unit(unit_acc, group_unit, ol_u)
+        stream.drain()
+        vect = agg.snapshot()
+    finally:
+        stream.close()
+    assert unit_acc is not None
+    return unit_acc, vect
+
+
+def _sum_masks_host_fused(
+    seeds: list[bytes], length: int, config: MaskConfigPair
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """The native twin of the Pallas fused kernel: ``xn_sample_fold_u64``
+    rejection-samples each seed's mask straight INTO a u64 accumulator —
+    no mask bytes, no bytes→limbs pass, no stack, no separate fold read.
+    Seeds split across threads with per-thread partial accumulators
+    (disjoint memory; the GIL is released inside the native call), merged
+    with the exact limb ``mod_add``. Returns ``None`` when the entry
+    doesn't apply (no library, order wider than 8 bytes) so the caller
+    falls back to the materializing wave path."""
+    from ..utils import native
+
+    lib = native.load()
+    order = config.vect.order
+    bpn = (order.bit_length() + 7) // 8
+    # order > 2^63 can't even hold residual + one fold in u64 (2*order - 2
+    # wraps), so the wave path serves those
+    if (
+        lib is None
+        or bpn > 8
+        or order > (1 << 63)
+        or not hasattr(lib, "xn_sample_fold_u64")
+    ):
+        return None
+    from concurrent.futures import ThreadPoolExecutor
+
+    order_le = order.to_bytes(bpn, "little")
+    ol_u = host_limbs.order_limbs_for(config.unit.order)
+    n_limb = host_limbs.n_limbs_for_order(order)
+    # u64 lazy-reduction headroom: the unreduced partial holds one reduced
+    # residual (< order) plus up to `reduce_every` folds (< order each), so
+    # (reduce_every + 1) * order must stay below 2^64 (>= 1 for any
+    # order <= 2^63; huge for typical orders)
+    reduce_every = max(1, (1 << 64) // order - 2)
+    nt = _host_sampler_threads(len(seeds), default_cap=4)
+    chunks = [seeds[i::nt] for i in range(nt)]
+
+    def run_chunk(chunk: list[bytes]):
+        acc = np.zeros(length, dtype=np.uint64)
+        units = []
+        since_reduce = 0
+        for seed in chunk:
+            sampler = StreamSampler(seed)
+            units.append(sampler.draw_limbs(1, config.unit.order)[0])
+            if since_reduce >= reduce_every:
+                np.mod(acc, np.uint64(order), out=acc)
+                since_reduce = 1
+            else:
+                since_reduce += 1
+            end = lib.xn_sample_fold_u64(
+                native.as_u8p(seed),
+                sampler.consumed_bytes,
+                length,
+                native.as_u8p(order_le),
+                bpn,
+                native.np_u64p(acc),
+            )
+            if end == 0:  # out-of-range order: caller takes the wave path
+                return None
+        np.mod(acc, np.uint64(order), out=acc)
+        return acc, units
+
+    with ThreadPoolExecutor(max_workers=nt) as pool:
+        results = list(pool.map(run_chunk, chunks))
+    if any(r is None for r in results):
+        return None
+
+    def to_limbs(acc64: np.ndarray) -> np.ndarray:
+        lo = (acc64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        if n_limb == 1:
+            return lo[:, None]
+        hi = (acc64 >> np.uint64(32)).astype(np.uint32)
+        return np.stack([lo, hi], axis=1)
+
+    ol_v = host_limbs.order_limbs_for(order)
+    vect_acc: np.ndarray | None = None
+    unit_acc: np.ndarray | None = None
+    for acc64, units in results:
+        part = to_limbs(acc64)
+        vect_acc = part if vect_acc is None else host_limbs.mod_add(vect_acc, part, ol_v)
+        for u in units:
+            unit_acc = _acc_unit(unit_acc, u, ol_u)
+    assert unit_acc is not None and vect_acc is not None
+    return unit_acc, vect_acc
+
+
+def _sum_masks_host_threaded(
+    seeds: list[bytes], length: int, config: MaskConfigPair, seed_batch: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The CPU incumbent: the fused native sample+fold when it applies
+    (``_sum_masks_host_fused`` — the mask never materializes), else
+    per-seed derivations on the native (AVX2) ``StreamSampler`` across a
+    GIL-released thread pool, folded per wave with the single-pass native
+    batch fold. Memory stays bounded by ``seed_batch * length`` mask
+    elements (one wave at a time) — the shape that lets 10k-seed Sum2
+    legs run on a laptop."""
+    fused = _sum_masks_host_fused(seeds, length, config)
+    if fused is not None:
+        return fused
+    from concurrent.futures import ThreadPoolExecutor
+
+    ol_v = host_limbs.order_limbs_for(config.vect.order)
+    ol_u = host_limbs.order_limbs_for(config.unit.order)
+    step = max(1, seed_batch)
+
+    def derive(seed: bytes) -> tuple[np.ndarray, np.ndarray]:
+        sampler = StreamSampler(seed)
+        unit = sampler.draw_limbs(1, config.unit.order)[0]
+        return unit, sampler.draw_limbs(length, config.vect.order)
+
+    unit_acc: np.ndarray | None = None
+    vect_acc: np.ndarray | None = None
+    with ThreadPoolExecutor(max_workers=_host_sampler_threads(len(seeds), default_cap=8)) as pool:
+        for g0 in range(0, len(seeds), step):
+            group = seeds[g0 : g0 + step]
+            pairs = list(pool.map(derive, group))
+            units = np.stack([u for u, _ in pairs])
+            vects = np.stack([v for _, v in pairs])
+            pairs.clear()
+            group_unit = host_limbs.batch_mod_sum(units[:, None, :], ol_u)[0]
+            if vect_acc is None:
+                vect_acc = host_limbs.batch_mod_sum(vects, ol_v)
+                unit_acc = group_unit
+            else:
+                # batch + running accumulator in one native read; tree
+                # fallback only for orders outside the single-pass kernels
+                fast = host_limbs.fold_wire_batch_host(vect_acc, vects, ol_v)
+                vect_acc = (
+                    fast
+                    if fast is not None
+                    else host_limbs.mod_add(
+                        vect_acc, host_limbs.batch_mod_sum(vects, ol_v), ol_v
+                    )
+                )
+                unit_acc = _acc_unit(unit_acc, group_unit, ol_u)
+    assert unit_acc is not None and vect_acc is not None
+    return unit_acc, vect_acc
+
+
+def _sum_masks_fused(
+    seeds: list[bytes],
+    length: int,
+    config: MaskConfigPair,
+    seed_batch: int,
+    interpret: bool,
+    chunk_candidates: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The fused route: keystream→reject→fold in one Pallas kernel per seed
+    group; the per-seed masks never materialize in HBM. Unit draws and the
+    byte-cursor handoff run in-graph (``_unit_offsets_fn``) — no scalar
+    host derivation anywhere on this path."""
+    from . import fold_pallas
+    from .fold_jax import planar_to_wire
+
+    n_limb = host_limbs.n_limbs_for_order(config.vect.order)
+    ol_u = host_limbs.order_limbs_for(config.unit.order)
+    step = max(1, seed_batch)
+    # seeds fold sequentially inside the kernel, so one seed's chunk budget
+    # is the whole keystream footprint
+    chunk = (
+        chunk_candidates
+        if chunk_candidates is not None
+        else chacha_jax.provisioned_chunk(length, config.vect.order, 1)
+    )
+    acc = jnp.zeros((n_limb, length), dtype=jnp.uint32)
+    unit_acc: np.ndarray | None = None
+    unit_fn = _unit_offsets_fn(config)
+    _publish_compile_cache_gauge()
+    for g0 in range(0, len(seeds), step):
+        group = seeds[g0 : g0 + step]
+        kw = jnp.asarray(seed_words(group))
+        units, offsets = unit_fn(kw)
+        acc, _ends = fold_pallas.mask_fold_planar_pallas(
+            acc,
+            kw,
+            offsets,
+            length,
+            config.vect.order,
+            chunk_candidates=chunk,
+            interpret=interpret,
+        )
+        group_unit = host_limbs.batch_mod_sum(np.asarray(units)[:, None, :], ol_u)[0]
+        unit_acc = _acc_unit(unit_acc, group_unit, ol_u)
+    assert unit_acc is not None
+    return unit_acc, planar_to_wire(np.asarray(acc))
 
 
 def _sum_masks(

@@ -539,6 +539,46 @@ class StreamingAggregator:
             with self._lock:
                 agg.nb_models += n_piece
 
+    def fold_planar_stack_now(self, stacked) -> None:
+        """Fold an already device-resident planar ``[K, L, padded_len]``
+        BATCH on the CALLER's thread — the fused-mask-pipeline shape
+        (``ops.masking_jax``): a whole seed group's mask planes come out of
+        one jitted derive as a single stacked array, so re-slicing it into
+        rows only to re-stack them would buy two copies. Same rationale and
+        accounting as :meth:`fold_planar_rows_now` (device-resident batches
+        are never queued; ``agg.acc`` has one mutator at a time); in
+        shard-parallel mode each shard folds its addressable slice."""
+        if stacked.shape[0] == 0:
+            return
+        k = int(stacked.shape[0])
+        import jax
+
+        agg = self.agg
+        if self._sharded:
+            self._join_shard_queues()
+            err = self._poisoned()
+            if err is not None:
+                raise self._poison_error() from err
+            if self._closed:
+                raise StreamingError("pipeline is closed")
+            plan = self._ensure_plan(k, lambda: stacked)
+            # pin the mesh layout: the derive emits a single-device array,
+            # and the per-shard fan-out reads addressable shards
+            stacked = jax.device_put(stacked, agg._batch_sharding)
+            self._fold_pinned_stack(plan, stacked, k)
+            return
+        self._queue.join()
+        err = self._poisoned()
+        if err is not None:
+            raise self._poison_error() from err
+        if self._closed:
+            raise StreamingError("pipeline is closed")
+        agg._resolve_kernel_cheap(k)
+        new_acc = agg._fold(agg.acc, stacked)
+        with self._lock:
+            agg.acc = new_acc
+            agg.nb_models += k
+
     def submit_host_planar_rows(self, rows: list) -> StreamTicket:
         """Stream-fold host planar ``[L, padded_len]`` rows (numpy), copied
         into a ring buffer here so the caller can recycle its arrays."""
@@ -1252,6 +1292,26 @@ class StreamingAggregator:
         outcome = "failed" if failed else ("folded-degraded" if retried else "folded")
         BATCHES_TOTAL.labels(stage=outcome).inc()
 
+    def _fold_pinned_stack(self, plan, stacked, k: int) -> None:
+        """Fold ONE batch-sharding-pinned device batch through the shard
+        plan on the caller's thread and credit ``nb_models`` under the
+        lock — the per-shard fan-out idiom shared by the stacked and
+        row-chunked caller-thread paths (one copy, not three: the
+        ``by_start`` shard addressing and the credit ordering are exactly
+        the PR-7-hardened sequence a missed divergent copy would break)."""
+        if plan.native:
+            full = np.asarray(stacked)  # lint: sync-ok
+            for d in range(plan.n_shards):
+                plan.fold_shard_slice(d, full)
+        else:
+            by_start = {
+                s.index[-1].start or 0: s.data for s in stacked.addressable_shards
+            }
+            for d, (lo, _hi) in enumerate(plan.slices):
+                plan.fold_shard(d, by_start[lo])
+        with self._lock:
+            self.agg.nb_models += k
+
     def _fold_planar_rows_now_sharded(self, rows: list) -> None:
         """Shard-parallel variant of :meth:`fold_planar_rows_now`: the rows
         are already device-resident mesh-sharded planars, so each shard
@@ -1281,18 +1341,7 @@ class StreamingAggregator:
             stacked = jax.device_put(jnp.stack(piece), agg._batch_sharding)
             n_piece = len(piece)
             del piece
-            if plan.native:
-                full = np.asarray(stacked)  # lint: sync-ok
-                for d in range(plan.n_shards):
-                    plan.fold_shard_slice(d, full)
-            else:
-                by_start = {
-                    s.index[-1].start or 0: s.data for s in stacked.addressable_shards
-                }
-                for d, (lo, _hi) in enumerate(plan.slices):
-                    plan.fold_shard(d, by_start[lo])
-            with self._lock:
-                agg.nb_models += n_piece
+            self._fold_pinned_stack(plan, stacked, n_piece)
 
     def _drain_sharded(self) -> int:
         """The cross-shard barrier: every shard queue drains, the one

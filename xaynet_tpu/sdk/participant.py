@@ -75,8 +75,11 @@ class Participant:
         keys: Optional[SigningKeyPair] = None,
         max_message_size: Optional[int] = 4096,
         # None = auto: the Sum2 device path turns on when JAX's default
-        # backend is an accelerator (see PetSettings.device_sum2)
+        # backend is an accelerator (see PetSettings.device_sum2); an
+        # explicit True forces the promoted batched pipeline at any size
         device_sum2: Optional[bool] = None,
+        # Sum2 mask derive+sum route (see PetSettings.mask_kernel)
+        mask_kernel: str = "auto",
         # wrap URL clients in the retrying ResilientClient (one flaky 429 or
         # dropped connection must not turn a participant into a dropout);
         # pass False to talk raw HTTP, or hand in a pre-built client
@@ -101,6 +104,7 @@ class Participant:
                 scalar=scalar,
                 max_message_size=max_message_size,
                 device_sum2=device_sum2,
+                mask_kernel=mask_kernel,
                 mask_seed=mask_seed,
             )
             self._sm = StateMachine(settings, client, self._store, self._events)

@@ -110,6 +110,13 @@ class PetSettings:
     # falling back to the host path (tests set this so a broken device
     # kernel cannot hide behind the fallback)
     device_sum2_strict: bool = False
+    # Sum2 mask derive+sum route (utils.kernels.MASK_KERNELS): "auto" (the
+    # default) lets masking_jax race the candidates once per process;
+    # explicit values PIN the route — and therefore engage the promoted
+    # pipeline at any model size (only an explicit device_sum2=False
+    # overrides a pin back to the legacy host path). The oracle pins each
+    # leg this way.
+    mask_kernel: str = "auto"
     # deterministic mask seed for the Update task (32 bytes). None (the
     # default, and the only safe production value) draws a fresh random
     # seed per update exactly like the reference; injecting a fixed seed
@@ -126,6 +133,12 @@ class PetSettings:
             )
         if self.mask_seed is not None and len(self.mask_seed) != 32:
             raise ValueError("mask_seed must be exactly 32 bytes")
+        from ..utils.kernels import MASK_KERNELS
+
+        if self.mask_kernel not in MASK_KERNELS:
+            raise ValueError(
+                "mask_kernel must be one of: " + " | ".join(MASK_KERNELS)
+            )
 
 
 @dataclass
@@ -168,6 +181,7 @@ class StateMachine:
         self.max_message_size = settings.max_message_size
         self.device_sum2 = settings.device_sum2
         self.device_sum2_strict = settings.device_sum2_strict
+        self.mask_kernel = settings.mask_kernel
         self.mask_seed = settings.mask_seed
         self.client = client
         self.model_store = model_store
@@ -358,20 +372,39 @@ class StateMachine:
         return await self._send(payload, PhaseKind.AWAITING)
 
     def _aggregate_masks(self, mask_seeds, length: int, config) -> MaskObject:
-        # length gate first: small models must not pay for the accelerator
-        # probe (the auto default imports jax on first resolution)
-        use_device = length >= self.DEVICE_SUM2_THRESHOLD and (
-            self.device_sum2
-            if self.device_sum2 is not None
-            else _default_backend_is_accelerator()
+        # getattr: tests build bare machines with __new__ and set only flags
+        mask_kernel = getattr(self, "mask_kernel", "auto")
+        pinned = mask_kernel not in (None, "auto")
+        # an explicit device_sum2=True — or a PINNED mask_kernel (the
+        # setting's contract: explicit values pin the route, so it must
+        # actually engage the routed pipeline) — takes the promoted path
+        # regardless of model size; an explicit device_sum2=False always
+        # wins. Otherwise the length gate runs first, so small models never
+        # pay for the accelerator probe (the auto default imports jax on
+        # first resolution).
+        use_device = (
+            self.device_sum2 is True
+            or (pinned and self.device_sum2 is not False)
+            or (
+                self.device_sum2 is not False
+                and length >= self.DEVICE_SUM2_THRESHOLD
+                and (
+                    self.device_sum2
+                    if self.device_sum2 is not None
+                    else _default_backend_is_accelerator()
+                )
+            )
         )
         if use_device:
             try:
                 from ..core.mask.object import MaskUnit, MaskVect
                 from ..ops import masking_jax
 
+                # the kwarg is only passed when pinned: the default route
+                # stays masking_jax's auto-calibrated choice
+                kernel_kw = {"kernel": mask_kernel} if pinned else {}
                 unit, vect = masking_jax.sum_masks(
-                    [s.as_bytes() for s in mask_seeds], length, config
+                    [s.as_bytes() for s in mask_seeds], length, config, **kernel_kw
                 )
                 return MaskObject(
                     MaskVect(config.vect, np.asarray(vect)),
@@ -481,6 +514,7 @@ class StateMachine:
             "max_message_size": self.max_message_size,
             "device_sum2": self.device_sum2,
             "device_sum2_strict": self.device_sum2_strict,
+            "mask_kernel": self.mask_kernel,
             "mask_seed": self.mask_seed.hex() if self.mask_seed else None,
             "phase": self.phase.value,
             "task": self.task.value,
@@ -522,6 +556,7 @@ class StateMachine:
             # the save/restore round trip
             device_sum2=(None if d.get("device_sum2") is None else bool(d["device_sum2"])),
             device_sum2_strict=bool(d.get("device_sum2_strict", False)),
+            mask_kernel=str(d.get("mask_kernel") or "auto"),
             mask_seed=(
                 bytes.fromhex(d["mask_seed"]) if d.get("mask_seed") else None
             ),

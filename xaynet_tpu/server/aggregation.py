@@ -25,9 +25,100 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.mask.config import MaskConfigPair
-from ..core.mask.masking import Aggregation, AggregationError
+from ..core.mask.masking import Aggregation, AggregationError, UnmaskingError
 from ..core.mask.object import LazyWireMaskVect, MaskObject, MaskUnit, MaskVect
+from ..ops import limbs as limb_ops
 from ..telemetry import profiling
+
+
+class DeviceAggregation(Aggregation):
+    """Aggregation view over the still-sharded device accumulator.
+
+    ``finalize()`` materializes a host ``Aggregation`` — it GATHERS the
+    whole mesh accumulator into one wire-layout host array before the
+    Unmask phase has even subtracted the mask. This view keeps the
+    accumulator where it is: ``unmask_array``/``unmask`` subtract the
+    elected mask per-shard in place (``ShardedAggregator.unmask_limbs`` —
+    each mesh device subtracts its own model-axis slice; the host
+    ``mod_sub`` runs only when a native fold left the accumulator
+    host-resident), and only the *unmasked* result crosses to the host for
+    the fixed-point decode. Validation and the tiny unit channel need no
+    accumulator read at all; ``object`` stays available for
+    checkpoint/test paths that genuinely want the gathered aggregate.
+    """
+
+    def __init__(self, config: MaskConfigPair, object_size: int, device, unit_acc):
+        # deliberately NOT calling super().__init__: it would allocate an
+        # empty host MaskObject of the full model size just to carry configs
+        self.nb_models = device.nb_models
+        self.object_size = object_size
+        self._config = config
+        self._device = device
+        self._unit_acc = np.asarray(unit_acc)
+
+    @property
+    def config(self) -> MaskConfigPair:
+        return self._config
+
+    @property
+    def object(self) -> MaskObject:
+        """Gathered host aggregate (checkpoints/tests only — the unmask
+        path never calls this)."""
+        return MaskObject(
+            MaskVect(self._config.vect, self._device.snapshot()),
+            MaskUnit(self._config.unit, self._unit_acc),
+        )
+
+    def validate_unmasking(self, mask: MaskObject) -> None:
+        if self.nb_models == 0:
+            raise UnmaskingError("NoModel")
+        if self.nb_models > self._config.vect.max_nb_models:
+            raise UnmaskingError("TooManyModels")
+        if self.nb_models > self._config.unit.max_nb_models:
+            raise UnmaskingError("TooManyScalars")
+        if self._config.vect != mask.vect.config or self.object_size != len(mask.vect):
+            raise UnmaskingError("MaskManyMismatch")
+        if self._config.unit != mask.unit.config:
+            raise UnmaskingError("MaskOneMismatch")
+        if not mask.is_valid():
+            raise UnmaskingError("InvalidMask")
+
+    def _unmasked_limbs(self, mask_obj: MaskObject) -> tuple[np.ndarray, int]:
+        # per-shard in-place subtract: the mask planes upload with the
+        # accumulator's sharding and each device subtracts its own slice;
+        # the gather happens AFTER the subtraction, on the unmasked result
+        n_vect = self._device.unmask_limbs(mask_obj.vect.data)
+        ol_u = limb_ops.order_limbs_for(self._config.unit.order)
+        n_unit = limb_ops.mod_sub(
+            self._unit_acc[None, :], np.asarray(mask_obj.unit.data)[None, :], ol_u
+        )[0]
+        return n_vect, limb_ops.limbs_to_int(n_unit)
+
+    # the base implementations read configs through ``self.object`` —
+    # which HERE would gather the mesh accumulator; re-expressed on the
+    # carried config pair so unmasking never touches the property
+    def unmask_array(self, mask_obj: MaskObject) -> np.ndarray:
+        from ..core.mask.encode import (
+            decode_scalar_sum,
+            decode_vect_any,
+            decode_vect_fast,
+            has_fast_path,
+        )
+
+        n_vect, n_unit = self._unmasked_limbs(mask_obj)
+        scalar_sum = decode_scalar_sum(n_unit, self._config.unit, self.nb_models)
+        if has_fast_path(self._config.vect):
+            return decode_vect_fast(n_vect, self._config.vect, self.nb_models, scalar_sum)
+        return decode_vect_any(n_vect, self._config.vect, self.nb_models, scalar_sum)
+
+    def unmask(self, mask_obj: MaskObject):
+        from ..core.mask.encode import decode_scalar_sum, decode_vect_exact
+        from ..core.mask.model import Model
+
+        n_vect, n_unit = self._unmasked_limbs(mask_obj)
+        scalar_sum = decode_scalar_sum(n_unit, self._config.unit, self.nb_models)
+        values = limb_ops.limbs_to_ints(n_vect)
+        return Model(decode_vect_exact(values, self._config.vect, self.nb_models, scalar_sum))
 
 
 class StagedAggregator:
@@ -380,3 +471,22 @@ class StagedAggregator:
         )
         agg.nb_models = self._device.nb_models
         return agg
+
+    def finalize_inplace(self) -> Aggregation:
+        """The Unmask handoff WITHOUT gathering the accumulator.
+
+        Host mode is unchanged (the accumulator is host-resident — its
+        ``mod_sub`` is the right unmask). Device mode returns a
+        :class:`DeviceAggregation` view over the still-sharded accumulator,
+        so the Unmask phase subtracts the elected mask per-shard in place
+        and only the unmasked result crosses to the host for decode —
+        ``finalize()`` (kept for snapshot/test callers) gathers first and
+        subtracts after, a full extra accumulator round-trip at 25M params.
+        """
+        self.drain()
+        if self._device is None:
+            return self._host
+        self._stream.close()
+        return DeviceAggregation(
+            self.config, self.object_size, self._device, self._unit_acc
+        )

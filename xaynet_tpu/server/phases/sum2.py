@@ -33,7 +33,10 @@ class Sum2Phase(PhaseState):
     async def next(self):
         from .unmask import Unmask
 
-        return Unmask(self.shared, self.aggregator.finalize())
+        # finalize WITHOUT gathering: device rounds hand Unmask a sharded
+        # view so the elected mask is subtracted per-shard in place (host
+        # rounds get the host Aggregation exactly as before)
+        return Unmask(self.shared, self.aggregator.finalize_inplace())
 
     async def handle_request(self, req: StateMachineRequest) -> None:
         if not isinstance(req, Sum2Request):

@@ -7,9 +7,13 @@ scores are ambiguous -> round failure); validate and unmask the aggregate;
 persist the global model under ``{round_id}_{hex(seed)}`` with the latest-id
 pointer; publish proof to the trust anchor; broadcast the new model.
 
-The unmask subtract runs on the vectorized limb kernels; the fixed-point
-decode uses the double-double fast path for f32 configs
-(core/mask/encode.py).
+The unmask subtract runs on the vectorized limb kernels. Device rounds
+arrive as a ``DeviceAggregation`` view (``aggregation.finalize_inplace``):
+the subtract runs per-shard against the still-sharded accumulator — each
+mesh device unmasks its own model-axis slice, the aggregate is never
+gathered before subtraction, and the host ``mod_sub`` only runs when a
+native fold left the accumulator host-resident. The fixed-point decode
+uses the double-double fast path for f32 configs (core/mask/encode.py).
 """
 
 from __future__ import annotations
@@ -55,9 +59,17 @@ class Unmask(PhaseState):
             self.model_agg.validate_unmasking(mask)
         except UnmaskingError as err:
             raise PhaseError("Unmasking", err.kind) from err
-        self.global_model = profiling.timed_kernel(
-            "unmask", len(self.model_agg), lambda: self.model_agg.unmask_array(mask)
-        )
+        from ..aggregation import DeviceAggregation
+
+        if isinstance(self.model_agg, DeviceAggregation):
+            # the sharded in-place subtract records the `unmask` kernel op
+            # itself (ShardedAggregator.unmask_limbs) — wrapping it again
+            # here would double-count the op in /metrics
+            self.global_model = self.model_agg.unmask_array(mask)
+        else:
+            self.global_model = profiling.timed_kernel(
+                "unmask", len(self.model_agg), lambda: self.model_agg.unmask_array(mask)
+            )
         await self._save_global_model()
         await self._publish_proof()
 

@@ -54,6 +54,13 @@ class OracleCase:
     seed: int = 0  # roots the weights RNG and the injected mask seeds
     block_size: int = 4  # sim participants per vmap block
     time_max: float = 60.0
+    # drive the production leg's sum participants through the PROMOTED
+    # device sum2 pipeline (masking_jax.sum_masks) instead of the scalar
+    # host path — with a pinned route so each oracle leg is deterministic
+    # about the code it exercises ("auto" = the calibrated winner). Strict:
+    # a broken device kernel must trip the oracle, not hide in a fallback.
+    device_sum2: bool = False
+    mask_kernel: str = "auto"
 
     @property
     def mask_config(self) -> MaskConfig:
@@ -157,9 +164,19 @@ async def _drive_production_round(case: OracleCase) -> np.ndarray:
         participants = []
         for i in range(case.n_sum):
             keys = keys_for_task(round_seed, SUM_PROB, UPDATE_PROB, "sum", start=i * 1000)
+            pet = (
+                PetSettings(
+                    keys=keys,
+                    device_sum2=True,
+                    device_sum2_strict=True,
+                    mask_kernel=case.mask_kernel,
+                )
+                if case.device_sum2
+                else PetSettings(keys=keys)
+            )
             participants.append(
                 ParticipantSM(
-                    PetSettings(keys=keys),
+                    pet,
                     InProcessClient(fetcher, handler),
                     _ArrayModelStore(None),
                 )

@@ -57,8 +57,13 @@ from ..core.mask.encode import (
     encode_unit,
     has_fast_path,
 )
-from ..ops import chacha_jax, limbs as host_limbs, limbs_jax
-from ..ops.masking_jax import derive_mask_ingraph, encode_models_batch, seed_words
+from ..ops import limbs as host_limbs, limbs_jax
+from ..ops.masking_jax import (
+    derive_chunk_budgets,
+    derive_mask_ingraph,
+    encode_models_batch,
+    seed_words,
+)
 from ..parallel.mesh import MODEL_AXIS, shard_map_compat
 from ..telemetry import profiling
 
@@ -115,10 +120,10 @@ class SimRound:
         self._n_limb_v = host_limbs.n_limbs_for_order(cfg.vect.order)
         self._n_limb_u = host_limbs.n_limbs_for_order(cfg.unit.order)
         # chunk budgets: block_size lanes derive concurrently (scan blocks
-        # are sequential; each mesh device runs block_size lanes too)
-        self._unit_chunk = chacha_jax.provisioned_chunk(1, cfg.unit.order, spec.block_size)
-        self._vect_chunk = chacha_jax.provisioned_chunk(
-            spec.model_length, cfg.vect.order, spec.block_size
+        # are sequential; each mesh device runs block_size lanes too) — the
+        # shared provisioning rule of the promoted production derive
+        self._unit_chunk, self._vect_chunk = derive_chunk_budgets(
+            spec.model_length, cfg, spec.block_size
         )
         self._program = jax.jit(self._build_program())
         self.program_calls = 0  # observability: one per run(), never per participant

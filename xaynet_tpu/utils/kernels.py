@@ -16,3 +16,35 @@ cleanly when the shared library won't build.
 """
 
 FOLD_KERNELS = ("auto", "xla", "pallas", "pallas-interpret", "native-u64")
+
+# Sum2 mask derive+sum kernels (``ops.masking_jax.sum_masks``):
+#
+# - ``batch``        — ALL derivations of a seed group in ONE jitted in-graph
+#                      program (``derive_mask_limbs_batch``), the resulting
+#                      mask planes streamed through the PR-7 shard pipeline;
+# - ``fused-pallas`` — the Pallas keystream→reject→modular-add kernel
+#                      (``ops.fold_pallas.mask_fold_planar_pallas``): the mask
+#                      is never materialized in HBM, only the accumulator is;
+# - ``fused-pallas-interpret`` — the same kernel through the Pallas
+#                      interpreter (the CPU route that keeps the fused kernel
+#                      continuously exercised without a Mosaic compiler);
+# - ``host-threaded`` — the CPU incumbent: the fused native sample+fold
+#                      (``xn_sample_fold_u64`` — accepted draws accumulate
+#                      straight into a u64 buffer, the mask never
+#                      materializes) when the order fits, else the native
+#                      (AVX2) ``StreamSampler`` across a GIL-released
+#                      thread pool with the single-pass batch fold;
+# - ``host-chunked`` — the pre-promotion device path (host unit draws per
+#                      seed + host-chunked device vector derivation), kept
+#                      as an explicit fallback;
+# - ``auto``         — first call races the candidates on a probe seed group
+#                      (the fold-kernel auto-calibration idiom) and memoizes
+#                      the winner process-wide.
+MASK_KERNELS = (
+    "auto",
+    "batch",
+    "fused-pallas",
+    "fused-pallas-interpret",
+    "host-threaded",
+    "host-chunked",
+)

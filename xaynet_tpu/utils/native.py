@@ -18,7 +18,7 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -81,6 +81,18 @@ def load() -> Optional[ctypes.CDLL]:
             u8p,
         ]
         lib.xn_sample_uniform.restype = ctypes.c_uint64
+        # fused sample+fold (ABI 7): accepted draws accumulate into a u64
+        # buffer instead of materializing the mask bytes
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.xn_sample_fold_u64.argtypes = [
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint32,
+            u64p,
+        ]
+        lib.xn_sample_fold_u64.restype = ctypes.c_uint64
         lib.xn_mod_add.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
         lib.xn_mod_add.restype = None
         lib.xn_fold_planar_u64.argtypes = [
@@ -192,6 +204,10 @@ def np_u8p(arr):
 
 def np_u32p(arr):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def np_u64p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
 
 
 def np_u32p_at(arr, element_offset: int):
