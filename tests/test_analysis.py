@@ -413,6 +413,83 @@ def test_metrics_brace_shorthand_expansion(tmp_path):
     assert _metrics_fixture(tmp_path, code, rows) == []
 
 
+# --- span-discipline pass (ISSUE 12) ----------------------------------------
+
+
+def _spans_fixture(tmp_path, code: str, doc_rows: str):
+    from tools.analysis import spans
+
+    src = tmp_path / "xaynet_tpu/mod.py"
+    src.parent.mkdir(parents=True, exist_ok=True)
+    src.write_text(code)
+    design = tmp_path / "DESIGN.md"
+    design.write_text(
+        "<!-- span-table:begin -->\n| Span | Where |\n|---|---|\n"
+        + doc_rows
+        + "\n<!-- span-table:end -->\n"
+    )
+    info = SourceCache(tmp_path).get(src)
+    return spans.run([info], design)
+
+
+def test_span_parity_and_with_discipline_ok(tmp_path):
+    code = (
+        "from ..telemetry import tracing as trace\n"
+        "S = trace.declare_span('mod.work')\n"
+        "def f():\n"
+        "    with trace.get_tracer().span(S, batch=1):\n"
+        "        pass\n"
+        "    tracer = trace.get_tracer()\n"
+        "    with tracer.span('mod.work'):\n"
+        "        pass\n"
+    )
+    rows = "| `mod.work` | mod.py |"
+    assert _spans_fixture(tmp_path, code, rows) == []
+
+
+def test_span_bare_call_and_undeclared_flagged(tmp_path):
+    code = (
+        "from ..telemetry import tracing as trace\n"
+        "S = trace.declare_span('mod.work')\n"
+        "def f():\n"
+        "    h = trace.get_tracer().span(S)\n"  # not a with-item
+        "    with trace.get_tracer().span('mod.undeclared'):\n"
+        "        pass\n"
+    )
+    rows = "| `mod.work` | mod.py |\n| `mod.undeclared` | nowhere |"
+    msgs = " | ".join(f.message for f in _spans_fixture(tmp_path, code, rows))
+    assert "must be used as a `with` item" in msgs
+    assert "never declared" in msgs
+    assert "not declared anywhere" in msgs  # the stale doc row for mod.undeclared
+
+
+def test_span_duplicate_declaration_and_table_drift(tmp_path):
+    code = (
+        "from ..telemetry import tracing as trace\n"
+        "A = trace.declare_span('mod.dup')\n"
+        "B = trace.declare_span('mod.dup')\n"
+        "C = trace.declare_span('mod.solo')\n"
+    )
+    rows = "| `mod.dup` | mod.py |"
+    msgs = " | ".join(f.message for f in _spans_fixture(tmp_path, code, rows))
+    assert "declared more than once" in msgs
+    assert "'mod.solo' is not in the DESIGN.md §16 span table" in msgs
+
+
+def test_span_brace_shorthand_rows(tmp_path):
+    code = (
+        "from ..telemetry import tracing as trace\n"
+        "A = trace.declare_span('mod.one')\n"
+        "B = trace.declare_span('mod.two')\n"
+        "def f():\n"
+        "    with trace.get_tracer().span(A):\n"
+        "        with trace.get_tracer().span(B):\n"
+        "            pass\n"
+    )
+    rows = "| `mod.{one,two}` | mod.py |"
+    assert _spans_fixture(tmp_path, code, rows) == []
+
+
 # --- suppression / baseline mechanics ---------------------------------------
 
 

@@ -38,7 +38,7 @@ from xaynet_tpu.edge import (
     PartialAggregateEnvelope,
 )
 from xaynet_tpu.edge.rest import EdgeRestServer
-from xaynet_tpu.sdk.client import HttpClient
+from xaynet_tpu.sdk.client import HttpClient, ResilientClient
 from xaynet_tpu.sdk.simulation import build_update_message, keys_for_task
 from xaynet_tpu.sdk.state_machine import PetSettings, StateMachine as ParticipantSM
 from xaynet_tpu.sdk.traits import ModelStore
@@ -213,10 +213,18 @@ async def _drive_round(
     params = await probe.get_round_params()
     seed = params.seed.as_bytes()
     n = len(models)
+    for target in update_targets:
+        # resilient targets pin the round's trace id so their uploads
+        # stitch into the coordinator's round trace (DESIGN §16)
+        set_round_trace = getattr(target, "set_round_trace", None)
+        if set_round_trace is not None:
+            set_round_trace(seed)
 
     sum_keys = keys_for_task(seed, SUM_PROB, UPDATE_PROB, "sum", start=0)
     summer = ParticipantSM(
-        PetSettings(keys=sum_keys), HttpClient(coord.url), _ArrayModelStore(None)
+        PetSettings(keys=sum_keys),
+        ResilientClient(HttpClient(coord.url)),
+        _ArrayModelStore(None),
     )
 
     async def drive_summer():
@@ -744,3 +752,82 @@ def test_edge_crash_mid_window_participants_fall_back_upstream():
                 np.testing.assert_allclose(np.asarray(model), expected, atol=1e-9)
 
     asyncio.run(run())
+
+
+# --- distributed round tracing (docs/DESIGN.md §16) --------------------------
+
+
+def test_two_tier_round_single_stitched_trace(tmp_path):
+    """Acceptance: a two-tier round (edge -> coordinator shard pipeline,
+    SDK summer) produces ONE Chrome trace that passes the CI validator and
+    carries spans from all five subsystems under ONE trace id — the id
+    every tier derived independently from the round seed."""
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    repo = _Path(__file__).resolve().parent.parent
+    if str(repo) not in _sys.path:
+        _sys.path.insert(0, str(repo))
+    from tools import trace_report
+    from xaynet_tpu.telemetry import tracing
+
+    tracer = tracing.get_tracer()
+    old_mode, old_dir = tracer.mode, tracer.trace_dir
+    tracer.configure(mode="on", trace_dir=str(tmp_path))
+
+    async def run():
+        n = 4
+        rng = np.random.default_rng(9)
+        models = [rng.uniform(-1, 1, MODEL_LEN).astype(np.float32) for _ in range(n)]
+        settings = _settings(n)
+        # the device path (shard-parallel on a multi-device mesh, the
+        # single-worker streaming pipeline otherwise) — the `stream.*`
+        # spans come from here
+        settings.aggregation.device = True
+        settings.aggregation.batch_size = 2
+        async with _Coordinator(settings) as coord:
+            async with _Edge(coord.url, "edge-tr", max_members=2) as edge:
+                await coord.wait_phase("sum")
+                targets = [ResilientClient(HttpClient(edge.url))]
+
+                async def edge_ready():
+                    await edge.wait_update_phase()
+
+                try:
+                    await asyncio.wait_for(
+                        _drive_round(coord, models, targets, before_updates=edge_ready),
+                        120,
+                    )
+                    # the round's export flushes when the NEXT round's Idle
+                    # opens its window — wait for the file inside the
+                    # coordinator's lifetime
+                    for _ in range(400):
+                        if list(tmp_path.glob("round_*.trace.json")):
+                            break
+                        await asyncio.sleep(0.05)
+                finally:
+                    for t in targets:
+                        t.close()
+
+    try:
+        asyncio.run(run())
+        files = sorted(tmp_path.glob("round_*.trace.json"))
+        assert files, "no per-round trace exported"
+        events = trace_report.load_events(str(files[0]))
+        assert trace_report.validate(events) == []
+        (round_event,) = [e for e in events if e["name"] == "round"]
+        trace_id = round_event["args"]["trace"]
+        stitched = [e for e in events if e["args"].get("trace") == trace_id]
+        subsystems = {e["cat"] for e in stitched}
+        # the five concurrent subsystems + the SDK, one trace id
+        assert {"rest", "ingest", "stream", "phase", "edge", "sdk"} <= subsystems, (
+            subsystems
+        )
+        # the envelope hop stitched: the coordinator's fold span links the
+        # edge's seal span
+        folds = [e for e in stitched if e["name"] == "edge.upstream_fold"]
+        seals = {e["args"]["span"] for e in stitched if e["name"] == "edge.seal"}
+        assert folds and any(e["args"].get("link") in seals for e in folds)
+    finally:
+        tracer.configure(mode=old_mode, trace_dir=old_dir)
+        tracer.end_round()

@@ -451,3 +451,53 @@ def test_sharded_fold_planar_rows_now_device_resident(kernel):
     assert np.array_equal(agg.snapshot(), seq.snapshot())
     assert agg.nb_models == seq.nb_models == total
     stream.close()
+
+
+def test_healthz_pipeline_section_degraded_shard():
+    """Satellite (ISSUE 12): after the PR-7 single-shard sync-retry path
+    fires, /healthz's pipeline section must surface the global degraded
+    flag AND the per-shard triple — the first place an operator looks when
+    the mesh goes degraded."""
+    n, total, bs = 48, 6, 3
+    stacks, _, _ = _updates(n, total, seed=31)
+    seq = _sequential_oracle(n, stacks, bs)
+
+    agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
+    stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
+    real_fold = ShardPlan.fold_shard
+    state = {"failed": False}
+
+    def flaky(self, d, batch):
+        if d == 2 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient shard fault")
+        return real_fold(self, d, batch)
+
+    try:
+        ShardPlan.fold_shard = flaky
+        for i in range(0, total, bs):
+            stream.submit_batch(np.stack(stacks[i : i + bs]))
+        stream.drain()
+    finally:
+        ShardPlan.fold_shard = real_fold
+
+    assert stream.degraded  # the sync-retry path fired
+    from xaynet_tpu.server.rest import RestServer
+    from xaynet_tpu.telemetry.registry import get_registry
+
+    rest = RestServer.__new__(RestServer)  # only _streaming_health is exercised
+    rest.registry = get_registry()
+    section = rest._streaming_health()
+    assert section is not None
+    assert section["degraded"] is True
+    assert section["inflight_folds"] == 0  # drained
+    for d in range(8):
+        shard = section["shards"][str(d)]
+        assert shard["staging_depth"] == 0
+        assert shard["inflight_folds"] == 0
+        assert "overlap_ratio" in shard
+    # the degraded round still completed byte-identically (PR-7 ladder)
+    assert np.array_equal(agg.snapshot(), seq.snapshot())
+    stream.close()
+    # close resets the flag for the next healthy pipeline's healthz
+    assert rest._streaming_health()["degraded"] is False

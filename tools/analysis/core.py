@@ -94,6 +94,11 @@ rule(
     "xaynet_* metric registered more than once, or code <-> DESIGN.md "
     "metric-table drift",
 )
+rule(
+    "span",
+    "tracing span() not used as a context manager, span name declared "
+    "twice / undeclared, or code <-> DESIGN.md §16 span-table drift",
+)
 
 
 def suppressed(rule_name: str, line: str) -> bool:

@@ -31,7 +31,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from . import filerules, invariants, locks, metricscheck, purity
+from . import filerules, invariants, locks, metricscheck, purity, spans
 from .cache import ResultCache, SourceCache
 from .callgraph import CallGraph, SymbolTable
 from .core import Baseline, Finding
@@ -186,6 +186,7 @@ class Analyzer:
         findings.extend(purity.run(graph))
         findings.extend(invariants.run(graph))
         findings.extend(metricscheck.run(infos, design))
+        findings.extend(spans.run(infos, design))
         self.results.put_project(tree_key, findings)
         return findings
 

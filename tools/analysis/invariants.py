@@ -57,8 +57,9 @@ NB_MODELS_SITES: dict[tuple[str, str], str] = {
         "worker fold credit + in-flight handoff under one lock",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._fold_payload"):
         "degraded-path wire credit from the synced acceptance vector",
-    ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.drain"):
-        "the ONE deferred wire credit at the drain barrier",
+    ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._drain_inner"):
+        "the ONE deferred wire credit at the drain barrier (drain()'s body; "
+        "the public method only wraps it in the stream.drain trace span)",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._dispatch_sharded"):
         "degraded shard-parallel batch credit",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._dispatch_sharded_wire"):

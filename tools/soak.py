@@ -123,14 +123,19 @@ def run_chaos_soak_sync(
 
     url = f"http://127.0.0.1:{port}"
 
-    def _client():
+    def _client(round_seed: bytes | None = None):
         # a multi-hundred-round soak must survive the transient blips it
         # exists to exercise: one connection reset on a bare HttpClient
         # would abort the whole run (the sum leg already retries — the
         # Participant wraps its client in ResilientClient by default)
         # one-shot per-poll client: its event loop dies with asyncio.run,
         # so a pooled keep-alive socket would just leak until GC
-        return ResilientClient(HttpClient(url, keep_alive=False))
+        client = ResilientClient(HttpClient(url, keep_alive=False))
+        # pin the round's trace id: chaos uploads stitch into the
+        # coordinator's round trace, so a failed round's flight dump can
+        # be joined to the soak's own logs
+        client.set_round_trace(round_seed)
+        return client
 
     def fetch_params():
         return asyncio.run(_client().get_round_params())
@@ -162,7 +167,7 @@ def run_chaos_soak_sync(
             raise RuntimeError(f"round {completed + 1}: sum dictionary never appeared")
 
         async def flood_updates():
-            client = _client()
+            client = _client(round_seed=seed)
 
             async def submit(blob: bytes) -> None:
                 await client.send_message(blob)
@@ -269,6 +274,10 @@ def run_two_tier_soak_sync(
 
             async def flood_edges():
                 clients = [ResilientClient(HttpClient(u)) for u in edge_urls]
+                for c in clients:
+                    # two-tier uploads carry the round trace id too: the
+                    # edge adopts it, so edge + coordinator + soak stitch
+                    c.set_round_trace(seed)
                 rr = itertools.count()
 
                 async def submit(blob: bytes) -> None:
@@ -516,6 +525,13 @@ def main() -> None:
                 )
             )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # flight-recorder dumps must SURVIVE the soak's tempdir: a failed
+        # chaos round's forensics are the whole point of keeping them
+        # (mkdtemp outside `tmp`; the path is printed in the result JSON
+        # and on any failure)
+        flight_dir = tempfile.mkdtemp(prefix="xaynet-soak-flight-")
+        env["XAYNET_FLIGHT_DIR"] = flight_dir
+        os.environ["XAYNET_FLIGHT_DIR"] = flight_dir  # SDK-side triggers too
         if fault_plan is not None:
             env["XAYNET_FAULT_PLAN"] = fault_plan
         if args.device_kernel:
@@ -603,9 +619,36 @@ def main() -> None:
                     )
                 return run_soak_sync(args.port, n_rounds, args.model_len)
 
-            run_block(warmup_rounds)
-            rss_warm = _rss_kb(proc.pid)
-            result = run_block(args.rounds)
+            def _flight_dumps() -> list:
+                try:
+                    return sorted(
+                        os.path.join(flight_dir, f)
+                        for f in os.listdir(flight_dir)
+                        if f.startswith("flight_")
+                    )
+                except OSError:
+                    return []
+
+            try:
+                run_block(warmup_rounds)
+                rss_warm = _rss_kb(proc.pid)
+                result = run_block(args.rounds)
+            except Exception as err:
+                # a failed/non-identical round stops being
+                # reproduce-from-scratch: name the forensic bundles the
+                # coordinator/edges dumped on the way down
+                dumps = _flight_dumps()
+                print(
+                    json.dumps(
+                        {
+                            "soak_failed": str(err),
+                            "flight_dir": flight_dir,
+                            "flight_dumps": dumps,
+                        }
+                    ),
+                    file=sys.stderr,
+                )
+                raise
             rss_end = _rss_kb(proc.pid)
             resolved = None
             if args.device_kernel:
@@ -633,6 +676,8 @@ def main() -> None:
                     "fault_plan": fault_plan,
                     "dropout": dropout if chaos else None,
                     "stragglers": stragglers if chaos else None,
+                    "flight_dir": flight_dir,
+                    "flight_dumps": _flight_dumps(),
                 }
             )
             print(json.dumps(result))

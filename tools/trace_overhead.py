@@ -1,0 +1,156 @@
+"""Tracing-overhead bench leg: the streaming fold path, spans on vs off.
+
+The fold headline's span surface is the streaming pipeline (stage/fold/
+commit/drain spans per batch) — the raw kernel loop carries no spans, so
+measuring it would trivially show zero. This leg drives the PRODUCTION
+submit/drain path at the headline batch shape with tracing ``on`` and
+``off`` and reports the relative delta; BENCH.md records the number, and
+the DESIGN §16 policy is: the default stays ``[metrics] trace = "on"``
+while the overhead is <2%, else the default flips to failure-only
+sampling.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/trace_overhead.py [--model-len N]
+                    [--k K] [--batches B] [--reps R]
+Prints one JSON line: {updates_per_s_on, updates_per_s_off, overhead_pct}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _one_window(mode: str, stack, config, model_len: int, n_batches: int) -> float:
+    """updates/s of one submit+drain window in ``mode``."""
+    from xaynet_tpu.parallel.aggregator import ShardedAggregator
+    from xaynet_tpu.parallel.streaming import StreamingAggregator
+    from xaynet_tpu.telemetry import tracing
+
+    tracing.get_tracer().configure(mode=mode, trace_dir="")
+    k = stack.shape[0]
+    agg = ShardedAggregator(config.vect, model_len)
+    stream = StreamingAggregator(agg, max_batch=k)
+    try:
+        # one untimed window resolves the kernel + warms the rings
+        stream.submit_batch(stack)
+        stream.drain()
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            stream.submit_batch(stack)
+        stream.drain()
+        return k * n_batches / (time.perf_counter() - t0)
+    finally:
+        stream.close()
+
+
+def measure(mode: str, stack, config, model_len: int, n_batches: int, reps: int) -> float:
+    """Median updates/s over ``reps`` windows in ``mode`` (standalone use;
+    ``main`` interleaves on/off windows instead — see below)."""
+    import numpy as np
+
+    return float(
+        np.median(
+            [_one_window(mode, stack, config, model_len, n_batches) for _ in range(reps)]
+        )
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-len", type=int, default=1_000_000)
+    ap.add_argument("--k", type=int, default=8, help="updates per batch")
+    ap.add_argument("--batches", type=int, default=6, help="batches per timed window")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from xaynet_tpu.core.mask.config import (
+        BoundType, DataType, GroupType, MaskConfig, ModelType,
+    )
+    from xaynet_tpu.ops import limbs as host_limbs
+    from xaynet_tpu.utils.jaxcache import silence_cpu_cache
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        silence_cpu_cache(jax)
+    config = MaskConfig(
+        GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M6
+    ).pair()
+    n_limb = host_limbs.n_limbs_for_order(config.vect.order)
+    rng = np.random.default_rng(0)
+    # wire layout [K, model_len, L] — what submit_batch stages
+    stack = rng.integers(
+        0, 2**32, size=(args.k, args.model_len, n_limb), dtype=np.uint32
+    )
+    stack[:, :, n_limb - 1] &= np.uint32((1 << 20) - 1)
+
+    # PAIRED off/on windows, ALTERNATING order, median-of-ratios: this
+    # bench box throttles (walls drift 2-3x across a run), so two
+    # back-to-back whole passes measure the drift, not the spans — the
+    # first draft of this tool did exactly that and "measured" ~10%.
+    # Pairing adjacent windows cancels the slow drift; alternating which
+    # mode runs first cancels the intra-pair heat-up bias (an A/A off-vs-
+    # off control showed the SECOND window of a pair runs up to ~10%
+    # different on its own); the median ratio resists contended outlier
+    # draws. One discarded warm window pays the jit compile + kernel-race
+    # one-time costs for both modes.
+    _one_window("off", stack, config, args.model_len, args.batches)
+    off_ups, on_ups, ratios = [], [], []
+    for i in range(args.reps):
+        first, second = ("off", "on") if i % 2 == 0 else ("on", "off")
+        x = _one_window(first, stack, config, args.model_len, args.batches)
+        y = _one_window(second, stack, config, args.model_len, args.batches)
+        on_i, off_i = (y, x) if first == "off" else (x, y)
+        on_ups.append(on_i)
+        off_ups.append(off_i)
+        ratios.append(on_i / off_i)
+        time.sleep(1.0)  # breather between pairs (thermal)
+    off = float(np.median(off_ups))
+    on = float(np.median(on_ups))
+    ratio = float(np.median(ratios))
+    overhead = (1.0 - ratio) * 100.0
+
+    # the analytic bound alongside the noisy end-to-end number: spans per
+    # batch are a handful, so cost-per-span x spans-per-batch / batch wall
+    # bounds the overhead independently of machine noise
+    from xaynet_tpu.telemetry import tracing
+
+    tracer = tracing.get_tracer()
+    tracer.configure(mode="on")
+    name = tracing.declared_span_names()
+    probe = "trace.overhead_probe"
+    if probe not in name:
+        tracing.declare_span(probe)
+    n_probe = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        with tracer.span(probe, batch=1):
+            pass
+    span_cost_us = (time.perf_counter() - t0) / n_probe * 1e6
+    print(
+        json.dumps(
+            {
+                "updates_per_s_on": round(on, 2),
+                "updates_per_s_off": round(off, 2),
+                "overhead_pct": round(overhead, 2),
+                "pair_ratios": [round(r, 4) for r in ratios],
+                "span_cost_us": round(span_cost_us, 2),
+                "model_len": args.model_len,
+                "k": args.k,
+                "batches": args.batches,
+                "reps": args.reps,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,302 @@
+"""Round-trace consumer: text timeline, critical path, and CI validation.
+
+Reads the per-round Chrome-trace JSON the tracer exports
+(``[metrics] trace_dir`` / ``XAYNET_TRACE_DIR``; loadable as-is in
+``chrome://tracing`` / Perfetto) and renders what an operator actually
+asks of it:
+
+- ``timeline``  — a per-round text timeline: spans ordered by start,
+  indented by parent depth, with wall offsets and durations;
+- ``summary``   — per-stage (span-name) totals and the round's
+  critical-path decomposition: how much of the round wall each phase span
+  accounts for, and inside the update/sum2 phases how much the streaming
+  stage/fold legs overlap;
+- ``--validate`` — the CI schema gate: timestamps monotonic and finite,
+  no orphan parents (every ``parent`` resolves within the bundle — remote
+  hops ride ``link`` attributes precisely so this stays strict), children
+  inside their parents' windows (small tolerance), and the round's phase
+  spans covering the round span;
+- ``--round-report`` — cross-check the trace's phase walls against the
+  round report JSONL (``[metrics] round_report_path``): the two artifacts
+  measure the same bracket, so a drift beyond tolerance means one of them
+  is lying.
+
+Usage:
+  python tools/trace_report.py round_3.trace.json
+  python tools/trace_report.py --validate round_3.trace.json
+  python tools/trace_report.py --round-report reports.jsonl round_3.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# child may start marginally before its parent's first sample or end after
+# (thread scheduling between the monotonic reads); anything past this is a
+# real containment violation
+_NEST_TOLERANCE_US = 50_000.0
+
+# phase spans the round must contain to count as covered (idle/failure/
+# shutdown are round-boundary or error phases and legitimately absent)
+_REQUIRED_PHASES = ("phase.sum", "phase.update", "phase.sum2", "phase.unmask")
+
+# round-report cross-check tolerance: the trace span and the report wall
+# bracket the same process+purge region, so they agree to scheduling noise
+_PHASE_WALL_REL_TOL = 0.25
+_PHASE_WALL_ABS_TOL_S = 0.25
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _span_id(event: dict) -> str | None:
+    return (event.get("args") or {}).get("span")
+
+
+def _parent_id(event: dict) -> str | None:
+    return (event.get("args") or {}).get("parent")
+
+
+def validate(events: list[dict]) -> list[str]:
+    """Schema checks; returns human-readable problems (empty = valid)."""
+    problems: list[str] = []
+    if not events:
+        return ["trace contains no complete (ph=X) events"]
+    by_span: dict[str, dict] = {}
+    for e in events:
+        ts, dur = e.get("ts"), e.get("dur")
+        if not isinstance(ts, (int, float)) or not isinstance(dur, (int, float)):
+            problems.append(f"{e.get('name')}: non-numeric ts/dur")
+            continue
+        if ts < 0 or dur < 0 or ts != ts or dur != dur:
+            problems.append(f"{e.get('name')}: negative or NaN ts/dur ({ts}, {dur})")
+        sid = _span_id(e)
+        if sid:
+            if sid in by_span:
+                problems.append(f"duplicate span id {sid} ({e.get('name')})")
+            by_span[sid] = e
+    for e in events:
+        pid = _parent_id(e)
+        if not pid:
+            continue
+        parent = by_span.get(pid)
+        if parent is None:
+            problems.append(
+                f"{e.get('name')} (span {_span_id(e)}): orphan parent {pid}"
+            )
+            continue
+        if e["ts"] + _NEST_TOLERANCE_US < parent["ts"] or (
+            e["ts"] + e["dur"]
+            > parent["ts"] + parent["dur"] + _NEST_TOLERANCE_US
+        ):
+            problems.append(
+                f"{e.get('name')} (span {_span_id(e)}) escapes its parent "
+                f"{parent.get('name')}'s window"
+            )
+    rounds = [e for e in events if e.get("name") == "round"]
+    if len(rounds) != 1:
+        problems.append(f"expected exactly one round span, found {len(rounds)}")
+        return problems
+    rnd = rounds[0]
+    lo, hi = rnd["ts"] - _NEST_TOLERANCE_US, rnd["ts"] + rnd["dur"] + _NEST_TOLERANCE_US
+    names = {e.get("name") for e in events}
+    for required in _REQUIRED_PHASES:
+        if required not in names:
+            problems.append(f"round not covered: no {required} span")
+    for e in events:
+        if not str(e.get("name", "")).startswith("phase.") or e.get("name") in (
+            "phase.idle",
+        ):
+            continue
+        if e["ts"] < lo or e["ts"] + e["dur"] > hi:
+            problems.append(f"{e['name']} lies outside the round span")
+    return problems
+
+
+def phase_walls(events: list[dict]) -> dict[str, float]:
+    """Seconds per phase span name (summed — a resumed phase runs twice)."""
+    out: dict[str, float] = {}
+    for e in events:
+        name = str(e.get("name", ""))
+        if name.startswith("phase."):
+            out[name[len("phase."):]] = out.get(name[len("phase."):], 0.0) + (
+                e["dur"] / 1e6
+            )
+    return out
+
+
+def cross_check(events: list[dict], report: dict) -> list[str]:
+    """Trace phase walls vs the round report's phase_durations."""
+    problems: list[str] = []
+    walls = phase_walls(events)
+    for phase, reported in (report.get("phase_durations") or {}).items():
+        traced = walls.get(phase)
+        if traced is None:
+            if reported > _PHASE_WALL_ABS_TOL_S:
+                problems.append(
+                    f"report has {phase} at {reported:.3f}s but the trace has "
+                    "no such phase span"
+                )
+            continue
+        if abs(traced - reported) > max(
+            _PHASE_WALL_ABS_TOL_S, reported * _PHASE_WALL_REL_TOL
+        ):
+            problems.append(
+                f"{phase}: trace wall {traced:.3f}s vs report {reported:.3f}s "
+                "(beyond tolerance)"
+            )
+    return problems
+
+
+def _children(events: list[dict]) -> dict[str | None, list[dict]]:
+    kids: dict[str | None, list[dict]] = {}
+    for e in events:
+        kids.setdefault(_parent_id(e), []).append(e)
+    for lst in kids.values():
+        lst.sort(key=lambda e: e["ts"])
+    return kids
+
+
+def timeline(events: list[dict], limit: int = 200) -> str:
+    """Indented per-round text timeline (earliest ``limit`` spans)."""
+    if not events:
+        return "(empty trace)"
+    t0 = min(e["ts"] for e in events)
+    kids = _children(events)
+    by_span = {_span_id(e): e for e in events if _span_id(e)}
+    lines: list[str] = []
+
+    def emit(e: dict, depth: int) -> None:
+        if len(lines) >= limit:
+            return
+        attrs = {
+            k: v
+            for k, v in (e.get("args") or {}).items()
+            if k not in ("trace", "span", "parent")
+        }
+        extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"{(e['ts'] - t0) / 1e6:10.4f}s {'  ' * depth}{e['name']:<24} "
+            f"{e['dur'] / 1e6:9.4f}s  {extra}"
+        )
+        for child in kids.get(_span_id(e), []):
+            emit(child, depth + 1)
+
+    roots = [e for e in events if _parent_id(e) not in by_span]
+    roots.sort(key=lambda e: e["ts"])
+    for root in roots:
+        emit(root, 0)
+    if len(events) > limit:
+        lines.append(f"... ({len(events) - limit} more spans)")
+    return "\n".join(lines)
+
+
+def summary(events: list[dict]) -> str:
+    """Per-stage totals + the round's critical-path decomposition."""
+    if not events:
+        return "(empty trace)"
+    per_name: dict[str, tuple[int, float]] = {}
+    for e in events:
+        n, s = per_name.get(e["name"], (0, 0.0))
+        per_name[e["name"]] = (n + 1, s + e["dur"] / 1e6)
+    lines = ["per-stage totals:"]
+    for name, (n, secs) in sorted(per_name.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"  {name:<24} {n:6d} spans  {secs:10.4f}s")
+    rounds = [e for e in events if e["name"] == "round"]
+    if rounds:
+        wall = rounds[0]["dur"] / 1e6
+        lines.append(f"\ncritical path (round wall {wall:.4f}s):")
+        walls = phase_walls(events)
+        accounted = 0.0
+        for phase in ("sum", "update", "sum2", "unmask", "failure"):
+            if phase in walls:
+                accounted += walls[phase]
+                lines.append(
+                    f"  phase.{phase:<18} {walls[phase]:10.4f}s "
+                    f"({100 * walls[phase] / wall:5.1f}% of round)"
+                    if wall > 0
+                    else f"  phase.{phase:<18} {walls[phase]:10.4f}s"
+                )
+        if wall > 0:
+            lines.append(
+                f"  (other: idle/transitions) {max(0.0, wall - accounted):10.4f}s"
+            )
+        stage = sum(e["dur"] for e in events if e["name"] == "stream.stage") / 1e6
+        fold = sum(e["dur"] for e in events if e["name"] == "stream.fold") / 1e6
+        if fold > 0:
+            lines.append(
+                f"  streaming legs: stage {stage:.4f}s, fold {fold:.4f}s "
+                "(overlapped; per-shard folds run concurrently)"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="round trace report / validator")
+    ap.add_argument("trace", help="per-round Chrome-trace JSON (tracer export)")
+    ap.add_argument(
+        "--validate",
+        action="store_true",
+        help="schema gate: exit 1 on monotonicity/orphan/coverage violations",
+    )
+    ap.add_argument(
+        "--round-report",
+        default=None,
+        metavar="JSONL",
+        help="cross-check phase walls against this round-report JSONL "
+        "(matched on round_id when present, else the last line)",
+    )
+    ap.add_argument("--limit", type=int, default=200, help="timeline rows")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace)
+    problems: list[str] = []
+    if args.validate:
+        problems.extend(validate(events))
+    if args.round_report:
+        round_ids = {
+            (e.get("args") or {}).get("round_id")
+            for e in events
+            if e.get("name") == "round"
+        }
+        report = None
+        matched = False
+        with open(args.round_report) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                candidate = json.loads(line)
+                if candidate.get("round_id") in round_ids:
+                    report, matched = candidate, True
+                elif not matched:
+                    report = candidate  # fallback: the LAST line wins
+        if report is None:
+            problems.append("round report file has no reports")
+        else:
+            problems.extend(cross_check(events, report))
+
+    if not args.validate:
+        print(timeline(events, args.limit))
+        print()
+        print(summary(events))
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        print(f"trace INVALID: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    if args.validate:
+        print(
+            f"trace valid: {len(events)} spans, "
+            f"{len({(e.get('args') or {}).get('trace') for e in events})} trace id(s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

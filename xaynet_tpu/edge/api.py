@@ -73,6 +73,7 @@ class EdgeCoordinatorApi:
             members=envelope.members,
             seed_dicts=envelope.seed_dicts,
             masked=envelope.masked,
+            trace=envelope.trace,
         )
         try:
             await self.request_tx.request(request)

@@ -44,28 +44,34 @@ class PartialAggregateEnvelope:
     members: list[bytes]  # update pks, fold order
     seed_dicts: dict[bytes, LocalSeedDict]  # update pk -> {sum pk -> seed}
     masked: MaskObject  # modular sum of the members' masked models
+    # optional trace context ("trace_id-span_id") of the edge's seal span:
+    # the coordinator's fold span adopts the trace id, so a two-tier round
+    # stitches into ONE trace (docs/DESIGN.md §16). Absent on pre-tracing
+    # envelopes — the wire format stays compatible both ways.
+    trace: str | None = None
 
     def __len__(self) -> int:
         return len(self.members)
 
     def to_bytes(self) -> bytes:
         masked_raw = serialize_mask_object(self.masked)
-        header = json.dumps(
-            {
-                "edge_id": self.edge_id,
-                "window_seq": self.window_seq,
-                "round_seed": self.round_seed.hex(),
-                "members": [pk.hex() for pk in self.members],
-                "seed_dicts": {
-                    pk.hex(): {
-                        sum_pk.hex(): seed.as_bytes().hex()
-                        for sum_pk, seed in local.items()
-                    }
-                    for pk, local in self.seed_dicts.items()
-                },
-                "masked_sha256": hashlib.sha256(masked_raw).hexdigest(),
-            }
-        ).encode()
+        fields = {
+            "edge_id": self.edge_id,
+            "window_seq": self.window_seq,
+            "round_seed": self.round_seed.hex(),
+            "members": [pk.hex() for pk in self.members],
+            "seed_dicts": {
+                pk.hex(): {
+                    sum_pk.hex(): seed.as_bytes().hex()
+                    for sum_pk, seed in local.items()
+                }
+                for pk, local in self.seed_dicts.items()
+            },
+            "masked_sha256": hashlib.sha256(masked_raw).hexdigest(),
+        }
+        if self.trace:
+            fields["trace"] = self.trace
+        header = json.dumps(fields).encode()
         return MAGIC + struct.pack("<I", len(header)) + header + masked_raw
 
     @classmethod
@@ -99,6 +105,7 @@ class PartialAggregateEnvelope:
                 members=members,
                 seed_dicts=seed_dicts,
                 masked=parse_mask_object(masked_raw)[0],
+                trace=str(header["trace"]) if header.get("trace") else None,
             )
         except EnvelopeError:
             raise

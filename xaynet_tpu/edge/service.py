@@ -40,11 +40,17 @@ from ..server.requests import (
 )
 from ..server.services import PetMessageHandler
 from ..server.settings import MaskSettings, Settings
+from ..telemetry import tracing as trace
+from ..telemetry.recorder import flight_dump
 from ..telemetry.registry import get_registry
 from .aggregator import EdgeAdmitError, EdgeAggregator
 from .upstream import ResilientUpstream, UpstreamClient
 
 logger = logging.getLogger("xaynet.edge")
+
+SPAN_WINDOW = trace.declare_span("edge.window")
+SPAN_SEAL = trace.declare_span("edge.seal")
+SPAN_SHIP = trace.declare_span("edge.ship")
 
 _registry = get_registry()
 ENVELOPES_SHIPPED = _registry.counter(
@@ -218,6 +224,14 @@ class EdgeService:
             self._round_seed = seed
             self._window_opened = None
             self.round_id = int(info["round_id"])
+            # the edge derives the SAME round trace id the coordinator and
+            # the SDK derive from the public seed: its ingest/window/ship
+            # spans stitch into the one distributed round trace, and its
+            # upstream client stamps X-Xaynet-Trace accordingly
+            trace.get_tracer().begin_round(self.round_id, trace.round_trace_id(seed))
+            set_round_trace = getattr(self.upstream, "set_round_trace", None)
+            if set_round_trace is not None:  # injected test doubles may lack it
+                set_round_trace(seed)
             self.events.set_round_id(self.round_id)
             self.events.broadcast_keys(keys)
             self.events.broadcast_params(params)
@@ -298,7 +312,23 @@ class EdgeService:
     async def _seal_pending(self) -> None:
         if self.aggregator is None or not self.aggregator.pending:
             return
-        envelope = self.aggregator.seal(self.edge_id, self._round_seed)
+        opened = self._window_opened
+        tracer = trace.get_tracer()
+        with tracer.span(SPAN_SEAL, members=self.aggregator.pending) as seal_span:
+            envelope = self.aggregator.seal(self.edge_id, self._round_seed)
+            if seal_span.ctx is not None:
+                # the envelope carries the seal span's context: the
+                # coordinator's fold span adopts the trace and links back
+                envelope.trace = trace.format_header(seal_span.ctx)
+        if opened is not None:
+            # the window's lifetime (first admit -> seal) as a retro span
+            tracer.record_span(
+                SPAN_WINDOW,
+                start=opened,
+                duration=time.monotonic() - opened,
+                seq=envelope.window_seq,
+                members=len(envelope),
+            )
         self._window_opened = None
         await self._ship_q.put(envelope)  # blocks when the backlog is full
         ENVELOPE_BACKLOG.set(self._ship_q.qsize() + self._shipping)
@@ -314,7 +344,18 @@ class EdgeService:
             self._shipping = 1
             ENVELOPE_BACKLOG.set(self._ship_q.qsize() + self._shipping)
             try:
-                await self.upstream.post_envelope(envelope.to_bytes())
+                with trace.get_tracer().span(
+                    SPAN_SHIP, seq=envelope.window_seq, members=len(envelope)
+                ) as ship_span:
+                    try:
+                        await self.upstream.post_envelope(envelope.to_bytes())
+                    except BaseException as err:
+                        outcome = "dropped"
+                        if isinstance(err, ClientPermanentError):
+                            outcome = "rejected"
+                        ship_span.set(outcome=outcome)
+                        raise
+                    ship_span.set(outcome="accepted")
                 self.shipped += 1
                 ENVELOPES_SHIPPED.labels(outcome="accepted").inc()
             except ClientPermanentError as err:
@@ -337,6 +378,15 @@ class EdgeService:
                     self.edge_id,
                     envelope.window_seq,
                     err,
+                )
+                # forensic bundle: the span ring holds the window, seal and
+                # ship-retry spans that led up to losing this envelope
+                flight_dump(
+                    "edge-ship-drop",
+                    f"edge {self.edge_id} window {envelope.window_seq} "
+                    f"({len(envelope)} members): {err}",
+                    edge_id=self.edge_id,
+                    window_seq=envelope.window_seq,
                 )
             except asyncio.CancelledError:
                 raise

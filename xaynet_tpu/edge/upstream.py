@@ -20,8 +20,13 @@ from typing import Optional
 
 from ..resilience.policy import RetryPolicy
 from ..sdk.client import HttpClient, ResilientClient, default_client_policy
+from ..telemetry import tracing as trace
 
 EDGE_TOKEN_HEADER = "X-Edge-Token"
+
+SPAN_EDGE_ROUND = trace.declare_span("sdk.edge_round")
+SPAN_EDGE_ENVELOPE = trace.declare_span("sdk.edge_envelope")
+SPAN_EDGE_FORWARD = trace.declare_span("sdk.edge_forward")
 
 
 class UpstreamClient(HttpClient):
@@ -70,6 +75,13 @@ class UpstreamClient(HttpClient):
 
 class ResilientUpstream(ResilientClient):
     """Retry wrapper over :class:`UpstreamClient` (edge endpoints included)."""
+
+    SPANS = {
+        **ResilientClient.SPANS,
+        "edge_round": SPAN_EDGE_ROUND,
+        "edge_envelope": SPAN_EDGE_ENVELOPE,
+        "edge_forward": SPAN_EDGE_FORWARD,
+    }
 
     def __init__(self, inner: UpstreamClient, policy: Optional[RetryPolicy] = None):
         super().__init__(inner, policy if policy is not None else default_client_policy())

@@ -18,11 +18,18 @@ construction.
 from __future__ import annotations
 
 import asyncio
+import logging
+import time
 from typing import Optional
 
 from ..server.requests import CoalescedUpdates, RequestError, RequestSender, UpdateRequest
+from ..telemetry import tracing as trace
 from ..utils import tracing
 from .admission import BATCH_SIZE_HIST, AdmissionController
+
+logger = logging.getLogger("xaynet.ingest")
+
+SPAN_COALESCE = trace.declare_span("ingest.coalesce")
 
 
 class UpdateCoalescer:
@@ -35,6 +42,7 @@ class UpdateCoalescer:
         self.max_batch = max_batch
         self.linger_s = linger_s
         self._buf: list[tuple[UpdateRequest, asyncio.Future, str]] = []  # guarded-by: event-loop
+        self._opened: float = 0.0  # first add of the current buffer  # guarded-by: event-loop
         self._linger_task: Optional[asyncio.Task] = None  # guarded-by: event-loop
         self.batches_sent = 0  # guarded-by: event-loop
         self.members_sent = 0  # guarded-by: event-loop
@@ -51,6 +59,8 @@ class UpdateCoalescer:
         """
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         fut.add_done_callback(_consume_member_result)
+        if not self._buf:
+            self._opened = time.monotonic()
         self._buf.append((req, fut, tracing.current_request_id()))
         if len(self._buf) >= self.max_batch:
             await self.flush()
@@ -83,6 +93,20 @@ class UpdateCoalescer:
         BATCH_SIZE_HIST.labels(stage="coalesce").observe(len(batch))
         self.batches_sent += 1
         self.members_sent += len(batch)
+        # the coalesce window as a retroactive span (first add -> submit),
+        # plus the member ids in the log so one grep joins a request's REST
+        # log line to the envelope that carried it into the state machine
+        trace.get_tracer().record_span(
+            SPAN_COALESCE,
+            start=self._opened,
+            duration=time.monotonic() - self._opened,
+            n=len(batch),
+        )
+        logger.debug(
+            "coalesced %d updates into one envelope (rids: %s)",
+            len(batch),
+            " ".join(rid for _, _, rid in buf),
+        )
         try:
             await self.request_tx.request(batch)
         except RequestError as err:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from ..core.crypto.encrypt import SEALBYTES
 from ..core.message.message import HEADER_LENGTH
@@ -30,6 +31,7 @@ from ..server.events import PhaseName
 from ..server.requests import RequestError, RequestSender, UpdateRequest, request_from_message
 from ..server.services import PetMessageHandler, ServiceError
 from ..server.settings import IngestSettings
+from ..telemetry import tracing as trace
 from ..telemetry.registry import get_registry
 from ..utils import tracing
 from .admission import BATCH_SIZE_HIST, Admission, AdmissionController
@@ -37,6 +39,10 @@ from .coalescer import UpdateCoalescer
 from .intake import ShardedIntake, ShardFull
 
 logger = logging.getLogger("xaynet.ingest")
+
+SPAN_ADMISSION = trace.declare_span("ingest.admission")
+SPAN_QUEUE_WAIT = trace.declare_span("ingest.queue_wait")
+SPAN_DECRYPT_BATCH = trace.declare_span("ingest.decrypt_batch")
 
 WORKER_RESTARTS = get_registry().counter(
     "xaynet_ingest_worker_restarts_total",
@@ -131,19 +137,30 @@ class IngestPipeline:
         return self.events.phase.get_latest().event
 
     async def submit(self, encrypted: bytes) -> Admission:
-        """Admit, shed, or drop one encrypted message (REST entry point)."""
+        """Admit, shed, or drop one encrypted message (REST entry point).
+
+        The REST request id is assigned HERE and rides with the ciphertext
+        through the intake queue, so the decrypt worker and the coalescer
+        log under the same id the request logs carry — the id no longer
+        dies at the pipeline boundary.
+        """
         if len(encrypted) < _MIN_CIPHERTEXT or self._phase() not in _INGESTIBLE:
             # cheap pre-decrypt rejection: structurally impossible, or no
             # phase is accepting messages at all
             return self.admission.dropped("pre-filter")
-        verdict = self.admission.admit(self.intake.occupancy)
-        if verdict.shed:
-            return verdict
-        try:
-            self.intake.put_nowait(encrypted)
-        except ShardFull:
-            return self.admission.shed_shard_full(self.intake.occupancy)
-        self.admission.count_admitted()
+        request_id = tracing.new_request_id()
+        with trace.get_tracer().span(SPAN_ADMISSION, rid=request_id) as span:
+            verdict = self.admission.admit(self.intake.occupancy)
+            if verdict.shed:
+                span.set(verdict="shed")
+                return verdict
+            try:
+                self.intake.put_nowait((request_id, time.monotonic(), encrypted))
+            except ShardFull:
+                span.set(verdict="shed-shard-full")
+                return self.admission.shed_shard_full(self.intake.occupancy)
+            self.admission.count_admitted()
+            span.set(verdict="admitted")
         return verdict
 
     # --- drain ------------------------------------------------------------
@@ -181,30 +198,58 @@ class IngestPipeline:
             self.intake.drained()
             self.admission.observe(self.intake.occupancy)
             BATCH_SIZE_HIST.labels(stage="decrypt").observe(len(batch))
+            # the oldest member's wait IS the batch's queue-wait span: it
+            # bounds every other member's and is the number backpressure
+            # tuning needs
+            oldest = min(ts for _, ts, _ in batch)
+            trace.get_tracer().record_span(
+                SPAN_QUEUE_WAIT,
+                start=oldest,
+                duration=time.monotonic() - oldest,
+                shard=shard.index,
+                n=len(batch),
+            )
             try:
-                await self._process(batch)
+                await self._process(batch, shard.index)
             except asyncio.CancelledError:
                 raise
             except Exception:
                 # a poisoned batch must not kill the shard's worker
-                logger.exception("ingest worker %d: batch failed", shard.index)
+                logger.exception(
+                    "ingest worker %d: batch failed (rids: %s)",
+                    shard.index,
+                    " ".join(rid for rid, _, _ in batch),
+                )
 
-    async def _process(self, batch: list[bytes]) -> None:
-        results = await self.handler.process_batch(batch)
-        submits = []
-        coalescing = self.coalescer is not None and self._phase() is PhaseName.UPDATE
-        for res in results:
-            if res is None:
-                continue  # multipart chunk absorbed
-            if isinstance(res, ServiceError):
-                self.admission.count_rejection(res.stage)
-                continue
-            request_id = tracing.new_request_id()
-            req = request_from_message(res)
-            if coalescing and isinstance(req, UpdateRequest):
-                await self.coalescer.add(req)  # captures the current id
-            else:
-                submits.append(self._submit_one(req, request_id))
+    async def _process(self, batch: list[tuple], shard_index: int = -1) -> None:
+        with trace.get_tracer().span(
+            SPAN_DECRYPT_BATCH, shard=shard_index, n=len(batch)
+        ) as span:
+            results = await self.handler.process_batch([raw for _, _, raw in batch])
+            rejected = 0
+            submits = []
+            coalescing = self.coalescer is not None and self._phase() is PhaseName.UPDATE
+            for (request_id, _, _), res in zip(batch, results):
+                if res is None:
+                    continue  # multipart chunk absorbed
+                if isinstance(res, ServiceError):
+                    self.admission.count_rejection(res.stage)
+                    rejected += 1
+                    logger.debug(
+                        "[%s] ingest worker %d: message dropped at %s: %s",
+                        request_id,
+                        shard_index,
+                        res.stage,
+                        res,
+                    )
+                    continue
+                req = request_from_message(res)
+                if coalescing and isinstance(req, UpdateRequest):
+                    with tracing.use_request_id(request_id):
+                        await self.coalescer.add(req)  # captures the current id
+                else:
+                    submits.append(self._submit_one(req, request_id))
+            span.set(rejected=rejected)
         if submits:
             await asyncio.gather(*submits)
         if self.coalescer is not None and self.coalescer.pending:

@@ -37,12 +37,16 @@ import numpy as np
 from ..core.crypto.prng import StreamSampler
 from ..core.mask.config import MaskConfigPair
 from ..core.mask.encode import clamp_scalar, encode_unit, encode_vect_limbs
-from ..telemetry import profiling
+from ..telemetry import profiling, report as round_report
+from ..telemetry import tracing as trace
 from ..telemetry.registry import get_registry
 from ..utils.kernels import MASK_KERNELS
 from . import chacha_jax, limbs as host_limbs, limbs_jax
 
 logger = logging.getLogger(__name__)
+
+SPAN_MASK_CALIBRATE = trace.declare_span("mask.calibrate")
+SPAN_MASK_SUM = trace.declare_span("mask.sum")
 
 # Compiled-program cache bound for the pow2-lane batched derive (and the
 # other jitted mask-pipeline builders below). Each entry retains a full XLA
@@ -322,19 +326,36 @@ def _resolve_mask_kernel(
     else:
         candidates = ["batch", "fused-pallas", "host-threaded"]
     timings: dict[str, float] = {}
-    for name in candidates:
-        try:
-            fn = lambda name=name: _mask_route(name, probe, probe_len, config, seed_batch, mesh)
-            fn()  # compile / first touch
-            _, dt = profiling.measure(fn)
-            timings[name] = dt
-            profiling.record_calibration(f"mask-{name}", dt)
-        except Exception as e:  # Mosaic/compile failure -> keep the others
-            logger.warning(
-                "mask kernel %s unavailable: %s: %s", name, type(e).__name__, e
-            )
-    winner = min(timings, key=timings.get) if timings else "host-chunked"
+    with trace.get_tracer().span(
+        SPAN_MASK_CALIBRATE, backend=backend, length=length, probe=probe_len
+    ) as span:
+        for name in candidates:
+            try:
+                fn = lambda name=name: _mask_route(name, probe, probe_len, config, seed_batch, mesh)
+                fn()  # compile / first touch
+                _, dt = profiling.measure(fn)
+                timings[name] = dt
+                profiling.record_calibration(f"mask-{name}", dt)
+            except Exception as e:  # Mosaic/compile failure -> keep the others
+                logger.warning(
+                    "mask kernel %s unavailable: %s: %s", name, type(e).__name__, e
+                )
+        winner = min(timings, key=timings.get) if timings else "host-chunked"
+        span.set(winner=winner)
     _MASK_KERNEL_CACHE[key] = winner
+    # the verdict is round-report material: a headline shift caused by a
+    # verdict flip must be auditable from the report, not require a re-run
+    round_report.record_mask_calibration(
+        {
+            "winner": winner,
+            "backend": backend,
+            "length": length,
+            "bucket": bucket,
+            "mesh": None if mesh_key is None else list(mesh_key[0]),
+            "probe_length": probe_len,
+            "probe_walls": {k: round(v, 6) for k, v in timings.items()},
+        }
+    )
     logger.info(
         "mask kernel auto-calibration (%s backend, probe %d): %s -> %s",
         backend,
@@ -387,11 +408,14 @@ def sum_masks(
         kernel = _resolve_mask_kernel(seeds, length, config, seed_batch, mesh)
     global _LAST_MASK_KERNEL
     _LAST_MASK_KERNEL = kernel
-    return profiling.timed_kernel(
-        "mask_expand",
-        len(seeds) * length,
-        lambda: _mask_route(kernel, seeds, length, config, seed_batch, mesh),
-    )
+    with trace.get_tracer().span(
+        SPAN_MASK_SUM, kernel=kernel, seeds=len(seeds), length=length
+    ):
+        return profiling.timed_kernel(
+            "mask_expand",
+            len(seeds) * length,
+            lambda: _mask_route(kernel, seeds, length, config, seed_batch, mesh),
+        )
 
 
 def _sum_masks_batched(

@@ -79,10 +79,17 @@ import numpy as np
 from ..ops.fold_jax import MAX_LAZY_BATCH
 from ..resilience.faults import maybe_fail
 from ..telemetry import profiling
+from ..telemetry import tracing as trace
+from ..telemetry.recorder import flight_dump
 from ..telemetry.registry import get_registry
 from .aggregator import ShardedAggregator
 
 logger = logging.getLogger(__name__)
+
+SPAN_STAGE = trace.declare_span("stream.stage")
+SPAN_FOLD = trace.declare_span("stream.fold")
+SPAN_COMMIT = trace.declare_span("stream.commit")
+SPAN_DRAIN = trace.declare_span("stream.drain")
 
 _registry = get_registry()
 STAGING_DEPTH = _registry.gauge(
@@ -304,6 +311,7 @@ class StreamingAggregator:
         self._in_flight_models = 0  # submitted, not yet folded  # guarded-by: _lock
         self._error: BaseException | None = None  # guarded-by: _lock
         self._poison_seq: int | None = None  # poisoning batch index  # guarded-by: _lock
+        self._flight_dumped = False  # one flight dump per pipeline  # guarded-by: _lock
         self._degraded = False  # sync path for the rest of the round  # guarded-by: _lock
         self._batch_seq = 0  # submit-order index: producer-thread confined
         self._worker: threading.Thread | None = None
@@ -401,6 +409,22 @@ class StreamingAggregator:
                 ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
             return ring
 
+    def _flight_poison(self, cause: BaseException, seq: int | None) -> None:
+        """ONE forensic dump per pipeline (idempotent under the lock): the
+        span ring holds the poisoning batch's stage/fold (and per-shard)
+        spans. Worker paths call this AFTER the failing batch's spans have
+        closed — a dump taken inside the open span would miss exactly the
+        spans it exists to capture."""
+        with self._lock:
+            if self._flight_dumped:
+                return
+            self._flight_dumped = True
+        flight_dump(
+            "pipeline-poison",
+            f"batch {seq}: {type(cause).__name__}: {cause}",
+            batch=seq,
+        )
+
     def _poison_error(self) -> StreamingError:
         """The sticky error, always naming the poisoning batch and cause."""
         with self._lock:
@@ -462,10 +486,13 @@ class StreamingAggregator:
             unsafe = isinstance(e, _UnsafeFoldError)
             cause = (e.__cause__ or e) if unsafe else e
             with self._lock:
+                first = self._error is None
                 self._error = cause
                 self._poison_seq = seq
                 if not (unsafe and e.settled):
                     self._in_flight_models -= k
+            if first:
+                self._flight_poison(cause, seq)
             BATCHES_TOTAL.labels(stage="failed").inc()
             raise self._poison_error() from cause
         finally:
@@ -498,6 +525,10 @@ class StreamingAggregator:
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
         self._batch_seq += 1
+        trace.get_tracer().record_span(
+            SPAN_STAGE, start=t0, duration=time.monotonic() - t0,
+            batch=self._batch_seq, kind="planar", k=k,
+        )
         self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
         return ticket
 
@@ -596,6 +627,10 @@ class StreamingAggregator:
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
         self._batch_seq += 1
+        trace.get_tracer().record_span(
+            SPAN_STAGE, start=t0, duration=time.monotonic() - t0,
+            batch=self._batch_seq, kind="planar", k=k,
+        )
         self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
         return ticket
 
@@ -620,6 +655,10 @@ class StreamingAggregator:
             view[:, raw.shape[1] :] = 0  # zero bytes decode to zero elements
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
+        trace.get_tracer().record_span(
+            SPAN_STAGE, start=t0, duration=time.monotonic() - t0,
+            batch=self._batch_seq + 1, kind="wire", k=k,
+        )
         if self._sharded:
             return self._dispatch_sharded_wire(ring, buf, view, k, ticket)
         self._batch_seq += 1
@@ -719,7 +758,8 @@ class StreamingAggregator:
         except BaseException as second:
             # the batch is lost: the accumulator no longer matches any
             # consistent update set — poison permanently, with the batch
-            # index and root cause on every later error
+            # index and root cause on every later error (the caller fires
+            # the flight dump once its span has closed)
             unsafe = isinstance(second, _UnsafeFoldError)
             cause = (second.__cause__ or second) if unsafe else second
             cause.__context__ = first
@@ -740,36 +780,45 @@ class StreamingAggregator:
         buf, payload, kind, k, ticket, seq = item
         agg_t0 = time.monotonic()
         outcome = "folded"
-        try:
+        with trace.get_tracer().span(SPAN_FOLD, batch=seq, kind=kind, k=k) as fold_span:
             try:
-                maybe_fail("streaming.fold")
-                self._fold_payload(payload, kind, k, ticket, defer_ok=True)
-            except BaseException as first:
-                if isinstance(first, _UnsafeFoldError):
-                    # acc may already reference the batch: retrying would
-                    # double-fold it — poison straight away
-                    cause = first.__cause__ or first
-                    with self._lock:
-                        self._error = cause
-                        self._poison_seq = seq
-                        if not first.settled:
-                            self._in_flight_models -= k
-                    outcome = "failed"
-                    logger.exception(
-                        "streaming fold batch %d failed post-dispatch; pipeline poisoned",
-                        seq,
-                    )
-                else:
-                    outcome = self._degrade_and_retry(payload, kind, k, ticket, seq, first)
-        finally:
-            if buf is not None:
-                self._ring("wire" if kind == "wire" else "planar").release(buf)
+                try:
+                    maybe_fail("streaming.fold")
+                    self._fold_payload(payload, kind, k, ticket, defer_ok=True)
+                except BaseException as first:
+                    if isinstance(first, _UnsafeFoldError):
+                        # acc may already reference the batch: retrying would
+                        # double-fold it — poison straight away
+                        cause = first.__cause__ or first
+                        with self._lock:
+                            self._error = cause
+                            self._poison_seq = seq
+                            if not first.settled:
+                                self._in_flight_models -= k
+                        outcome = "failed"
+                        logger.exception(
+                            "streaming fold batch %d failed post-dispatch; pipeline poisoned",
+                            seq,
+                        )
+                    else:
+                        outcome = self._degrade_and_retry(payload, kind, k, ticket, seq, first)
+            finally:
+                if buf is not None:
+                    self._ring("wire" if kind == "wire" else "planar").release(buf)
+                with self._lock:
+                    self._fold_seconds += time.monotonic() - agg_t0
+                INFLIGHT_FOLDS.dec()
+                # a failed fold is NOT folded: dashboards comparing staged vs
+                # folded must be able to see the loss
+                BATCHES_TOTAL.labels(stage=outcome).inc()
+                fold_span.set(outcome=outcome)
+        if outcome == "failed":
+            # the dump fires AFTER the batch's fold span closed, so the
+            # ring it snapshots contains the poisoning batch's spans
             with self._lock:
-                self._fold_seconds += time.monotonic() - agg_t0
-            INFLIGHT_FOLDS.dec()
-            # a failed fold is NOT folded: dashboards comparing staged vs
-            # folded must be able to see the loss
-            BATCHES_TOTAL.labels(stage=outcome).inc()
+                cause, pseq = self._error, self._poison_seq
+            if cause is not None:
+                self._flight_poison(cause, pseq)
 
     # -- drain -------------------------------------------------------------
 
@@ -783,6 +832,10 @@ class StreamingAggregator:
         shard queue drains, every shard's device folds complete, and the
         per-shard accumulators reassemble into the aggregator's global
         ``acc`` before anything reads it."""
+        with trace.get_tracer().span(SPAN_DRAIN, sharded=self._sharded):
+            return self._drain_inner()
+
+    def _drain_inner(self) -> int:
         if self._sharded:
             return self._drain_sharded()
         self._queue.join()
@@ -824,10 +877,13 @@ class StreamingAggregator:
             # like an exhausted worker retry (drop the deferred counts and
             # keep every later drain failing)
             with self._lock:
+                fresh = self._error is None
                 self._error = e
                 self._in_flight_models -= sum(t.k for t in pending)
             for ticket in pending:
                 ticket._ok = None
+            if fresh:
+                self._flight_poison(e, None)
             raise self._poison_error() from e
         if pending:
             # the ONE deferred credit: the accepted count lands and the
@@ -975,6 +1031,9 @@ class StreamingAggregator:
             with self._lock:
                 self._stage_seconds += dt
                 self._shard_stage_seconds[d] += dt
+            trace.get_tracer().record_span(
+                SPAN_STAGE, start=t0, duration=dt, batch=job.seq, shard=d, k=k
+            )
             items.append((job, d, view, ring, buf))
         self._dispatch_sharded(job, items)
         return ticket
@@ -998,6 +1057,9 @@ class StreamingAggregator:
             with self._lock:
                 self._stage_seconds += dt
                 self._shard_stage_seconds[d] += dt
+            trace.get_tracer().record_span(
+                SPAN_STAGE, start=t0, duration=dt, batch=job.seq, shard=d, k=k
+            )
             items.append((job, d, view, ring, buf))
         self._dispatch_sharded(job, items)
         return ticket
@@ -1212,41 +1274,51 @@ class StreamingAggregator:
         t0 = time.monotonic()
         failed = False
         try:
-            with self._lock:
-                poisoned = self._error is not None
-            if poisoned:
-                # the pipeline is already lost: drop the fold (the shards
-                # are inconsistent either way), release resources fast
-                failed = True
-                return
-            try:
-                maybe_fail("streaming.fold")
-                maybe_fail(f"streaming.shard{d}.fold")
-                self._fold_shard_item(job, d, payload)
-            except BaseException as first:
-                if isinstance(first, _UnsafeFoldError):
-                    cause = first.__cause__ or first
-                    self._poison(cause, job.seq)
-                    failed = True
-                    logger.exception(
-                        "streaming shard %d fold of batch %d failed post-dispatch; "
-                        "pipeline poisoned",
-                        d,
-                        job.seq,
-                    )
-                else:
-                    failed = not self._retry_shard(job, d, payload, first)
+            with trace.get_tracer().span(
+                SPAN_FOLD, batch=job.seq, shard=d, kind=job.kind, k=job.k
+            ) as fold_span:
+                try:
+                    with self._lock:
+                        poisoned = self._error is not None
+                    if poisoned:
+                        # the pipeline is already lost: drop the fold (the
+                        # shards are inconsistent either way), release
+                        # resources fast
+                        failed = True
+                        return
+                    try:
+                        maybe_fail("streaming.fold")
+                        maybe_fail(f"streaming.shard{d}.fold")
+                        self._fold_shard_item(job, d, payload)
+                    except BaseException as first:
+                        if isinstance(first, _UnsafeFoldError):
+                            cause = first.__cause__ or first
+                            self._poison(cause, job.seq)
+                            failed = True
+                            logger.exception(
+                                "streaming shard %d fold of batch %d failed "
+                                "post-dispatch; pipeline poisoned",
+                                d,
+                                job.seq,
+                            )
+                        else:
+                            failed = not self._retry_shard(job, d, payload, first)
+                finally:
+                    if ring is not None:
+                        ring.release(buf)
+                    dt = time.monotonic() - t0
+                    with self._lock:
+                        self._shard_fold_seconds[d] += dt
+                        # D workers run concurrently: credit the global fold
+                        # leg 1/D of each worker's wall so the overlap ratio
+                        # keeps its single-pipeline meaning
+                        self._fold_seconds += dt / self._n_shards
+                    SHARD_INFLIGHT.labels(shard=str(d)).dec()
+                    fold_span.set(outcome="failed" if failed else "folded")
         finally:
-            if ring is not None:
-                ring.release(buf)
-            dt = time.monotonic() - t0
-            with self._lock:
-                self._shard_fold_seconds[d] += dt
-                # D workers run concurrently: credit the global fold leg
-                # 1/D of each worker's wall so the overlap ratio keeps its
-                # single-pipeline meaning
-                self._fold_seconds += dt / self._n_shards
-            SHARD_INFLIGHT.labels(shard=str(d)).dec()
+            # the commit barrier runs AFTER this shard's fold span closed:
+            # when the LAST shard settles a failed batch, every shard span
+            # of the batch is already in the ring the flight dump snapshots
             self._shard_job_done(job, failed)
 
     def _shard_job_done(self, job: _BatchJob, failed: bool) -> None:
@@ -1290,7 +1362,21 @@ class StreamingAggregator:
         failed = job.failed  # lint: guarded-ok: last-shard tail, single owner
         retried = job.retried  # lint: guarded-ok: last-shard tail, single owner
         outcome = "failed" if failed else ("folded-degraded" if retried else "folded")
+        # the commit barrier as a zero-width marker span: WHEN the batch
+        # settled its accounting, and how (the last shard records it)
+        trace.get_tracer().record_span(
+            SPAN_COMMIT,
+            start=time.monotonic(),
+            duration=0.0,
+            batch=job.seq,
+            outcome=outcome,
+        )
         BATCHES_TOTAL.labels(stage=outcome).inc()
+        if failed:
+            with self._lock:
+                cause, pseq = self._error, self._poison_seq
+            if cause is not None:
+                self._flight_poison(cause, pseq)
 
     def _fold_pinned_stack(self, plan, stacked, k: int) -> None:
         """Fold ONE batch-sharding-pinned device batch through the shard
@@ -1377,10 +1463,13 @@ class StreamingAggregator:
                 plan.block_until_ready()
         except Exception as e:
             with self._lock:
+                fresh = self._error is None
                 self._error = e
                 self._in_flight_models -= sum(t.k for t in pending)
             for ticket in pending:
                 ticket._ok = None
+            if fresh:
+                self._flight_poison(e, None)
             raise self._poison_error() from e
         if pending:
             with self._lock:

@@ -105,6 +105,18 @@ class CircuitBreaker:
         BREAKER_STATE.labels(component=self.component).set(_STATE_VALUE[state])
         BREAKER_TRANSITIONS.labels(component=self.component, to=state).inc()
         logger.warning("breaker %s -> %s", self.component, state)
+        if state == OPEN:
+            # forensic bundle: which calls burned the failure budget is in
+            # the span ring / metric deltas (rate-limited + fail-soft, so
+            # the write never extends the outage it documents)
+            from ..telemetry.recorder import flight_dump
+
+            flight_dump(
+                "breaker-open",
+                f"component {self.component} opened after "
+                f"{self._failures} consecutive failures",
+                component=self.component,
+            )
 
     def _maybe_half_open_locked(self) -> None:
         if self._state == OPEN and self._clock() - self._opened_at >= self.reset_timeout_s:

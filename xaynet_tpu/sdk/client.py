@@ -29,6 +29,7 @@ import numpy as np
 
 from ..core.common import RoundParameters, UpdateSeedDict
 from ..resilience.policy import RetryPolicy
+from ..telemetry import tracing as trace
 from ..telemetry.registry import get_registry
 from .traits import XaynetClient
 
@@ -39,6 +40,22 @@ CLIENT_DROPS = _registry.counter(
     "xaynet_sdk_client_injected_drops_total",
     "SDK sends silently dropped by the installed fault plan (sdk.drop).",
 )
+
+# one span name per endpoint (closed set — the DESIGN §16 table row), plus
+# the per-attempt child span the retry loop emits
+SPAN_PARAMS = trace.declare_span("sdk.params")
+SPAN_SUMS = trace.declare_span("sdk.sums")
+SPAN_SEEDS = trace.declare_span("sdk.seeds")
+SPAN_MODEL = trace.declare_span("sdk.model")
+SPAN_SEND = trace.declare_span("sdk.send")
+SPAN_ATTEMPT = trace.declare_span("sdk.attempt")
+_ENDPOINT_SPANS = {
+    "params": SPAN_PARAMS,
+    "sums": SPAN_SUMS,
+    "seeds": SPAN_SEEDS,
+    "model": SPAN_MODEL,
+    "send": SPAN_SEND,
+}
 
 
 class ClientError(Exception):
@@ -228,6 +245,12 @@ class HttpClient(XaynetClient):
         non-idempotent POST; those surface to the caller's retry policy,
         which understands protocol-level idempotence.
         """
+        ctx = trace.current_ctx()
+        if ctx is not None:
+            # propagate the trace across the wire: the coordinator's REST
+            # request span adopts this id (docs/DESIGN.md §16)
+            headers = dict(headers or {})
+            headers[trace.TRACE_HEADER] = trace.format_header(ctx)
         reused = self._checkout() if self.keep_alive else None
         for attempt in ("reused", "fresh"):
             if reused is not None:
@@ -392,9 +415,24 @@ class ResilientClient(XaynetClient):
       transient fault; ``perm=1`` makes it permanent).
     """
 
+    # endpoint -> span name; subclasses with extra endpoints extend this
+    SPANS = _ENDPOINT_SPANS
+
     def __init__(self, inner: XaynetClient, policy: Optional[RetryPolicy] = None):
         self.inner = inner
         self.policy = policy if policy is not None else default_client_policy()
+        # the round's trace context: set from the round seed by the SDK
+        # state machine (or the edge sync loop), so every tier derives the
+        # SAME trace id for one round; None = each call starts a fresh
+        # trace (this client GENERATES ids either way)
+        self.trace_ctx: Optional[trace.TraceContext] = None
+
+    def set_round_trace(self, round_seed: Optional[bytes]) -> None:
+        """Pin this client's calls to the round's deterministic trace."""
+        if round_seed is None:
+            self.trace_ctx = None
+        else:
+            self.trace_ctx = trace.TraceContext(trace.round_trace_id(round_seed))
 
     def close(self) -> None:
         """Release the wrapped transport's pooled connections (if any)."""
@@ -406,12 +444,39 @@ class ResilientClient(XaynetClient):
         # the shared policy loop carries the per-site retry/giveup/backoff
         # metrics (xaynet_resilience_*_total{site="sdk.<endpoint>"}); the
         # server-sent Retry-After floors the drawn delay via the hook
-        return await self.policy.call_async(
-            fn,
-            *args,
-            site=f"sdk.{endpoint}",
-            delay_floor=lambda err: getattr(err, "retry_after", None),
-        )
+        name = self.SPANS.get(endpoint)
+        tracer = trace.get_tracer()
+        if name is None or tracer.mode == "off":
+            return await self.policy.call_async(
+                fn,
+                *args,
+                site=f"sdk.{endpoint}",
+                delay_floor=lambda err: getattr(err, "retry_after", None),
+            )
+        # one logical-call span; every retry attempt is a CHILD span whose
+        # context rides the wire (X-Xaynet-Trace carries the attempt id, so
+        # the server can tell which attempt it served)
+        attempts = 0
+
+        async def one_attempt(*call_args):
+            nonlocal attempts
+            attempts += 1
+            with tracer.span(SPAN_ATTEMPT, attempt=attempts):
+                return await fn(*call_args)
+
+        ctx = self.trace_ctx
+        if ctx is None and trace.current_ctx() is None:
+            ctx = trace.TraceContext(trace.new_id())
+        with tracer.span(name, ctx=ctx) as span:
+            try:
+                return await self.policy.call_async(
+                    one_attempt,
+                    *args,
+                    site=f"sdk.{endpoint}",
+                    delay_floor=lambda err: getattr(err, "retry_after", None),
+                )
+            finally:
+                span.set(attempts=attempts)
 
     async def get_round_params(self) -> RoundParameters:
         return await self._call("params", self.inner.get_round_params)

@@ -230,6 +230,12 @@ class StateMachine:
             self._reset_round_state()
             self.phase = PhaseKind.NEW_ROUND
             self.notify.new_round()
+            # pin the client's spans to the round's deterministic trace id
+            # (derived from the public seed) so the participant's uploads
+            # stitch into the coordinator's round trace (DESIGN §16)
+            set_round_trace = getattr(self.client, "set_round_trace", None)
+            if set_round_trace is not None:
+                set_round_trace(fresh.seed.as_bytes())
 
         if self._pending is not None:
             return await self._drain_sends()

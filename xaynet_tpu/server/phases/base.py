@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Optional
 
 from ...storage.traits import Store
+from ...telemetry import tracing as trace
+from ...telemetry.recorder import flight_dump
 from ...telemetry.registry import get_registry
 from ...utils import tracing
 from ..events import EventPublisher, PhaseName
@@ -58,6 +60,20 @@ PHASE_OUTCOMES = get_registry().counter(
     "(full | degraded | timeout).",
     ("phase", "outcome"),
 )
+
+# one span name per phase — spelled out (not built in a loop) so the
+# analysis `span` pass can cross-check the literal set against the DESIGN
+# §16 span table exactly like the metrics table
+_PHASE_SPANS: dict[str, str] = {
+    "idle": trace.declare_span("phase.idle"),
+    "sum": trace.declare_span("phase.sum"),
+    "update": trace.declare_span("phase.update"),
+    "sum2": trace.declare_span("phase.sum2"),
+    "unmask": trace.declare_span("phase.unmask"),
+    "failure": trace.declare_span("phase.failure"),
+    "shutdown": trace.declare_span("phase.shutdown"),
+}
+SPAN_PARTIAL = trace.declare_span("edge.upstream_fold")
 
 
 class PhaseError(Exception):
@@ -217,15 +233,28 @@ class PhaseState:
     async def run_phase(self) -> Optional["PhaseState"]:
         self._announce()
         t0 = time_mod.monotonic()
-        try:
-            await self.process()
-            await self.purge_outdated_requests()
-        except (PhaseError, ChannelClosed) as err:
-            self._record_duration(t0)
-            return await self._into_failure(err)
-        except Exception as err:  # storage or internal errors
-            self._record_duration(t0)
-            return await self._into_failure(PhaseError(type(err).__name__, str(err)))
+        # the phase span brackets exactly what phase_duration measures
+        # (process + purge), so tools/trace_report.py can cross-check the
+        # trace against the round report's phase walls. Idle straddles the
+        # round boundary (it COMPUTES the seed the new round's trace id
+        # derives from), so its span is a fresh root — parenting it to the
+        # previous round's root would leave an orphan in the new round's
+        # export.
+        idle_ctx = (
+            trace.TraceContext(trace.new_id()) if self.NAME is PhaseName.IDLE else None
+        )
+        with trace.get_tracer().span(
+            _PHASE_SPANS[self.NAME.value], ctx=idle_ctx, round_id=self.shared.round_id
+        ):
+            try:
+                await self.process()
+                await self.purge_outdated_requests()
+            except (PhaseError, ChannelClosed) as err:
+                self._record_duration(t0)
+                return await self._into_failure(err)
+            except Exception as err:  # storage or internal errors
+                self._record_duration(t0)
+                return await self._into_failure(PhaseError(type(err).__name__, str(err)))
         self._record_duration(t0)
         self.broadcast()
         return await self.next()
@@ -318,6 +347,19 @@ class PhaseState:
 
     def _record_window_outcome(self, counter: _Counter, outcome: str, t0: float) -> None:
         PHASE_OUTCOMES.labels(phase=self.NAME.value, outcome=outcome).inc()
+        if outcome in ("degraded", "timeout"):
+            # forensic bundle: the span ring holds what led up to the
+            # degraded close / below-quorum timeout (recent request, ingest
+            # and fold spans), the deltas show which counters moved
+            flight_dump(
+                "degraded-close" if outcome == "degraded" else "phase-timeout",
+                f"round {self.shared.round_id} {self.NAME.value}: "
+                f"{counter.accepted} accepted (min {counter.min}, quorum "
+                f"{counter.quorum}), {counter.rejected} rejected, "
+                f"{counter.discarded} discarded",
+                phase=self.NAME.value,
+                round_id=self.shared.round_id,
+            )
         if self.shared.round_ctl is not None:
             self.shared.round_ctl.observe_phase(
                 self.NAME.value,
@@ -469,6 +511,11 @@ class PhaseState:
         try:
             with tracing.use_request_id(env.request_id), tracing.span(
                 "handle_partial", phase=self.NAME.value
+            ), trace.get_tracer().span(
+                SPAN_PARTIAL,
+                link=trace.parse_header(getattr(env.request, "trace", None)),
+                edge_id=getattr(env.request, "edge_id", ""),
+                members=k,
             ):
                 await self.handle_partial(
                     env.request, counter.max - counter.accepted

@@ -16,6 +16,7 @@ from ...core.common import RoundSeed
 from ...core.crypto.encrypt import EncryptKeyPair
 from ...core.crypto.hash import sha256
 from ...core.crypto.sign import SigningKeyPair
+from ...telemetry import tracing as trace
 from ..events import DictionaryUpdate, PhaseName
 from .base import PhaseState, Shared
 
@@ -42,6 +43,15 @@ class Idle(PhaseState):
         self._gen_round_keypair()
         self._update_round_probabilities()
         self._update_round_seed()
+        # the round's trace window opens HERE, the moment the new seed
+        # exists: the trace id derives from it, so the SDK and the edge tier
+        # compute the identical id from the broadcast parameters and the
+        # whole distributed round stitches into one trace (DESIGN §16). The
+        # previous round's trace flushes (Chrome export) as a side effect.
+        trace.get_tracer().begin_round(
+            self.shared.round_id,
+            trace.round_trace_id(self.shared.state.round_params.seed.as_bytes()),
+        )
         await self.shared.store.coordinator.set_coordinator_state(self.shared.state.to_bytes())
 
     def broadcast(self) -> None:

@@ -118,6 +118,10 @@ class PartialAggregate:
     members: list[bytes]  # update participant pks, envelope order
     seed_dicts: dict[bytes, LocalSeedDict]  # update pk -> local seed dict
     masked: MaskObject  # modular sum of the members' masked models
+    # the shipping edge's trace context ("trace_id-span_id", the envelope's
+    # `trace` header field): the update phase's fold span adopts the trace
+    # id so a two-tier round stitches into ONE trace (docs/DESIGN.md §16)
+    trace: Optional[str] = None
 
     def __len__(self) -> int:
         return len(self.members)

@@ -134,6 +134,17 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         else None
     )
     metrics = BridgedMetrics(sink=init_metrics(settings), reporter=reporter)
+    # distributed round tracing + flight recorder (docs/DESIGN.md §16):
+    # [metrics] trace/trace_dir/flight_dir override the env defaults
+    from ..telemetry import recorder as flight_recorder, tracing as trace
+
+    trace.get_tracer().configure(
+        # empty settings defer to the env defaults the Tracer already read
+        # (XAYNET_TRACE / XAYNET_TRACE_DIR); explicit config wins
+        mode=settings.metrics.trace or None,
+        trace_dir=settings.metrics.trace_dir or None,
+    )
+    flight_recorder.get_recorder().configure(settings.metrics.flight_dir or None)
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()
 
@@ -196,6 +207,8 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         # queued tail — without this the InfluxHttp dispatcher dies with
         # whatever was still batching
         metrics.close()
+        # ... and the in-flight round's trace window (Chrome export)
+        trace.get_tracer().end_round()
         logger.info("coordinator stopped")
 
 

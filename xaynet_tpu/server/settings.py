@@ -163,6 +163,19 @@ class MetricsSettings:
     # `enable`: the in-process telemetry registry is always on — enable/sink
     # only control the external line-protocol export.
     round_report_path: str = ""
+    # distributed round tracing (docs/DESIGN.md §16): "on" records spans
+    # and exports one Chrome-trace JSON per round (when trace_dir is set);
+    # "failure" keeps only the bounded flight-recorder ring (spans exist
+    # for failure forensics, no per-round export); "off" makes spans no-ops.
+    # "" (the default) defers to XAYNET_TRACE (default on) — an explicit
+    # config value overrides the env
+    trace: str = ""
+    # per-round Chrome-trace export directory (empty disables the export;
+    # the ring/flight recorder is unaffected)
+    trace_dir: str = ""
+    # flight-recorder dump directory ("" = XAYNET_FLIGHT_DIR, else the
+    # system temp dir)
+    flight_dir: str = ""
 
 
 @dataclass
@@ -443,6 +456,11 @@ class Settings:
             raise SettingsError("aggregation.wire_ingest requires aggregation.device = true")
         if self.aggregation.shard_threads < 0:
             raise SettingsError("aggregation.shard_threads must be >= 0 (0 = auto split)")
+        if self.metrics.trace not in ("", "on", "failure", "off"):
+            raise SettingsError(
+                "metrics.trace must be on | failure | off (or omitted to "
+                "defer to XAYNET_TRACE)"
+            )
 
     @classmethod
     def default(cls) -> "Settings":
@@ -572,6 +590,9 @@ class Settings:
                 round_report_path=str(
                     metrics_raw.get("round_report_path", base.metrics.round_report_path)
                 ),
+                trace=str(metrics_raw.get("trace", base.metrics.trace)),
+                trace_dir=str(metrics_raw.get("trace_dir", base.metrics.trace_dir)),
+                flight_dir=str(metrics_raw.get("flight_dir", base.metrics.flight_dir)),
             ),
             log=LoggingSettings(filter=str(log_raw.get("filter", base.log.filter))),
             aggregation=AggregationSettings(

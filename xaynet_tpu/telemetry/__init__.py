@@ -6,10 +6,15 @@
   hot path (``XAYNET_KERNEL_PROFILE=0`` disables the sync points);
 - ``report``    — per-round JSON report emitter (JSONL artifact);
 - ``bridge``    — the reference eight-measurement recorder surface on top
-  of the registry, forwarding to the legacy Jsonl/Influx sinks.
+  of the registry, forwarding to the legacy Jsonl/Influx sinks;
+- ``tracing``   — the distributed round-tracing span layer (trace ids,
+  bounded buffers, Chrome-trace export — docs/DESIGN.md §16);
+- ``recorder``  — the flight recorder dumping span ring + registry deltas
+  on failure triggers.
 """
 
 from .bridge import BridgedMetrics as BridgedMetrics
+from .recorder import FlightRecorder as FlightRecorder, flight_dump as flight_dump
 from .registry import (
     DEFAULT_BUCKETS as DEFAULT_BUCKETS,
     MetricError as MetricError,
@@ -17,3 +22,11 @@ from .registry import (
     get_registry as get_registry,
 )
 from .report import RoundReporter as RoundReporter
+from .tracing import (
+    TraceContext as TraceContext,
+    Tracer as Tracer,
+    declare_span as declare_span,
+    get_tracer as get_tracer,
+    round_trace_id as round_trace_id,
+    to_chrome_trace as to_chrome_trace,
+)

@@ -24,6 +24,33 @@ from . import profiling
 
 logger = logging.getLogger("xaynet.telemetry")
 
+# mask-kernel auto-calibration verdicts since the last report flush
+# (ops.masking_jax records; the round report drains). Module-level like the
+# profiling round window: verdicts are process-wide facts, and attributing
+# them to the round whose report drains them is exactly the audit trail the
+# headline needs (a verdict flip shows up in THAT round's report).
+_calib_lock = threading.Lock()
+_mask_calibrations: list[dict] = []
+
+# bound: verdicts are one-per-(backend, shape, mesh) and memoized, so a
+# handful per process is normal; a runaway recording bug must not grow the
+# report without limit
+_MAX_CALIBRATIONS = 64
+
+
+def record_mask_calibration(entry: dict) -> None:
+    """Record one auto-calibration verdict (winner + per-candidate probe
+    walls) for the next round report."""
+    with _calib_lock:
+        if len(_mask_calibrations) < _MAX_CALIBRATIONS:
+            _mask_calibrations.append(dict(entry))
+
+
+def drain_mask_calibrations() -> list[dict]:
+    with _calib_lock:
+        out, _mask_calibrations[:] = list(_mask_calibrations), []
+    return out
+
 
 def _streaming_snapshot() -> Optional[dict]:
     """Streaming-fold pipeline state for the round report, read from the
@@ -123,6 +150,19 @@ class RoundReporter:
         streaming = _streaming_snapshot()
         if streaming is not None:
             report["streaming"] = streaming
+        calibrations = drain_mask_calibrations()
+        if calibrations:
+            # auto-calibration verdicts that landed during this round:
+            # winner + per-candidate probe walls per (backend, length,
+            # bucket, mesh) — a headline shift caused by a verdict flip is
+            # auditable from the report instead of requiring a re-run
+            report["mask_calibration"] = calibrations
+        from .tracing import get_tracer
+
+        ctx = get_tracer().round_ctx()
+        if ctx is not None:
+            # join key to the per-round Chrome trace / flight dumps
+            report["trace_id"] = ctx.trace_id
         self.last_report = report
         if self.path:
             # a bad report path must never take the coordinator down: the
