@@ -365,6 +365,7 @@ def main() -> None:
     # full stage+fold path. CPU-only: the TPU capture path never holds a
     # host-side wire copy of the stack (per-slice staging, tunnel limits).
     streaming_vs_sync = None
+    bytes_per_fold = None
     if not on_tpu:
         try:
             # the comparison runs at half the headline batch so its extra
@@ -418,6 +419,54 @@ def main() -> None:
                 f"streaming_vs_sync: sync {t_sync:.2f}s vs streaming {t_stream:.2f}s "
                 f"-> {streaming_vs_sync}x (kernel {seq.kernel_used}, k={k_s}, "
                 f"mesh={len(jax.devices())})",
+                file=sys.stderr,
+            )
+            # --- bytes moved per fold: packed vs unpacked staging ---------
+            # The packed-reduction exit metric (ROADMAP item 3): drive the
+            # SAME wire batch through the production streaming pipeline with
+            # packed staging on and off, and read the telemetry byte
+            # counters (staging copies + cross-shard combine traffic) the
+            # pipeline itself maintains. Lower is better; bench_gate.py
+            # gates this family with inverted floor logic.
+            from xaynet_tpu.parallel.aggregator import BYTES_REDUCED
+            from xaynet_tpu.parallel.streaming import BYTES_STAGED
+
+            def _bytes_sample():
+                staged = sum(
+                    BYTES_STAGED.labels(layout=lay).value
+                    for lay in ("packed", "unpacked", "wire")
+                )
+                reduced = sum(
+                    BYTES_REDUCED.labels(path=p).value for p in ("scatter", "gather")
+                )
+                return staged + reduced
+
+            bytes_per_fold = {}
+            for packed_mode in (False, True):
+                bagg = ShardedAggregator(config, model_len, kernel=seq.kernel_used)
+                bstream = StreamingAggregator(
+                    bagg, staging_buffers=2, dispatch_ahead=2, max_batch=k_s,
+                    packed=packed_mode,
+                )
+                bstream.submit_batch(wire_stack)
+                bstream.drain()  # warm
+                before = _bytes_sample()
+                for _ in range(b_batches):
+                    bstream.submit_batch(wire_stack)
+                bstream.drain()
+                bagg.snapshot()  # the final model download (gather leg)
+                moved = _bytes_sample() - before
+                bstream.close()
+                bytes_per_fold["packed" if packed_mode else "unpacked"] = int(
+                    moved / b_batches
+                )
+                bytes_per_fold["kernel"] = bagg.kernel_used
+                del bagg, bstream
+            print(
+                f"bytes moved per fold (k={k_s}): "
+                f"unpacked {bytes_per_fold['unpacked']:,} vs packed "
+                f"{bytes_per_fold['packed']:,} "
+                f"({1 - bytes_per_fold['packed'] / max(1, bytes_per_fold['unpacked']):.1%} saved)",
                 file=sys.stderr,
             )
             del wire_stack
@@ -555,6 +604,7 @@ def main() -> None:
                 "native_threads": native_threads,
                 "shard_threads": shard_threads,
                 "streaming_vs_sync": streaming_vs_sync,
+                "bytes_per_fold": bytes_per_fold,
                 "mesh8": mesh8_out,
                 "sim": sim_out,
                 "spread": {
@@ -572,6 +622,45 @@ def main() -> None:
     # canonical @25M run appends — the gate keys on the LATEST record's
     # series, so a scaled smoke run on a small host must not plant a
     # throwaway series as the newest line and de-gate the real one.
+    # both layouts or neither: a failure between the two measurement legs
+    # must not plant an unpaired record as the family's latest line (the
+    # gate keys the gated series on the latest record)
+    if (
+        bytes_per_fold is not None
+        and model_len == 25_000_000
+        and all(lay in bytes_per_fold for lay in ("unpacked", "packed"))
+    ):
+        # the bytes-moved series (staging + cross-shard combine traffic per
+        # fold, from the pipeline's own telemetry counters): packed staging
+        # and its unpacked control are separate metrics of one
+        # lower-is-better family (tools/bench_gate.py inverts the floor
+        # logic for bytes/fold units)
+        try:
+            hist = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+            )
+            with open(hist, "a") as f:
+                for layout in ("unpacked", "packed"):
+                    record = {
+                        "ts": time.time(),
+                        "source": "bench.py:bytes",
+                        "parsed": {
+                            "metric": (
+                                f"bytes moved per fold @25M params ({layout} staging)"
+                            ),
+                            "value": bytes_per_fold[layout],
+                            "unit": "bytes/fold",
+                            "platform": platform,
+                            "kernel": bytes_per_fold.get("kernel"),
+                            "mesh": len(jax.devices()),
+                            "model_len": model_len,
+                            "native_threads": native_threads,
+                            "shard_threads": shard_threads,
+                        },
+                    }
+                    f.write(json.dumps(record) + "\n")
+        except Exception as e:  # history append must never sink the bench
+            print(f"BENCH_HISTORY bytes append failed: {e}", file=sys.stderr)
     if mesh8_out is not None and model_len == 25_000_000:
         mesh8_metric = (
             f"masked-update aggregation throughput @25M params, "

@@ -64,7 +64,7 @@ def synthetic_cifar(seed: int, n: int = 128, image_size: int = 32):
     return x, y
 
 
-def start_coordinator(model_len: int, n_sum: int, n_update: int):
+def start_coordinator(model_len: int, n_sum: int, n_update: int, quant: int = 0):
     settings = Settings(
         pet=PetSettings(
             sum=PhaseSettings(prob=0.2, count=CountSettings(n_sum, n_sum), time=TimeSettings(0, 300)),
@@ -73,6 +73,10 @@ def start_coordinator(model_len: int, n_sum: int, n_update: int):
         )
     )
     settings.model.length = model_len
+    # pre-mask quantization (docs/DESIGN.md §17): a coarser fixed-point
+    # config — smaller group order, fewer limbs, proportionally cheaper
+    # masks/folds/transfers. Participants follow via the round params.
+    settings.mask.quant = quant
     info, started = {}, threading.Event()
 
     def run():
@@ -101,6 +105,12 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3, help="local SGD learning rate")
     ap.add_argument("--check-loss", action="store_true",
                     help="exit nonzero unless the final global model beats the init loss")
+    ap.add_argument("--quant", type=int, default=0,
+                    help="pre-mask quantization level (0 = exact catalogue "
+                    "config; level q divides the fixed-point scale by 10^q "
+                    "and shrinks the group order/limb count). The "
+                    "--check-loss gate is the accuracy gate for quantized "
+                    "rounds: federation must still beat the init loss.")
     args = ap.parse_args()
 
     image_shape = (args.image_size, args.image_size, 3)
@@ -109,7 +119,7 @@ def main():
     n_sum, n_update = 2, max(3, args.participants - 2)
     print(f"LeNet: {model_len} parameters; {n_sum} sum + {n_update} update per round")
 
-    url = start_coordinator(model_len, n_sum, n_update)
+    url = start_coordinator(model_len, n_sum, n_update, quant=args.quant)
     probe = HttpClient(url)
 
     def sync(coro):

@@ -587,7 +587,148 @@ void fold_u64_core(const uint32_t* acc, const uint32_t* stack, uint32_t* out, ui
       n_threads);
 }
 
+// Packed-byte-planar leg of the single-pass u64 fold: the staged batch is
+// uint8[K, bpn, n] byte-planes (ops/limbs.py pack_planar — byte-plane b
+// holds byte b of every element), so one element slice reads bpn
+// unit-stride byte streams instead of n_limbs u32 streams: bpn/(4*L) of
+// the batch traffic (6/8 for the standard 2-limb f32 configs). Arithmetic
+// and headroom requirements match fold_u64_slice exactly; acc/out stay
+// planar uint32[L, *].
+void fold_packed_u64_slice(const uint32_t* acc, const uint8_t* packed, uint32_t* out,
+                           uint64_t acc_stride, uint64_t packed_row_stride,
+                           uint64_t packed_batch_stride, uint32_t n_limbs, uint32_t bpn,
+                           uint64_t k, uint64_t order, uint64_t s0, uint64_t s1) {
+  const bool pow2_boundary = order == 0;
+  const bool two_limbs = n_limbs == 2;
+  const double inv_order = pow2_boundary ? 0.0 : 1.0 / (double)order;
+  constexpr uint64_t BLOCK = 4096;
+  uint64_t sum[BLOCK];
+  for (uint64_t s = s0; s < s1; s += BLOCK) {
+    const uint64_t bn = (s1 - s) < BLOCK ? (s1 - s) : BLOCK;
+    if (two_limbs) {
+      const uint32_t* alo = acc + s;
+      const uint32_t* ahi = acc + acc_stride + s;
+      for (uint64_t i = 0; i < bn; i++)
+        sum[i] = (uint64_t)alo[i] | ((uint64_t)ahi[i] << 32);
+    } else {
+      for (uint64_t i = 0; i < bn; i++) sum[i] = acc[s + i];
+    }
+    for (uint64_t kk = 0; kk < k; kk++) {
+      const uint8_t* base = packed + kk * packed_batch_stride + s;
+      // unit-stride byte planes, low to high: the shifted adds vectorize
+      // per plane and the u64 partials stay in L1 across planes
+      for (uint32_t b = 0; b < bpn; b++) {
+        const uint8_t* plane = base + (uint64_t)b * packed_row_stride;
+        const uint32_t shift = 8u * b;
+        for (uint64_t i = 0; i < bn; i++) sum[i] += (uint64_t)plane[i] << shift;
+      }
+    }
+    if (!pow2_boundary) {
+      for (uint64_t i = 0; i < bn; i++) {
+        const uint64_t q = (uint64_t)((double)sum[i] * inv_order);
+        uint64_t r = sum[i] - q * order;
+        r += (r >> 63) ? order : 0;
+        r -= (r >= order) ? order : 0;
+        sum[i] = r;
+      }
+    } else if (!two_limbs) {
+      for (uint64_t i = 0; i < bn; i++) sum[i] &= 0xFFFFFFFFull;
+    }
+    if (two_limbs) {
+      uint32_t* olo = out + s;
+      uint32_t* ohi = out + acc_stride + s;
+      for (uint64_t i = 0; i < bn; i++) {
+        olo[i] = (uint32_t)sum[i];
+        ohi[i] = (uint32_t)(sum[i] >> 32);
+      }
+    } else {
+      for (uint64_t i = 0; i < bn; i++) out[s + i] = (uint32_t)sum[i];
+    }
+  }
+}
+
 }  // namespace
+
+// Strided single-pass fold of a PACKED byte-planar uint8[K, bpn, n] batch
+// into the planar uint32[L, *] accumulator slice (ABI 8; the packed twin of
+// xn_fold_planar_u64_strided). Pointers are pre-offset to the slice start;
+// `acc_stride` is in uint32 elements, `packed_row_stride` (between byte
+// planes) and `packed_batch_stride` (between updates) in bytes.
+// Requirements: bpn <= 8, n_limbs <= 2, every element < order, and
+// (K+1) * order < 2^64 for non-pow2 orders (all-zero order_limbs = the
+// 2^(32L) boundary, natural wraparound for any K).
+XN_EXPORT void xn_fold_packed_u64_strided(const uint32_t* acc, const uint8_t* packed,
+                                          uint32_t* out, uint64_t width, uint64_t acc_stride,
+                                          uint64_t packed_row_stride,
+                                          uint64_t packed_batch_stride, uint32_t n_limbs,
+                                          uint32_t bpn, uint64_t k,
+                                          const uint32_t* order_limbs, uint32_t n_threads) {
+  uint64_t order = 0;
+  for (uint32_t j = 0; j < n_limbs; j++) order |= (uint64_t)order_limbs[j] << (32 * j);
+  run_sliced(
+      width, 4096,
+      [=](uint64_t s0, uint64_t s1) {
+        fold_packed_u64_slice(acc, packed, out, acc_stride, packed_row_stride,
+                              packed_batch_stride, n_limbs, bpn, k, order, s0, s1);
+      },
+      n_threads);
+}
+
+// Pack wire-layout uint32 elements into byte-planar planes (ABI 8; the
+// staging-ring pack of ops/limbs.py). `wire` points at n elements of
+// n_limbs little-endian u32 limbs each (stride n_limbs — callers pass a
+// pre-offset pointer to address a column slice of a larger batch); byte
+// plane b of the output receives byte b of every element at
+// out + b * out_plane_stride. Plane-major loops keep every write
+// unit-stride; numpy's byte-granularity gather for the same copy measures
+// ~3x a planar transpose, this kernel ~memcpy speed. `n_threads` > 0 pins
+// the worker count (the producer thread packs 8 shard slices per batch).
+XN_EXPORT void xn_pack_wire_planes(const uint32_t* wire, uint64_t n, uint32_t n_limbs,
+                                   uint32_t bpn, uint8_t* out, uint64_t out_plane_stride,
+                                   uint32_t n_threads) {
+  run_sliced(
+      n, 4096,
+      [=](uint64_t s0, uint64_t s1) {
+        // i-blocked like the fold kernels: the first byte-plane's pass
+        // warms the element block into L1, the remaining bpn-1 passes hit
+        // cache instead of re-streaming DRAM
+        constexpr uint64_t BLOCK = 4096;
+        for (uint64_t s = s0; s < s1; s += BLOCK) {
+          const uint64_t bn = (s1 - s) < BLOCK ? (s1 - s) : BLOCK;
+          for (uint32_t b = 0; b < bpn; b++) {
+            const uint32_t* src = wire + s * n_limbs + (b / 4);
+            const uint32_t sh = 8u * (b % 4);
+            uint8_t* dst = out + (uint64_t)b * out_plane_stride + s;
+            for (uint64_t i = 0; i < bn; i++)
+              dst[i] = (uint8_t)(src[i * n_limbs] >> sh);
+          }
+        }
+      },
+      n_threads);
+}
+
+// Planar twin: pack planar uint32[L, n] limb planes (plane stride
+// `in_plane_stride` elements) into byte planes — unit-stride reads AND
+// writes (the host planar-row staging path).
+XN_EXPORT void xn_pack_planar_planes(const uint32_t* planar, uint64_t n,
+                                     uint64_t in_plane_stride, uint32_t bpn, uint8_t* out,
+                                     uint64_t out_plane_stride, uint32_t n_threads) {
+  run_sliced(
+      n, 4096,
+      [=](uint64_t s0, uint64_t s1) {
+        constexpr uint64_t BLOCK = 4096;
+        for (uint64_t s = s0; s < s1; s += BLOCK) {
+          const uint64_t bn = (s1 - s) < BLOCK ? (s1 - s) : BLOCK;
+          for (uint32_t b = 0; b < bpn; b++) {
+            const uint32_t* src = planar + (uint64_t)(b / 4) * in_plane_stride + s;
+            const uint32_t sh = 8u * (b % 4);
+            uint8_t* dst = out + (uint64_t)b * out_plane_stride + s;
+            for (uint64_t i = 0; i < bn; i++) dst[i] = (uint8_t)(src[i] >> sh);
+          }
+        }
+      },
+      n_threads);
+}
 
 // Single-pass batch fold for orders that fit in 64 bits (n_limbs <= 2 —
 // every f32/i32 B0-B6 config): fold K planar uint32[L, n] updates plus the
@@ -860,7 +1001,7 @@ XN_EXPORT uint64_t xn_count_ge(const uint32_t* limbs, uint64_t count, uint32_t n
   return bad;
 }
 
-XN_EXPORT uint32_t xn_abi_version(void) { return 7; }
+XN_EXPORT uint32_t xn_abi_version(void) { return 8; }
 
 // Fixed-point decode: out[i] = ((value_i - C) ) * inv, computed in
 // double-double, where value_i is the unmasked group element (wire-layout

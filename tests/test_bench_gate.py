@@ -258,3 +258,65 @@ def test_unknown_metric_prefix_without_unit_is_an_error(tmp_path):
         _run(path, "--metric-prefix", "long-haul soak")
     assert exc.value.code == 2  # argparse usage error
     assert _run(path, "--metric-prefix", "long-haul soak", "--unit", "rounds/s") == 0
+
+
+# --- bytes-moved family: lower is better (round 13, packed reduction) -------
+
+BYTES_METRIC = "bytes moved per fold @25M params (packed staging)"
+
+
+def test_bytes_family_lower_is_better_pass_and_fail(tmp_path, capsys):
+    # moving FEWER bytes than the best prior round is an improvement
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 1000.0, metric=BYTES_METRIC, unit="bytes/fold"),
+            _rec(2, 800.0, metric=BYTES_METRIC, unit="bytes/fold"),
+        ],
+    )
+    assert _run(path, "--metric-prefix", "bytes moved per fold") == 0
+    # moving MORE than threshold above the best (smallest) prior fails
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 800.0, metric=BYTES_METRIC, unit="bytes/fold"),
+            _rec(2, 1000.0, metric=BYTES_METRIC, unit="bytes/fold"),
+        ],
+    )
+    assert _run(path, "--metric-prefix", "bytes moved per fold") == 1
+    out = capsys.readouterr()
+    assert "lower-is-better" in out.out
+
+
+def test_bytes_family_within_threshold_passes(tmp_path):
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 1000.0, metric=BYTES_METRIC, unit="bytes/fold"),
+            _rec(2, 1050.0, metric=BYTES_METRIC, unit="bytes/fold"),
+        ],
+    )
+    assert _run(path, "--metric-prefix", "bytes moved per fold") == 0
+
+
+def test_bytes_family_unit_inferred_and_gated_by_default(tmp_path):
+    # unit inference for the new family (no --unit needed)
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 500.0, metric=BYTES_METRIC, unit="bytes/fold"),
+            _rec(2, 499.0, metric=BYTES_METRIC, unit="bytes/fold"),
+        ],
+    )
+    assert _run(path, "--metric-prefix", "bytes moved per fold @25M params") == 0
+    # and the default (no-prefix) run gates the family alongside the others
+    path = _write(
+        tmp_path,
+        [
+            _rec(1, 20.0),
+            _rec(2, 21.0),
+            _rec(3, 500.0, metric=BYTES_METRIC, unit="bytes/fold"),
+            _rec(4, 900.0, metric=BYTES_METRIC, unit="bytes/fold"),
+        ],
+    )
+    assert _run(path) == 1

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -41,6 +43,31 @@ def test_cifar_lenet_example_smoke():
             "--epochs", "3",
             "--lr", "0.01",
             "--check-loss",
+        ]
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    assert "eval loss" in r.stdout
+
+
+@pytest.mark.slow
+def test_cifar_lenet_quantized_round_accuracy_gate():
+    """The pre-mask quantization accuracy gate (docs/DESIGN.md §17): a
+    quantized round (level 5 — 1-limb prime order, 4-byte wire width)
+    through the REAL coordinator + SDK must still pass the --check-loss
+    gate, the way PR-3 gated byte-identity. Slow-marked (a full 2-round
+    federated example, ~1-4 min on shared cores): CI's unfiltered pytest
+    run covers it; the fast analytic accuracy bound lives in
+    tests/test_packed_codec.py::test_quantized_round_accuracy_bound."""
+    r = _run_example(
+        [
+            "examples/cifar_lenet.py",
+            "--rounds", "2",
+            "--participants", "6",
+            "--image-size", "8",
+            "--epochs", "3",
+            "--lr", "0.01",
+            "--check-loss",
+            "--quant", "5",
         ]
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"

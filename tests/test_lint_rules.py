@@ -363,3 +363,69 @@ def test_sim_tree_is_clean_under_roundtrip_rule():
     for path in sorted(target.rglob("*.py")):
         problems.extend(xn_lint.check_file(path))
     assert problems == []
+
+
+# --- width rule (wire/pack width single source of truth, DESIGN §17) -------
+
+
+def test_width_expr_rejected_outside_codec_module(tmp_path, monkeypatch):
+    source = (
+        "def f(order):\n"
+        "    bpn = (order.bit_length() + 7) // 8\n"
+        "    limbs = (bpn + 3) // 4\n"
+        "    return bpn, limbs\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/core/foo.py", source)
+    assert sum("hand-computed wire/pack width" in p for p in problems) == 2
+
+
+def test_width_expr_commuted_addition_still_rejected(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/foo.py",
+        "def f(bits):\n    return (7 + bits) // 8\n",
+    )
+    assert any("hand-computed wire/pack width" in p for p in problems)
+
+
+def test_width_codec_module_and_allowlist_pass(tmp_path, monkeypatch):
+    # the codec module itself is the single source of truth
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/ops/limbs.py",
+        "def wire_width_for(order):\n    return ((order - 1).bit_length() + 7) // 8\n",
+    )
+    assert not any("hand-computed wire/pack width" in p for p in problems)
+    # annotated non-wire byte-length math passes anywhere
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/core/bar.py",
+        "def f(n):\n    return (n.bit_length() + 7) // 8  # lint: width-ok\n",
+    )
+    assert not any("hand-computed wire/pack width" in p for p in problems)
+
+
+def test_width_rule_scoped_to_package_tree(tmp_path, monkeypatch):
+    # tools/tests stay free to compute widths (oracles recompute deliberately)
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "tools/foo.py",
+        "def f(order):\n    return (order.bit_length() + 7) // 8\n",
+    )
+    assert not any("hand-computed wire/pack width" in p for p in problems)
+
+
+def test_width_unrelated_floordivs_pass(tmp_path, monkeypatch):
+    source = (
+        "def f(x):\n"
+        "    a = (x + 1) // 8\n"
+        "    b = (x + 7) // 16\n"
+        "    c = (x.bit_length() + 31) // 32\n"
+        "    return a + b + c\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/core/baz.py", source)
+    assert not any("hand-computed wire/pack width" in p for p in problems)

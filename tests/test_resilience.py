@@ -795,6 +795,7 @@ def test_streaming_poisoning_names_batch_and_cause():
         raise RuntimeError("fold died (stand-in)")
 
     agg._fold_fn = boom  # both the streaming fold AND the sync retry die
+    agg._packed_fold_fn = boom  # packed staging is the default layout
     stream.submit_batch(np.stack(stacks[bs : 2 * bs]))
     from xaynet_tpu.parallel.streaming import StreamingError
 

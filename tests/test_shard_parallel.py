@@ -216,6 +216,7 @@ def test_shard_failure_degrades_then_completes_byte_identical():
     agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
     stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
     real_fold = ShardPlan.fold_shard
+    real_fold_packed = ShardPlan.fold_shard_packed
     state = {"failed": False}
 
     def flaky(self, d, batch):
@@ -224,13 +225,21 @@ def test_shard_failure_degrades_then_completes_byte_identical():
             raise RuntimeError("transient shard fault")
         return real_fold(self, d, batch)
 
+    def flaky_packed(self, d, batch):
+        if d == 3 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient shard fault")
+        return real_fold_packed(self, d, batch)
+
     try:
         ShardPlan.fold_shard = flaky
+        ShardPlan.fold_shard_packed = flaky_packed
         for i in range(0, total, bs):
             stream.submit_batch(np.stack(stacks[i : i + bs]))
         stream.drain()
     finally:
         ShardPlan.fold_shard = real_fold
+        ShardPlan.fold_shard_packed = real_fold_packed
 
     assert stream.degraded
     assert np.array_equal(agg.snapshot(), seq.snapshot())
@@ -248,19 +257,27 @@ def test_shard_failure_twice_poisons_with_batch_diagnostics():
     agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
     stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
     real_fold = ShardPlan.fold_shard
+    real_fold_packed = ShardPlan.fold_shard_packed
 
     def always_broken(self, d, batch):
         if d == 5:
             raise RuntimeError("shard 5 is on fire")
         return real_fold(self, d, batch)
 
+    def always_broken_packed(self, d, batch):
+        if d == 5:
+            raise RuntimeError("shard 5 is on fire")
+        return real_fold_packed(self, d, batch)
+
     try:
         ShardPlan.fold_shard = always_broken
+        ShardPlan.fold_shard_packed = always_broken_packed
         stream.submit_batch(np.stack(stacks[0:3]))
         with pytest.raises(StreamingError, match="batch 1.*shard 5 is on fire"):
             stream.drain()
     finally:
         ShardPlan.fold_shard = real_fold
+        ShardPlan.fold_shard_packed = real_fold_packed
     # sticky: healthy folds cannot resurrect a poisoned pipeline
     with pytest.raises(StreamingError, match="poisoned"):
         stream.submit_batch(np.stack(stacks[3:6]))
@@ -465,6 +482,7 @@ def test_healthz_pipeline_section_degraded_shard():
     agg = ShardedAggregator(CFG, n, mesh=_mesh(8), kernel="xla")
     stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
     real_fold = ShardPlan.fold_shard
+    real_fold_packed = ShardPlan.fold_shard_packed
     state = {"failed": False}
 
     def flaky(self, d, batch):
@@ -473,13 +491,21 @@ def test_healthz_pipeline_section_degraded_shard():
             raise RuntimeError("transient shard fault")
         return real_fold(self, d, batch)
 
+    def flaky_packed(self, d, batch):
+        if d == 2 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient shard fault")
+        return real_fold_packed(self, d, batch)
+
     try:
         ShardPlan.fold_shard = flaky
+        ShardPlan.fold_shard_packed = flaky_packed
         for i in range(0, total, bs):
             stream.submit_batch(np.stack(stacks[i : i + bs]))
         stream.drain()
     finally:
         ShardPlan.fold_shard = real_fold
+        ShardPlan.fold_shard_packed = real_fold_packed
 
     assert stream.degraded  # the sync-retry path fired
     from xaynet_tpu.server.rest import RestServer

@@ -137,7 +137,10 @@ def test_dispatch_ahead_out_of_order_completion_stress():
     # resolve the kernel on the first batch, then wrap the fold with jitter
     stream.submit_batch(np.stack(stacks[0:bs]))
     stream.drain()
-    real_fold = agg._fold_fn
+    # packed staging is the default layout, so the worker folds through
+    # _packed_fold_fn — wrap whichever entry the pipeline actually uses
+    packed = stream._packed
+    real_fold = agg._packed_fold_fn if packed else agg._fold_fn
     jitter = iter(np.random.default_rng(1).uniform(0.0, 0.004, size=total))
     folded_sizes = []
 
@@ -146,7 +149,10 @@ def test_dispatch_ahead_out_of_order_completion_stress():
         folded_sizes.append(int(staged.shape[0]))
         return real_fold(acc, staged)
 
-    agg._fold_fn = slow_fold
+    if packed:
+        agg._packed_fold_fn = slow_fold
+    else:
+        agg._fold_fn = slow_fold
     staged_before = BATCHES_TOTAL.labels(stage="staged").value
     for i in range(bs, total, bs):
         stream.submit_batch(np.stack(stacks[i : i + bs]))
@@ -179,6 +185,7 @@ def test_worker_failure_surfaces_at_drain():
         raise RuntimeError("fold died (stand-in)")
 
     agg._fold_fn = boom
+    agg._packed_fold_fn = boom  # packed staging is the default layout
     stream.submit_batch(np.stack(stacks[bs : 2 * bs]))
     with pytest.raises(StreamingError):
         stream.drain()

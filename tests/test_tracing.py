@@ -333,19 +333,27 @@ def test_streaming_poison_flight_dump_has_poisoning_batch_shard_spans(
     agg = ShardedAggregator(cfg, n, mesh=make_mesh(jax.devices()[:8]), kernel="xla")
     stream = StreamingAggregator(agg, staging_buffers=3, dispatch_ahead=2, max_batch=bs)
     real_fold = ShardPlan.fold_shard
+    real_fold_packed = ShardPlan.fold_shard_packed
 
     def always_broken(self, d, batch):
         if d == 5:
             raise RuntimeError("shard 5 is on fire")
         return real_fold(self, d, batch)
 
+    def always_broken_packed(self, d, batch):
+        if d == 5:
+            raise RuntimeError("shard 5 is on fire")
+        return real_fold_packed(self, d, batch)
+
     try:
         ShardPlan.fold_shard = always_broken
+        ShardPlan.fold_shard_packed = always_broken_packed
         stream.submit_batch(np.stack(stacks[0:3]))
         with pytest.raises(StreamingError, match="poisoned"):
             stream.drain()
     finally:
         ShardPlan.fold_shard = real_fold
+        ShardPlan.fold_shard_packed = real_fold_packed
         stream.close()
 
     dumps = sorted(tmp_path.glob("flight_*_pipeline-poison.json"))

@@ -95,6 +95,12 @@ rule(
     "metric-table drift",
 )
 rule(
+    "width",
+    "hand-computed wire/pack width expression ((x + 7) // 8 or (x + 3) // 4) "
+    "outside the codec module (ops/limbs.py is the single source of truth: "
+    "wire_width_for / draw_width_for / n_limbs_for_bytes)",
+)
+rule(
     "span",
     "tracing span() not used as a context manager, span name declared "
     "twice / undeclared, or code <-> DESIGN.md §16 span-table drift",

@@ -256,6 +256,24 @@ def _is_blocking_sync(node: ast.Call) -> bool:
     return isinstance(func, ast.Name) and func.id in _SYNC_CALLEES
 
 
+def _is_width_expr(node: ast.BinOp) -> bool:
+    """True for the hand-computed width idioms ``(x + 7) // 8`` (bits/bytes
+    -> bytes) and ``(x + 3) // 4`` (bytes -> uint32 limbs) — the two
+    expressions the codec module (``ops/limbs.py``) owns. Purely
+    syntactic, commutative in the addition."""
+    if not isinstance(node.op, ast.FloorDiv):
+        return False
+    if not (isinstance(node.right, ast.Constant) and node.right.value in (4, 8)):
+        return False
+    want = 7 if node.right.value == 8 else 3
+    left = node.left
+    if not (isinstance(left, ast.BinOp) and isinstance(left.op, ast.Add)):
+        return False
+    return (
+        isinstance(left.right, ast.Constant) and left.right.value == want
+    ) or (isinstance(left.left, ast.Constant) and left.left.value == want)
+
+
 def _is_device_put(node: ast.Call) -> bool:
     """True for ``jax.device_put(...)`` / ``device_put(...)`` calls (the
     rule is syntactic, like the queue rule: any spelling that resolves to
@@ -332,6 +350,13 @@ def check_file_info(info: FileInfo) -> list[Finding]:
     no_swallow_tree = rel.startswith(("xaynet_tpu/server", "xaynet_tpu/storage"))
     # SDK tree: raw transports bypass the resilient client wrapper
     sdk_tree = rel.startswith("xaynet_tpu/sdk")
+    # width rule: every wire/pack width must come from the codec module
+    # (ops/limbs.py — wire_width_for / draw_width_for / n_limbs_for_bytes);
+    # a hand-computed copy drifting from the codec is exactly how a packed
+    # plane and its unpack disagree by one byte
+    width_tree = (
+        rel.startswith("xaynet_tpu/") and rel != "xaynet_tpu/ops/limbs.py"
+    )
 
     line_of = info.line
 
@@ -433,6 +458,17 @@ def check_file_info(info: FileInfo) -> list[Finding]:
                     "tree bypasses the partial-aggregate accounting path (fold "
                     "through EdgeAggregator.admit/seal, or annotate the accounting "
                     "path's own fold site with '# lint: fold-ok')",
+                )
+        if width_tree and isinstance(node, ast.BinOp) and _is_width_expr(node):
+            if not suppressed("width", line_of(node.lineno)):
+                add(
+                    "width",
+                    node.lineno,
+                    "hand-computed wire/pack width expression "
+                    "(use ops.limbs.wire_width_for / draw_width_for / "
+                    "n_limbs_for_bytes — the codec module is the single "
+                    "source of truth — or annotate a non-wire byte-length "
+                    "computation with '# lint: width-ok')",
                 )
         if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
             if not suppressed("device-put", line_of(node.lineno)):

@@ -52,12 +52,20 @@ SIM_UNIT = "participants/s"
 SUM2_PREFIX = "e2e sum2 mask throughput"
 UNMASK_PREFIX = "e2e unmask throughput"
 ELEMENTS_UNIT = "elements/s"
+# packed-reduction family (bench.py:bytes): staging + cross-shard combine
+# traffic per fold. LOWER is better — the floor logic inverts (see
+# LOWER_IS_BETTER_UNITS): the gate fails when the latest round MOVES MORE
+# bytes than the best (smallest) prior round tolerates.
+BYTES_PREFIX = "bytes moved per fold"
+BYTES_UNIT = "bytes/fold"
+LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT})
 # families gated independently when no explicit --metric-prefix is given
 DEFAULT_FAMILIES = (
     (HEADLINE_PREFIX, HEADLINE_UNIT),
     (SIM_PREFIX, SIM_UNIT),
     (SUM2_PREFIX, ELEMENTS_UNIT),
     (UNMASK_PREFIX, ELEMENTS_UNIT),
+    (BYTES_PREFIX, BYTES_UNIT),
 )
 
 
@@ -154,8 +162,17 @@ def gate_family(
             )
         return 0
     *prior, (_, _, latest, _) = series
-    best_ts, best_metric, best, _best_cfg = max(prior, key=lambda item: item[2])
-    floor = best * (1.0 - threshold)
+    lower_better = unit in LOWER_IS_BETTER_UNITS
+    if lower_better:
+        # bytes-style family: best prior is the SMALLEST, the gate fails
+        # when the latest moves more than threshold ABOVE it
+        best_ts, best_metric, best, _best_cfg = min(prior, key=lambda item: item[2])
+        floor = best * (1.0 + threshold)
+        regressed = latest > floor
+    else:
+        best_ts, best_metric, best, _best_cfg = max(prior, key=lambda item: item[2])
+        floor = best * (1.0 - threshold)
+        regressed = latest < floor
     verdict = {
         "latest": latest,
         "best_prior": best,
@@ -165,13 +182,16 @@ def gate_family(
         "rounds": len(series),
         "metric": latest_metric,
         "config": latest_config,
+        "direction": "lower-is-better" if lower_better else "higher-is-better",
     }
-    if latest < floor:
+    if regressed:
         verdict["result"] = "REGRESSION"
         print(json.dumps(verdict))
+        pct = abs(1 - latest / best) * 100
+        word = "above" if lower_better else "below"
         print(
             f"bench-gate: FAIL — latest {latest:.2f} {unit} is "
-            f"{(1 - latest / best) * 100:.1f}% below the best prior round "
+            f"{pct:.1f}% {word} the best prior round "
             f"({best:.2f} @ ts {best_ts:.0f}, '{best_metric}'); "
             f"tolerated: {threshold * 100:.0f}%",
             file=sys.stderr,

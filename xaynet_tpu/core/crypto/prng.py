@@ -29,7 +29,7 @@ def generate_integer(stream: ChaChaStream, max_int: int) -> int:
     """Sequential oracle, one draw (reference semantics, python ints)."""
     if max_int == 0:
         return 0
-    nbytes = (max_int.bit_length() + 7) // 8
+    nbytes = limb_ops.draw_width_for(max_int)
     value = max_int
     while value >= max_int:
         value = int.from_bytes(stream.read(nbytes), "little")
@@ -94,8 +94,8 @@ class StreamSampler:
         # sizes the buffer with `max_int.to_bytes_le()`), which exceeds the
         # element width when the order is a power of two at a byte boundary
         # (e.g. 2^88, 2^96 from the catalogue).
-        bpn = (order.bit_length() + 7) // 8
-        cand_limbs = max(1, (bpn + 3) // 4)
+        bpn = limb_ops.draw_width_for(order)
+        cand_limbs = limb_ops.n_limbs_for_bytes(bpn)
         order_cl = limb_ops.int_to_limbs(order, cand_limbs)
         accept_rate = float(Fraction(order, 1 << (8 * bpn)))  # handles huge orders
 
@@ -133,7 +133,7 @@ class StreamSampler:
     def _draw_limbs_native(self, lib, count: int, order: int, out_limbs: int) -> np.ndarray:
         from ...utils import native
 
-        bpn = (order.bit_length() + 7) // 8
+        bpn = limb_ops.draw_width_for(order)
         order_le = order.to_bytes(bpn, "little")
         out = np.empty(count * bpn, dtype=np.uint8)
         new_offset = lib.xn_sample_uniform(

@@ -75,26 +75,104 @@ _BOUND_KEY = {
 _MODEL_KEY = {ModelType.M3: "M3", ModelType.M6: "M6", ModelType.M9: "M9", ModelType.M12: "M12"}
 
 
+def _is_probable_prime(n: int) -> bool:
+    """Deterministic Miller-Rabin for n < 3.3e24 (fixed witness set); the
+    same fixed witnesses above that — a strong-probable-prime test. The
+    protocol property that matters is DETERMINISM (coordinator and every
+    participant compute the identical order from the same config bytes);
+    the witness set is exhaustive for every f32/i32 quantized order."""
+    if n < 2:
+        return False
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _next_prime(n: int) -> int:
+    if n <= 2:
+        return 2
+    c = n | 1  # first odd >= n
+    while not _is_probable_prime(c):
+        c += 2
+    return c
+
+
 @dataclass(frozen=True)
 class MaskConfig:
-    """A masking configuration (hashable, usable as a dict key)."""
+    """A masking configuration (hashable, usable as a dict key).
+
+    ``quant`` is the pre-mask quantization level (docs/DESIGN.md §17):
+    level q divides the fixed-point scale ``exp_shift`` by ``10^q``, which
+    shrinks the derived group order — and with it the limb count, the wire
+    width, the mask derivation cost and every fold/transfer byte —
+    proportionally, at the price of ``10^q`` coarser weights. ``quant = 0``
+    (the default) is the exact catalogue config; quantized orders are
+    DERIVED from the reference's own construction (Integer: the exact
+    range product; Prime: next prime; Power2: next power of two).
+    """
 
     group_type: GroupType
     data_type: DataType
     bound_type: BoundType
     model_type: ModelType
+    quant: int = 0
+
+    def __post_init__(self) -> None:
+        # the scale ceiling (exp_shift would underflow past it) AND the
+        # wire ceiling (quant rides a nibble in to_bytes, so levels > 15
+        # are unannouncable — only BMAX scales are deep enough to hit it)
+        ceiling = min(15, self._exp_shift_pow())
+        if not (0 <= self.quant <= ceiling):
+            raise InvalidMaskConfigError(
+                f"quant must be in [0, {ceiling}] for this "
+                f"data/bound type, got {self.quant}"
+            )
+
+    def _exp_shift_pow(self) -> int:
+        """log10 of the UNQUANTIZED fixed-point scale (the quant ceiling)."""
+        if self.data_type is DataType.F32:
+            return 45 if self.bound_type is BoundType.BMAX else 10
+        if self.data_type is DataType.F64:
+            return 324 if self.bound_type is BoundType.BMAX else 20
+        return 10
 
     @cached_property
     def order(self) -> int:
-        """The finite-group order (protocol constant)."""
-        return ORDERS[
-            (
-                _GROUP_KEY[self.group_type],
-                _DATA_KEY[self.data_type],
-                _BOUND_KEY[self.bound_type],
-                _MODEL_KEY[self.model_type],
-            )
-        ]
+        """The finite-group order (protocol constant; derived for
+        quantized configs)."""
+        if self.quant == 0:
+            return ORDERS[
+                (
+                    _GROUP_KEY[self.group_type],
+                    _DATA_KEY[self.data_type],
+                    _BOUND_KEY[self.bound_type],
+                    _MODEL_KEY[self.model_type],
+                )
+            ]
+        # the reference's order construction (mod.rs:234-635) at the
+        # quantized scale: the group must represent every aggregate of
+        # max_nb_models encoded values in [0, 2 * add_shift * exp_shift]
+        base = 2 * int(self.add_shift) * self.exp_shift * self.max_nb_models + 1
+        if self.group_type is GroupType.INTEGER:
+            return base
+        if self.group_type is GroupType.POWER2:
+            return 1 << (base - 1).bit_length()
+        return _next_prime(base)
 
     @cached_property
     def add_shift(self) -> Fraction:
@@ -118,17 +196,17 @@ class MaskConfig:
 
     @cached_property
     def exp_shift(self) -> int:
-        """Fixed-point scale: weights are quantized to 1/exp_shift steps."""
-        if self.data_type is DataType.F32:
-            return 10**45 if self.bound_type is BoundType.BMAX else 10**10
-        if self.data_type is DataType.F64:
-            return 10**324 if self.bound_type is BoundType.BMAX else 10**20
-        return 10**10
+        """Fixed-point scale: weights are quantized to 1/exp_shift steps
+        (divided by ``10^quant`` for quantized rounds)."""
+        return 10 ** (self._exp_shift_pow() - self.quant)
 
     @cached_property
     def bytes_per_number(self) -> int:
-        """Fixed wire width of one group element."""
-        return ((self.order - 1).bit_length() + 7) // 8
+        """Fixed wire width of one group element (the single source of
+        truth lives in ops/limbs.wire_width_for)."""
+        from ...ops.limbs import wire_width_for
+
+        return wire_width_for(self.order)
 
     @property
     def max_nb_models(self) -> int:
@@ -137,12 +215,20 @@ class MaskConfig:
     # --- wire format -----------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        # the quant level rides the unused high nibble of the model byte
+        # (ModelType values are 3..12): quant = 0 serializes byte-identically
+        # to the reference wire format, so unquantized golden vectors and
+        # old readers are untouched. Levels > 15 are unrepresentable;
+        # __post_init__ enforces the same ceiling at construction, so this
+        # is a defensive invariant, not a reachable path.
+        if self.quant > 15:
+            raise InvalidMaskConfigError("quant > 15 has no wire encoding")
         return struct.pack(
             "BBBB",
             int(self.group_type),
             int(self.data_type),
             int(self.bound_type),
-            int(self.model_type),
+            int(self.model_type) | (self.quant << 4),
         )
 
     @classmethod
@@ -151,7 +237,9 @@ class MaskConfig:
             raise InvalidMaskConfigError("mask config buffer too short")
         g, d, b, m = struct.unpack_from("BBBB", data)
         try:
-            return cls(GroupType(g), DataType(d), BoundType(b), ModelType(m))
+            return cls(
+                GroupType(g), DataType(d), BoundType(b), ModelType(m & 0x0F), m >> 4
+            )
         except ValueError as e:
             raise InvalidMaskConfigError(str(e)) from e
 

@@ -137,7 +137,7 @@ def _decode_native(limbs: np.ndarray, c_int: int, recip: Fraction):
     ):
         return None
     inv_hi, inv_lo = dd.from_fraction(recip)
-    c_le = c_int.to_bytes((c_int.bit_length() + 7) // 8 or 1, "little")
+    c_le = c_int.to_bytes(limb_ops.draw_width_for(c_int) or 1, "little")
     arr = np.ascontiguousarray(limbs, dtype=np.uint32)
     out = np.empty(n, dtype=np.float64)
     import ctypes

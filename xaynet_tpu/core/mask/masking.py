@@ -70,7 +70,7 @@ def _mask_native(seed: bytes, sampler: StreamSampler, weights: np.ndarray,
     if lib is None or not hasattr(lib, "xn_mask_f32"):
         return None
     order = config.order
-    draw_nbytes = (order.bit_length() + 7) // 8
+    draw_nbytes = limb_ops.draw_width_for(order)
     elem_nbytes = config.bytes_per_number
     if draw_nbytes > 16:
         return None

@@ -156,7 +156,7 @@ def parse_mask_vect_stream(reader, lazy: bool = False) -> MaskVect:
         return LazyWireMaskVect(config, raw, count)
     # segmented convert: fixed-size wire segments go straight into the limb
     # tensor, so the transient staging is bounded (never O(payload))
-    n_limb = max(1, (bpn + 3) // 4)
+    n_limb = limb_ops.n_limbs_for_bytes(bpn)
     limbs = np.empty((count, n_limb), dtype=np.uint32)
     seg_elems = max(1, (2 << 20) // max(bpn, 1))
     for s in range(0, count, seg_elems):

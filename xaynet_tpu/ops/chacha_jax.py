@@ -145,7 +145,9 @@ def _words_to_bytes(words: jax.Array) -> jax.Array:
 
 def provision_candidates(count: int, order: int) -> int:
     """Candidates to draw so that P(accepted < count) < ~2^-60."""
-    bpn = (order.bit_length() + 7) // 8
+    from . import limbs as host_limbs
+
+    bpn = host_limbs.draw_width_for(order)
     # int/int true division is correctly rounded at any magnitude
     p = order / (1 << (8 * bpn))
     p = max(min(p, 1.0), 1e-9)
@@ -208,7 +210,9 @@ def _chop_reject_scatter(
     ``csum[i]`` counts acceptances among attempts ``0..i`` (``csum[-1]`` =
     acceptances in this chunk).
     """
-    cand_limbs = max(1, (bpn + 3) // 4)
+    from . import limbs as host_limbs
+
+    cand_limbs = host_limbs.n_limbs_for_bytes(bpn)
     padded = jnp.zeros((n_cand, cand_limbs * 4), dtype=jnp.uint8)
     padded = padded.at[:, :bpn].set(stream.reshape(n_cand, bpn))
     # little-endian bytes -> uint32 limbs
@@ -281,8 +285,8 @@ def _derive_params(
     the single-seed path was designed around."""
     from . import limbs as host_limbs
 
-    bpn = (order.bit_length() + 7) // 8
-    cand_limbs = max(1, (bpn + 3) // 4)
+    bpn = host_limbs.draw_width_for(order)
+    cand_limbs = host_limbs.n_limbs_for_bytes(bpn)
     out_limbs = host_limbs.n_limbs_for_order(order)
     # trace-time limb math on the STATIC order int (a Python argument of
     # the jitted derivation, never a traced value)

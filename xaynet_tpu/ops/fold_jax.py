@@ -154,6 +154,19 @@ def fold_planar_batch(acc, stack_planar, order: int):
     return p_mod_add(acc, value[:n_limb], order)
 
 
+@partial(jax.jit, static_argnames=("n_limbs", "order"), donate_argnums=(0,))
+def fold_packed_batch(acc, packed, n_limbs: int, order: int):
+    """Fold PACKED byte-planar ``uint8[K, bpn, n]`` updates into the planar
+    ``[L, n]`` accumulator: in-graph unpack (``limbs_jax.packed_planar_to_limbs``)
+    fused with the lazy-carry fold in ONE jit, so the 4L-byte planar tensor
+    never crosses host->device — only the ``bpn``-byte packed planes do
+    (the EQuARX insight applied to the staging transfer)."""
+    from .limbs_jax import packed_planar_to_limbs
+
+    planar = packed_planar_to_limbs(packed, n_limbs)
+    return fold_planar_batch(acc, planar, order)
+
+
 def wire_to_planar(stack: np.ndarray) -> np.ndarray:
     """Host: wire-layout ``[K, n, L]`` (or ``[n, L]``) -> planar ``[K, L, n]``."""
     stack = np.asarray(stack, dtype=np.uint32)

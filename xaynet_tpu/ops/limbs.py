@@ -22,14 +22,39 @@ _U64 = np.uint64
 _MASK32 = np.uint64(0xFFFFFFFF)
 
 
+def wire_width_for(order: int) -> int:
+    """THE wire/pack width of one group element, in bytes:
+    ``bytes_per_number = ceil(bits(order - 1) / 8)``.
+
+    This module is the single source of truth for width math — the packed
+    planar codec, the wire serializers, ``MaskConfig.bytes_per_number`` and
+    the device unpack all derive from here, and the ``width`` lint rule
+    (tools/analysis) rejects hand-computed copies of the expression
+    anywhere else under ``xaynet_tpu/``.
+    """
+    return max(1, ((order - 1).bit_length() + 7) // 8)  # lint: width-ok
+
+
+def draw_width_for(order: int) -> int:
+    """The rejection-sampler DRAW width in bytes: the byte length of the
+    order *itself* (the reference sizes its candidate buffer with
+    ``max_int.to_bytes_le()``), which exceeds :func:`wire_width_for` when
+    the order is a power of two at a byte boundary (e.g. 2^88, 2^96)."""
+    return (order.bit_length() + 7) // 8  # lint: width-ok
+
+
+def n_limbs_for_bytes(nbytes: int) -> int:
+    """Byte width -> uint32 limb count (whole limbs)."""
+    return max(1, (nbytes + 3) // 4)  # lint: width-ok
+
+
 def n_limbs_for_order(order: int) -> int:
     """Number of 32-bit limbs for elements of the group of this order.
 
     Matches the wire width: ``bytes_per_number = ceil(bits(order - 1) / 8)``
     rounded up to whole limbs.
     """
-    bpn = ((order - 1).bit_length() + 7) // 8
-    return max(1, (bpn + 3) // 4)
+    return n_limbs_for_bytes(wire_width_for(order))
 
 
 def order_limbs_for(order: int) -> np.ndarray:
@@ -120,7 +145,7 @@ def bytes_le_to_limbs(buf: bytes | np.ndarray, count: int, bytes_per_number: int
     pad/slice path measures ~370 MB/s and parse sits on the coordinator's
     per-update critical path — one 25M-param update is a 150 MB payload).
     """
-    n_limb = max(1, (bytes_per_number + 3) // 4)
+    n_limb = n_limbs_for_bytes(bytes_per_number)
     raw = np.frombuffer(buf, dtype=np.uint8, count=count * bytes_per_number)
     from ..utils import native
 
@@ -145,7 +170,7 @@ def limbs_to_bytes_le(arr: np.ndarray, bytes_per_number: int) -> bytes:
 
     lib = native.load()
     # native codec assumes the wire width and limb count agree (L == ceil(bpn/4))
-    if lib is not None and n > 0 and arr.shape[1] == max(1, (bytes_per_number + 3) // 4):
+    if lib is not None and n > 0 and arr.shape[1] == n_limbs_for_bytes(bytes_per_number):
         out = np.empty(n * bytes_per_number, dtype=np.uint8)
         lib.xn_limbs_to_wire(
             native.np_u32p(arr), n, bytes_per_number, arr.shape[1], native.np_u8p(out)
@@ -413,6 +438,302 @@ def fold_planar_batch_host(
     folded = batch_mod_sum(wire, order_limbs)
     acc_wire = np.ascontiguousarray(acc.T)
     return np.ascontiguousarray(mod_add(acc_wire, folded, order_limbs).T)
+
+
+# ---------------------------------------------------------------------------
+# packed planar codec
+#
+# Masked limb CONTENTS are uniform-random and incompressible, but the
+# REPRESENTATION is not: group orders rarely fill their uint32 limbs, so a
+# planar ``uint32[..., L, n]`` tensor packs losslessly to the wire width
+# ``bpn = wire_width_for(order)`` bytes per element (6 instead of 8 for the
+# standard 2-limb f32 configs — a 25% cut in staged/transferred bytes).
+# The packed layout is BYTE-PLANAR ``uint8[..., bpn, n]``: byte-plane b
+# holds byte b of every element, so pack/unpack are strided plane copies
+# (no per-element gather), the device unpack is the same shift-or chain as
+# the wire unpack but over contiguous planes, and the native packed fold
+# streams bpn unit-stride byte planes exactly like the planar u64 fold
+# streams its limb planes. Lossless iff every element < 2^(8*bpn) — true
+# for every validated group element (element < order <= 2^(8*bpn)).
+# ---------------------------------------------------------------------------
+
+
+def pack_planar(planar: np.ndarray, bpn: int, out: np.ndarray | None = None) -> np.ndarray:
+    """Planar ``uint32[..., L, n]`` -> packed byte-planar ``uint8[..., bpn, n]``.
+
+    ``out`` optionally receives the result (the streaming pipeline packs
+    straight into its ring buffers). Elements must be < 2^(8*bpn) (i.e.
+    validated group elements); higher bytes are DROPPED by design.
+    """
+    planar = np.asarray(planar, dtype=_U32)
+    n_limb, n = planar.shape[-2], planar.shape[-1]
+    if bpn > 4 * n_limb:
+        raise ValueError("pack width exceeds the limb width")
+    if out is None:
+        out = np.empty((*planar.shape[:-2], bpn, n), dtype=np.uint8)
+    if (
+        planar.ndim == 2
+        and planar.flags.c_contiguous
+        and out.ndim == 2
+        and out.strides[-1] == 1
+        and _native_pack_planar(planar, bpn, out)
+    ):
+        return out
+    if planar.flags.c_contiguous:
+        # little-endian u32 planes viewed as bytes: element i's byte b lives
+        # at [..., b // 4, 4 * i + (b % 4)] — one strided plane copy per
+        # byte-plane, no arithmetic temporaries
+        raw = planar.view(np.uint8)
+        for b in range(bpn):
+            out[..., b, :] = raw[..., b // 4, b % 4 :: 4]
+    else:
+        # strided views (a transposed wire slice): shift-and-mask per plane
+        for b in range(bpn):
+            out[..., b, :] = (
+                (planar[..., b // 4, :] >> _U32(8 * (b % 4))) & _U32(0xFF)
+            ).astype(np.uint8)
+    return out
+
+
+def _native_pack_planar(planar: np.ndarray, bpn: int, out: np.ndarray) -> bool:
+    """Native plane pack of one contiguous planar ``[L, n]`` into byte-planar
+    ``out[bpn, *]`` (row stride from ``out.strides[0]``)."""
+    from ..utils import native
+
+    lib = native.load()
+    if lib is None or not hasattr(lib, "xn_pack_planar_planes"):
+        return False
+    lib.xn_pack_planar_planes(
+        native.np_u32p(planar),
+        planar.shape[-1],
+        planar.shape[-1],  # input plane stride
+        bpn,
+        native.np_u8p(out),
+        out.strides[0],
+        0,
+    )
+    return True
+
+
+def pack_planar_slice(
+    planar: np.ndarray,
+    lo: int,
+    hi: int,
+    bpn: int,
+    out: np.ndarray,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Pack the column slice ``[lo, hi)`` of one contiguous planar
+    ``uint32[L, n]`` row into byte-planar ``out[bpn, >= hi-lo]`` in place
+    (native plane kernel: unit-stride reads AND writes; shift-and-mask
+    numpy fallback)."""
+    n_limb, n = planar.shape
+    width = hi - lo
+    if bpn > 4 * n_limb:
+        raise ValueError("pack width exceeds the limb width")
+    view = out[:, :width]
+    from ..utils import native
+
+    lib = native.load()
+    if (
+        lib is not None
+        and hasattr(lib, "xn_pack_planar_planes")
+        and planar.flags.c_contiguous
+        and out.strides[-1] == 1
+    ):
+        lib.xn_pack_planar_planes(
+            native.np_u32p_at(planar, lo),
+            width,
+            n,  # input plane stride
+            bpn,
+            native.np_u8p(view),
+            out.strides[0],
+            max(0, int(n_threads)),
+        )
+        return view
+    for b in range(bpn):
+        view[b, :] = (
+            (planar[b // 4, lo:hi] >> _U32(8 * (b % 4))) & _U32(0xFF)
+        ).astype(np.uint8)
+    return view
+
+
+def pack_wire_slice(
+    stack: np.ndarray,
+    lo: int,
+    hi: int,
+    bpn: int,
+    out: np.ndarray,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Pack the element-column slice ``[lo, hi)`` of a wire-layout
+    ``uint32[K, n, L]`` batch into byte-planar ``out[K, bpn, >= hi-lo]``
+    IN PLACE through its strides — the per-shard staging-ring pack of the
+    streaming pipeline. Native kernel when available (plane-major
+    unit-stride writes, ~memcpy speed; numpy's byte gather for the same
+    copy measures ~3x a planar transpose), strided numpy copy otherwise.
+    """
+    k, n, n_limb = stack.shape
+    width = hi - lo
+    if bpn > 4 * n_limb:
+        raise ValueError("pack width exceeds the limb width")
+    if not stack.flags.c_contiguous:
+        stack = np.ascontiguousarray(stack, dtype=_U32)
+    from ..utils import native
+
+    lib = native.load()
+    view = out[:, :, :width]
+    if (
+        lib is not None
+        and hasattr(lib, "xn_pack_wire_planes")
+        and out.strides[-1] == 1
+    ):
+        for i in range(k):
+            lib.xn_pack_wire_planes(
+                native.np_u32p_at(stack, (i * n + lo) * n_limb),
+                width,
+                n_limb,
+                bpn,
+                native.np_u8p_at(out, i * out.strides[0]),
+                out.strides[1],
+                max(0, int(n_threads)),
+            )
+        return view
+    raw = stack.view(np.uint8)  # [K, n, 4L]
+    view[...] = np.moveaxis(raw[:, lo:hi, :bpn], -1, -2)
+    return view
+
+
+def pack_wire(stack: np.ndarray, bpn: int, out: np.ndarray | None = None) -> np.ndarray:
+    """Wire-layout ``uint32[..., n, L]`` -> packed byte-planar
+    ``uint8[..., bpn, n]`` (the staging-ring pack for wire-layout submit
+    paths: byte b of element i is byte ``b`` of its little-endian wire
+    row). Native plane-pack kernel for the 3-D batch shape, one strided
+    numpy transpose copy otherwise."""
+    stack = np.ascontiguousarray(stack, dtype=_U32)
+    n_limb = stack.shape[-1]
+    if bpn > 4 * n_limb:
+        raise ValueError("pack width exceeds the limb width")
+    if out is None:
+        out = np.empty((*stack.shape[:-2], bpn, stack.shape[-2]), dtype=np.uint8)
+    if stack.ndim == 3 and out.ndim == 3:
+        return pack_wire_slice(stack, 0, stack.shape[1], bpn, out)
+    raw = stack.view(np.uint8)  # [..., n, 4L]
+    out[...] = np.moveaxis(raw[..., :bpn], -1, -2)
+    return out
+
+
+def unpack_planar(packed: np.ndarray, n_limbs: int, out: np.ndarray | None = None) -> np.ndarray:
+    """Packed byte-planar ``uint8[..., bpn, n]`` -> planar ``uint32[..., L, n]``."""
+    packed = np.asarray(packed, dtype=np.uint8)
+    bpn, n = packed.shape[-2], packed.shape[-1]
+    if n_limbs < n_limbs_for_bytes(bpn):
+        raise ValueError("limb width too small for the packed width")
+    if out is None or not out.flags.c_contiguous:
+        out = np.zeros((*packed.shape[:-2], n_limbs, n), dtype=_U32)
+    else:
+        out[...] = 0
+    raw = out.view(np.uint8)
+    for b in range(bpn):
+        raw[..., b // 4, b % 4 :: 4] = packed[..., b, :]
+    return out
+
+
+def fold_packed_slice_host(
+    acc: np.ndarray,
+    packed: np.ndarray,
+    out: np.ndarray,
+    col0: int,
+    col1: int,
+    order_limbs: np.ndarray,
+    n_threads: int = 0,
+    acc_cols: int | None = None,
+) -> bool:
+    """Fold the column slice ``[col0, col1)`` of a PACKED byte-planar
+    ``uint8[K, bpn, n]`` batch into the planar ``uint32[L, *]`` accumulator
+    slice — the native single-pass u64 fold reading the packed bytes in
+    place (25% less batch traffic at bpn=6 vs the unpacked planar fold).
+
+    Buffer addressing matches :func:`fold_planar_slice_host`; returns False
+    when no native path applies (caller unpacks and takes the planar fold).
+    Requirements: u64-applicable order (<= 2 limbs, K+1 headroom) and
+    ``bpn <= 8``.
+    """
+    k, bpn, n = packed.shape
+    width = col1 - col0
+    n_limb = acc.shape[0]
+    a_cols = acc_cols if acc_cols is not None else n
+    if acc.shape != (n_limb, a_cols) or out.shape != acc.shape:
+        raise ValueError("accumulator/out shape mismatch")
+    if not (acc.flags.c_contiguous and out.flags.c_contiguous and packed.flags.c_contiguous):
+        raise ValueError("packed slice fold requires C-contiguous buffers")
+    if out is acc:
+        raise ValueError("out must not alias acc")
+    if bpn > 8 or not u64_fold_applicable(k, n_limb, order_limbs):
+        return False
+    from ..utils import native
+
+    lib = native.load()
+    if lib is None or not hasattr(lib, "xn_fold_packed_u64_strided"):
+        return False
+    off = 0 if acc_cols is not None else col0
+    lib.xn_fold_packed_u64_strided(
+        native.np_u32p_at(acc, off),
+        native.np_u8p_at(packed, col0),
+        native.np_u32p_at(out, off),
+        width,
+        a_cols,  # acc/out plane stride (elements)
+        n,  # packed byte-plane stride (bytes)
+        bpn * n,  # packed batch (update) stride (bytes)
+        n_limb,
+        bpn,
+        k,
+        native.np_u32p(np.ascontiguousarray(order_limbs, dtype=_U32)),
+        max(0, int(n_threads)),
+    )
+    return True
+
+
+def fold_packed_batch_host(
+    acc: np.ndarray,
+    packed: np.ndarray,
+    order_limbs: np.ndarray,
+    out: np.ndarray | None = None,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Single-pass host fold of PACKED byte-planar ``uint8[K, bpn, n]``
+    updates into the planar ``uint32[L, n]`` accumulator.
+
+    Native fast path reads the packed bytes directly (the fold's dominant
+    cost is the one mandatory read of the batch, and packed planes are
+    ``bpn / 4L`` of the unpacked bytes); without it the batch unpacks once
+    on the host and takes :func:`fold_planar_batch_host`. ``out``/
+    ``n_threads`` behave exactly like the planar fold's.
+    """
+    k, bpn, n = packed.shape
+    n_limb = acc.shape[0]
+    if acc.shape != (n_limb, n):
+        raise ValueError("accumulator/batch shape mismatch")
+    acc_c = np.ascontiguousarray(acc, dtype=_U32)
+    packed_c = np.ascontiguousarray(packed, dtype=np.uint8)
+    if (
+        out is not None
+        and out.shape == acc_c.shape
+        and out.dtype == _U32
+        and out.flags.c_contiguous
+        and out is not acc_c
+    ):
+        pass  # reuse the caller's spare buffer
+    else:
+        out = np.empty_like(acc_c)
+    if fold_packed_slice_host(
+        acc_c, packed_c, out, 0, n, order_limbs, n_threads=n_threads
+    ):
+        return out
+    # no native packed path: one host unpack, then the planar fold (which
+    # may still take its own native or pairwise route)
+    planar = unpack_planar(packed_c, n_limb)
+    return fold_planar_batch_host(acc_c, planar, order_limbs, out=out, n_threads=n_threads)
 
 
 def fold_wire_batch_host(

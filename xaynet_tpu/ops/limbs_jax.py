@@ -137,7 +137,9 @@ def wire_bytes_to_planar(data: jax.Array, count: int, bpn: int) -> jax.Array:
     6/8 for the f32/B0/M3 configs, 7/8 for M6) and never pay a host-side
     parse. Designed to run inside a jitted caller.
     """
-    out_limbs = (bpn + 3) // 4
+    from . import limbs as host_limbs
+
+    out_limbs = host_limbs.n_limbs_for_bytes(bpn)
     b = data.reshape(*data.shape[:-1], count, bpn).astype(_U32)
     limbs = []
     for j in range(out_limbs):
@@ -146,6 +148,40 @@ def wire_bytes_to_planar(data: jax.Array, count: int, bpn: int) -> jax.Array:
             w = w | (b[..., 4 * j + i] << _U32(8 * i))
         limbs.append(w)
     return jnp.stack(limbs, axis=-2)
+
+
+def packed_planar_to_limbs(packed: jax.Array, n_limbs: int) -> jax.Array:
+    """Packed byte-planar ``uint8[..., bpn, n]`` -> planar ``uint32[..., L, n]``.
+
+    Device twin of ``limbs.unpack_planar`` (the packed staging codec): limb
+    j assembles from byte-planes ``4j .. min(4j+4, bpn)`` with the same
+    shift-or chain as :func:`wire_bytes_to_planar`, but every read is a
+    CONTIGUOUS plane (the byte-planar layout keeps the model axis minor).
+    Pure byte shuffling — designed to run inside a jitted caller so the
+    packed bytes, not the 4L-byte planar, are what crosses host->device.
+    """
+    from . import limbs as host_limbs
+
+    bpn = packed.shape[-2]
+    if n_limbs < host_limbs.n_limbs_for_bytes(bpn):
+        raise ValueError("limb width too small for the packed width")
+    b = packed.astype(_U32)
+    limbs = []
+    for j in range(n_limbs):
+        if 4 * j >= bpn:
+            limbs.append(jnp.zeros(packed.shape[:-2] + packed.shape[-1:], dtype=_U32))
+            continue
+        w = b[..., 4 * j, :]
+        for i in range(1, min(4, bpn - 4 * j)):
+            w = w | (b[..., 4 * j + i, :] << _U32(8 * i))
+        limbs.append(w)
+    return jnp.stack(limbs, axis=-2)
+
+
+# standalone jitted entry for callers that unpack OUTSIDE their own jit
+# (e.g. ahead of the Pallas shard fold, whose kernel wants planar input):
+# one shared trace cache, keyed on shape + the static limb count
+packed_planar_to_limbs_jit = jax.jit(packed_planar_to_limbs, static_argnums=(1,))
 
 
 def planar_all_lt_const(planar: jax.Array, order: int) -> jax.Array:

@@ -470,7 +470,7 @@ def _sum_masks_host_fused(
 
     lib = native.load()
     order = config.vect.order
-    bpn = (order.bit_length() + 7) // 8
+    bpn = host_limbs.draw_width_for(order)
     # order > 2^63 can't even hold residual + one fold in u64 (2*order - 2
     # wraps), so the wave path serves those
     if (

@@ -21,12 +21,35 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.mask.config import MaskConfig
 from ..ops import limbs as host_limbs
-from ..ops.fold_jax import MAX_LAZY_BATCH, fold_planar_batch, p_mod_sub, wire_to_planar
+from ..ops.fold_jax import (
+    MAX_LAZY_BATCH,
+    fold_packed_batch,
+    fold_planar_batch,
+    p_mod_sub,
+    wire_to_planar,
+)
 from ..telemetry import profiling
+from ..telemetry.registry import get_registry
 from ..utils.kernels import FOLD_KERNELS
 from .mesh import MODEL_AXIS, make_mesh, pad_to_multiple, shard_map_compat
 
 logger = logging.getLogger(__name__)
+
+# cross-shard combine traffic (bytes actually copied), by path: "scatter" =
+# decomposing the global accumulator into per-shard buffers (native plans
+# copy; device plans decompose zero-copy), "gather" = reassembling /
+# materializing the accumulator on the host (the final model download and
+# any snapshot/checkpoint read). The reduce-scatter layout keeps the
+# accumulator per-shard ACROSS drain windows, so these counters advance
+# once per round instead of twice per drain — the bench's bytes-moved
+# series reads them.
+BYTES_REDUCED = get_registry().counter(
+    "xaynet_bytes_reduced_total",
+    "Accumulator bytes copied on the cross-shard combine path, by "
+    "direction (scatter = global -> per-shard, gather = per-shard -> "
+    "global/host).",
+    ("path",),
+)
 
 _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 
@@ -82,26 +105,36 @@ def _build_wire_unpack(bpn: int, order: int, multi_device: bool):
     return unpack_mask
 
 
-def _sharded_native_fold(
-    acc_np: np.ndarray, stack_np: np.ndarray, order_limbs, n_shards: int, state: dict
+def _sharded_native_fan_out(
+    acc_np: np.ndarray,
+    batch_np: np.ndarray,
+    batch_dtype,
+    slice_fold,
+    batch_fold,
+    n_shards: int,
+    state: dict,
 ) -> np.ndarray:
-    """One concurrent strided native kernel call per mesh shard over the
-    full host planar batch: shard ``d`` reads and writes only its
-    contiguous plane slice of the shared acc/out buffers (disjoint columns
-    — no synchronization beyond the join), each call under the per-shard
-    thread budget. The GIL is released inside the C++ kernel, so the
-    threads genuinely overlap the shard folds; they are spawned per call
-    (spawn cost ~10us each, noise against a >=100ms fold) because the
-    aggregator has no close() hook to own a pool's lifecycle. Returns the
-    new accumulator (``state['spare']`` reused when possible, exactly like
-    the single-device ping-pong)."""
+    """Shared thread fan-out for the per-shard strided native folds: one
+    concurrent kernel call per mesh shard over the full staged batch —
+    shard ``d`` reads and writes only its contiguous plane slice of the
+    shared acc/out buffers (disjoint columns, no synchronization beyond
+    the join), each call under the per-shard thread budget. The GIL is
+    released inside the C++ kernel, so the threads genuinely overlap the
+    shard folds; they are spawned per call (spawn cost ~10us each, noise
+    against a >=100ms fold) because the aggregator has no close() hook to
+    own a pool's lifecycle. ``slice_fold(acc, batch, spare, lo, hi,
+    budget) -> bool`` folds one shard's column slice; ``batch_fold(acc,
+    batch, out) -> acc`` is the exact generic fallback when the native
+    library becomes unavailable mid-round. Returns the new accumulator
+    (``state['spare']`` reused when possible, exactly like the
+    single-device ping-pong)."""
     import threading
 
     from .mesh import shard_slices
     from .shards import shard_thread_budget
 
     acc_c = np.ascontiguousarray(acc_np, dtype=np.uint32)
-    stack_c = np.ascontiguousarray(stack_np, dtype=np.uint32)
+    batch_c = np.ascontiguousarray(batch_np, dtype=batch_dtype)
     spare = state["spare"]
     if not (
         spare is not None
@@ -120,9 +153,7 @@ def _sharded_native_fold(
 
     def fold_slice(i: int, lo: int, hi: int) -> None:
         try:
-            results[i] = host_limbs.fold_planar_slice_host(
-                acc_c, stack_c, spare, lo, hi, order_limbs, n_threads=budget
-            )
+            results[i] = slice_fold(acc_c, batch_c, spare, lo, hi, budget)
         except BaseException as e:  # surfaced after the join
             errors.append(e)
 
@@ -140,8 +171,51 @@ def _sharded_native_fold(
         raise errors[0]
     if all(results):
         return spare
-    # library unavailable mid-round: exact generic fallback
-    return host_limbs.fold_planar_batch_host(acc_c, stack_c, order_limbs, out=spare)
+    return batch_fold(acc_c, batch_c, spare)
+
+
+def _sharded_native_fold_packed(
+    acc_np: np.ndarray, packed_np: np.ndarray, order_limbs, n_shards: int, state: dict
+) -> np.ndarray:
+    """Packed twin of :func:`_sharded_native_fold`: the shared fan-out
+    over the strided packed-fold kernel (``ops.limbs.fold_packed_slice_host``)
+    reading the byte-planar batch directly."""
+    return _sharded_native_fan_out(
+        acc_np,
+        packed_np,
+        np.uint8,
+        lambda acc, packed, spare, lo, hi, budget: host_limbs.fold_packed_slice_host(
+            acc, packed, spare, lo, hi, order_limbs, n_threads=budget
+        ),
+        # library unavailable mid-round: exact generic fallback (one unpack)
+        lambda acc, packed, out: host_limbs.fold_packed_batch_host(
+            acc, packed, order_limbs, out=out
+        ),
+        n_shards,
+        state,
+    )
+
+
+def _sharded_native_fold(
+    acc_np: np.ndarray, stack_np: np.ndarray, order_limbs, n_shards: int, state: dict
+) -> np.ndarray:
+    """The shared fan-out over the strided planar-fold kernel
+    (``ops.limbs.fold_planar_slice_host``) reading the full host planar
+    batch."""
+    return _sharded_native_fan_out(
+        acc_np,
+        stack_np,
+        np.uint32,
+        lambda acc, stack, spare, lo, hi, budget: host_limbs.fold_planar_slice_host(
+            acc, stack, spare, lo, hi, order_limbs, n_threads=budget
+        ),
+        # library unavailable mid-round: exact generic fallback
+        lambda acc, stack, out: host_limbs.fold_planar_batch_host(
+            acc, stack, order_limbs, out=out
+        ),
+        n_shards,
+        state,
+    )
 
 
 class ShardedAggregator:
@@ -184,10 +258,53 @@ class ShardedAggregator:
         # multiple of the mesh size, so every device's byte slice is
         # element-aligned (count/n elements x bpn bytes)
         self._batch_bytes_sharding = NamedSharding(self.mesh, P(None, MODEL_AXIS))
-        self.acc = jax.device_put(
+        # packed byte-planar staging batches [K, bpn, padded] shard over the
+        # same model (lane) axis as the planar layout
+        self._batch_packed_sharding = NamedSharding(self.mesh, P(None, None, MODEL_AXIS))
+        # the single-source-of-truth pack width (ops/limbs.wire_width_for):
+        # the streaming pipeline stages bpn bytes per element instead of
+        # 4*L whenever that is actually narrower
+        self.packed_width = host_limbs.wire_width_for(self.order)
+        self._packed_fold_fn = None  # built once kernel_used resolves
+        # reduce-scatter ownership: while a ShardPlan is adopted, the
+        # per-shard buffers ARE the accumulator and `_acc` is stale — the
+        # `acc` property reassembles on demand (the only gathers left are
+        # explicit reads: snapshot/checkpoint/final download)
+        self._live_plan = None
+        self._acc = jax.device_put(
             jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding
         )
         self.nb_models = 0
+
+    # -- reduce-scatter accumulator ownership -------------------------------
+
+    @property
+    def acc(self):
+        """The global planar accumulator. With a live (adopted) shard plan
+        the per-shard buffers are authoritative and this READ reassembles
+        them on demand — zero-copy for device plans, one counted
+        concatenation for native host plans. The reduce-scatter contract:
+        nothing gathers per drain window anymore; only explicit reads
+        (snapshot, checkpoint, the final model download) pay the gather."""
+        plan = self._live_plan
+        if plan is not None:
+            return plan.reassemble()
+        return self._acc
+
+    @acc.setter
+    def acc(self, value):
+        # an explicit accumulator write (restore/reset/non-sharded fold)
+        # supersedes any adopted plan — the per-shard buffers are stale
+        if self._live_plan is not None:
+            self._live_plan = None
+        self._acc = value
+
+    def adopt_plan(self, plan) -> None:
+        """Adopt a :class:`~xaynet_tpu.parallel.shards.ShardPlan` as the
+        authoritative accumulator (the streaming pipeline's reduce-scatter
+        handoff). The plan persists across drain windows; ``acc`` reads
+        reassemble on demand."""
+        self._live_plan = plan
 
     def _to_planar_padded(self, stack: np.ndarray) -> np.ndarray:
         """Wire ``[K, n, L]`` -> planar padded ``[K, L, padded_len]`` (host)."""
@@ -474,6 +591,110 @@ class ShardedAggregator:
 
         return fold
 
+    def packed_staging_usable(self) -> bool:
+        """Whether packed byte-planar staging actually shrinks anything:
+        the wire width must be narrower than the limb width (at the
+        ``order == 2^(32L)`` boundary bpn == 4L and packing is a no-op)."""
+        return self.packed_width < 4 * self.n_limbs
+
+    def _make_native_packed_fold_fn(self):
+        """Host packed fold ``(acc u32[L,n], packed u8[K,bpn,n]) -> acc``:
+        the native kernel reads the byte planes directly (25% less batch
+        traffic at bpn=6 than the unpacked planar read), with the same
+        spare ping-pong, multi-shard fan-out and oversized-batch fallback
+        as :meth:`_make_native_fold_fn`."""
+        order = self.order
+        order_limbs = host_limbs.order_limbs_for(order)
+        n_limbs = self.n_limbs
+        headroom = (
+            None if order == (1 << (32 * self.n_limbs)) else (1 << 64) // order
+        )
+        n_shards = self.mesh.devices.size
+        state = {"spare": None, "warned": False, "budget": 0}
+
+        def fold(acc, packed):
+            packed_np = np.asarray(packed)  # host kernel reads host memory  # lint: sync-ok
+            acc_np = np.asarray(acc)  # lint: sync-ok
+            if headroom is not None and packed_np.shape[0] + 1 > headroom:
+                if not state["warned"]:
+                    state["warned"] = True
+                    logger.warning(
+                        "native-u64 headroom exceeded at K=%d (order ~2^%d); "
+                        "folding oversized packed batches with the XLA kernel",
+                        packed_np.shape[0],
+                        order.bit_length(),
+                    )
+                planar = host_limbs.unpack_planar(packed_np, n_limbs)
+                return fold_planar_batch(acc_np, planar, order)
+            if n_shards > 1:
+                out = _sharded_native_fold_packed(
+                    acc_np, packed_np, order_limbs, n_shards, state
+                )
+            else:
+                out = host_limbs.fold_packed_batch_host(
+                    acc_np, packed_np, order_limbs, out=state["spare"]
+                )
+            state["spare"] = (
+                acc_np if (out is not acc_np and acc_np.flags.writeable) else None
+            )
+            return out
+
+        return fold
+
+    def _make_packed_fold_fn(self, kernel: str):
+        """The packed-batch fold callable for ``kernel`` (byte-planar
+        ``uint8[K, bpn, padded]`` input), memoized process-wide like the
+        planar fold fns. Device kernels fuse the in-graph unpack with the
+        fold in one jit (``ops.fold_jax.fold_packed_batch``) so only packed
+        bytes cross host->device; Pallas kernels unpack in a separate jit
+        (``pallas_call`` reads its operand from HBM — fusion buys nothing)."""
+        if kernel == "native-u64":
+            return self._make_native_packed_fold_fn()
+        n_limbs, order = self.n_limbs, self.order
+        if kernel in ("pallas", "pallas-interpret"):
+            from ..ops import limbs_jax
+
+            unpack = jax.jit(lambda p: limbs_jax.packed_planar_to_limbs(p, n_limbs))
+            base_fold = self._make_fold_fn(kernel)
+            return lambda a, p: base_fold(a, unpack(p))
+        key = ("xla-packed", _mesh_key(self.mesh), n_limbs, order)
+        fn = _FOLD_FN_CACHE.get(key)
+        if fn is None:
+            if self.mesh.devices.size > 1:
+
+                def call(a, p):
+                    return fold_packed_batch(a, p, n_limbs, order)
+
+                fn = jax.jit(
+                    _shard_map(
+                        call,
+                        mesh=self.mesh,
+                        in_specs=(P(None, MODEL_AXIS), P(None, None, MODEL_AXIS)),
+                        out_specs=P(None, MODEL_AXIS),
+                    ),
+                    donate_argnums=(0,),
+                )
+            else:
+                fn = lambda a, p: fold_packed_batch(a, p, n_limbs, order)
+            _FOLD_FN_CACHE[key] = fn
+        return fn
+
+    def _fold_packed(self, acc, staged_packed):
+        """Fold a packed byte-planar staged batch (same ``masked_add``
+        telemetry op as the planar fold: one /metrics series answers 'how
+        fast is the masked add' whichever staging layout fed it). Callers
+        resolve ``kernel_used`` first — packed staging never drives the
+        auto-calibration (that races on a planar batch)."""
+        if self._packed_fold_fn is None:
+            if self.kernel_used is None:
+                raise RuntimeError("kernel must be resolved before a packed fold")
+            self._packed_fold_fn = self._make_packed_fold_fn(self.kernel_used)
+        return profiling.timed_kernel(
+            "masked_add",
+            staged_packed.shape[0] * staged_packed.shape[-1],
+            lambda: self._packed_fold_fn(acc, staged_packed),
+        )
+
     def _native_u64_usable(self, k: int) -> bool:
         """Whether the native u64 fold can serve THIS aggregator: an order
         within 2 limbs whose K+1-term running sum fits u64
@@ -678,6 +899,17 @@ class ShardedAggregator:
         planar = wire_to_planar(mask) if mask.shape == (self.model_length, self.n_limbs) else mask
         if planar.shape[1] != self.padded_length:
             planar = np.pad(planar, ((0, 0), (0, self.padded_length - planar.shape[1])))
+        if self._live_plan is not None:
+            # reduce-scatter unmask: each shard subtracts ITS slice of the
+            # mask against its own accumulator buffer — the aggregate is
+            # never reassembled before subtraction, and the only gather is
+            # the unmasked result crossing to the host for decode (the
+            # final model download)
+            return profiling.timed_kernel(
+                "unmask",
+                self.padded_length,
+                lambda: self._unmask_plan(self._live_plan, planar),
+            )
         if not isinstance(self.acc, jax.Array):
             # the native fold keeps the accumulator host-resident (it would
             # previously ride into the jit as an implicit upload; a
@@ -703,6 +935,40 @@ class ShardedAggregator:
             lambda: _unmask_kernel(self.acc, mask_dev, self.order),
         )
         return np.ascontiguousarray(np.asarray(out)[:, : self.model_length].T)
+
+    def _unmask_plan(self, plan, mask_planar: np.ndarray) -> np.ndarray:
+        """Per-shard in-place unmask against a live reduce-scatter plan:
+        native plans subtract on each host shard buffer, device plans
+        dispatch one subtract per device (all in flight before the first
+        fetch) — either way only the UNMASKED per-shard slices move, once,
+        into the host wire result."""
+        out = np.empty((self.model_length, self.n_limbs), dtype=np.uint32)
+        if plan.native:
+            order_limbs = host_limbs.order_limbs_for(self.order)
+            for d, (lo, hi) in enumerate(plan.slices):
+                real_hi = min(hi, self.model_length)
+                if lo >= real_hi:
+                    continue
+                acc_w = np.ascontiguousarray(plan.accs[d][:, : real_hi - lo].T)  # lint: guarded-ok: drain barrier read
+                mask_w = np.ascontiguousarray(mask_planar[:, lo:real_hi].T)
+                out[lo:real_hi] = host_limbs.mod_sub(acc_w, mask_w, order_limbs)
+        else:
+            pending = []
+            for d, (lo, hi) in enumerate(plan.slices):
+                mask_dev = jax.device_put(
+                    np.ascontiguousarray(mask_planar[:, lo:hi]), plan.devices[d]
+                )
+                # dispatch every shard's subtract before fetching any: the
+                # per-device kernels overlap, the downloads serialize after
+                pending.append(
+                    (lo, hi, _unmask_kernel(plan.accs[d], mask_dev, self.order))  # lint: guarded-ok: drain barrier read
+                )
+            for lo, hi, res in pending:
+                real_hi = min(hi, self.model_length)
+                if lo < real_hi:
+                    out[lo:real_hi] = np.asarray(res)[:, : real_hi - lo].T
+        BYTES_REDUCED.labels(path="gather").inc(out.nbytes)
+        return np.ascontiguousarray(out)
 
     def snapshot(self) -> np.ndarray:
         """Host wire-layout copy of the aggregate (checkpoints / tests)."""

@@ -135,6 +135,13 @@ class ShardPlan:
                 self.accs = [
                     np.ascontiguousarray(acc_np[:, lo:hi]) for lo, hi in self.slices
                 ]
+                from .aggregator import BYTES_REDUCED
+
+                # host memory has no sharded view: decomposing the global
+                # accumulator copies it once (the reduce-scatter layout
+                # keeps the plan across drain windows, so this is per
+                # round, not per drain)
+                BYTES_REDUCED.labels(path="scatter").inc(int(acc_np.nbytes))
             self.spares: list = [np.empty_like(a) for a in self.accs]  # guarded-by: _device_dispatch_lock
         else:
             import jax
@@ -219,6 +226,59 @@ class ShardPlan:
             self._locked_device_fold(
                 d, lambda acc: fold_planar_batch(acc, batch, self.agg.order)
             )
+
+    def fold_shard_packed(self, d: int, packed) -> None:
+        """Fold a per-shard PACKED byte-planar batch ``uint8[K, bpn, width]``
+        into shard ``d``'s accumulator (the packed-staging streaming path).
+        Native: the strided packed kernel reads the byte planes directly
+        (``ops.limbs.fold_packed_batch_host``), falling back to one unpack +
+        the planar fold when the u64 path doesn't apply. Device: the fused
+        unpack+fold jit (``ops.fold_jax.fold_packed_batch``) on the shard's
+        device — only packed bytes ever cross host->device.
+        Consistency contract matches :meth:`fold_shard` exactly (the
+        accumulator is reassigned only after the fold returns)."""
+        if self.native:
+            packed_np = np.asarray(packed)  # host-kernel view  # lint: sync-ok
+            if not (
+                packed_np.shape[1] <= 8
+                and host_limbs.u64_fold_applicable(
+                    packed_np.shape[0], self.agg.n_limbs, self.order_limbs
+                )
+            ):
+                self._warn_fallback(packed_np.shape[0])
+            acc = self.accs[d]  # lint: guarded-ok: single-owner shard slot
+            out = host_limbs.fold_packed_batch_host(
+                acc,
+                packed_np,
+                self.order_limbs,
+                out=self.spares[d],  # lint: guarded-ok: single-owner shard slot
+                n_threads=self.n_threads,
+            )
+            spare_back = acc if (out is not acc and acc.flags.writeable) else None
+            self.spares[d] = spare_back  # lint: guarded-ok: single-owner shard slot
+            self.accs[d] = out  # lint: guarded-ok: single-owner shard slot
+            return
+        from ..ops.fold_jax import fold_packed_batch
+
+        n_limbs, order = self.agg.n_limbs, self.agg.order
+        if self.agg.kernel_used in ("pallas", "pallas-interpret"):
+            from ..ops import fold_pallas, limbs_jax
+
+            interpret = self.agg.kernel_used == "pallas-interpret"
+
+            def call(acc):
+                # the module-level jitted unpack: one shared trace cache
+                # across calls/shards instead of a fresh retrace per batch
+                planar = limbs_jax.packed_planar_to_limbs_jit(packed, n_limbs)
+                return fold_pallas.fold_planar_batch_pallas(
+                    acc, planar, order, interpret=interpret
+                )
+
+            self._locked_device_fold(d, call)
+            return
+        self._locked_device_fold(
+            d, lambda acc: fold_packed_batch(acc, packed, n_limbs, order)
+        )
 
     def _locked_device_fold(self, d: int, call) -> None:
         """Run one shard's device fold under the dispatch lock; on the CPU
@@ -306,12 +366,19 @@ class ShardPlan:
         """The global planar accumulator assembled from the per-shard
         state: zero-copy for device plans
         (``make_array_from_single_device_arrays`` over the per-device
-        buffers, which ARE the mesh sharding's shards), one concatenation
-        copy for native plans (host memory has no sharded view). The
-        caller (drain) re-publishes this as ``agg.acc``; the plan is stale
-        afterwards — rebuild before folding again."""
+        buffers, which ARE the mesh sharding's shards), one counted
+        concatenation copy for native plans (host memory has no sharded
+        view). Reduce-scatter contract (DESIGN §17): this is a READ — an
+        adopted plan stays authoritative afterwards and keeps folding into
+        the same per-shard buffers (``ShardedAggregator.acc`` calls this
+        on demand for snapshot/checkpoint/final download). Only an
+        explicit ``acc`` WRITE supersedes the plan."""
         if self.native:
-            return np.concatenate(self.accs, axis=1)  # lint: guarded-ok: drain barrier read
+            from .aggregator import BYTES_REDUCED
+
+            out = np.concatenate(self.accs, axis=1)  # lint: guarded-ok: drain barrier read
+            BYTES_REDUCED.labels(path="gather").inc(int(out.nbytes))
+            return out
         import jax
 
         return jax.make_array_from_single_device_arrays(

@@ -138,6 +138,13 @@ SHARD_OVERLAP = _registry.gauge(
     "that ran concurrently with the other leg during the last drain window.",
     ("shard",),
 )
+BYTES_STAGED = _registry.counter(
+    "xaynet_bytes_staged_total",
+    "Bytes copied into host staging rings (and later across host->device), "
+    "by layout: packed = byte-planar wire-width planes, unpacked = full "
+    "uint32 limb planes, wire = raw serialized element blocks.",
+    ("layout",),
+)
 
 _SHUTDOWN = object()
 
@@ -280,6 +287,7 @@ class StreamingAggregator:
         max_batch: int = 64,
         shard_parallel: bool | None = None,
         shard_threads: int = 0,
+        packed: bool | None = None,
     ):
         if staging_buffers < 2:
             raise ValueError("staging_buffers must be >= 2 (no overlap below that)")
@@ -299,6 +307,17 @@ class StreamingAggregator:
         self._sharded = n_dev > 1 and (shard_parallel is None or shard_parallel)
         self._n_shards = n_dev if self._sharded else 1
         self._shard_threads = shard_threads
+        # packed staging (on by default wherever it shrinks anything): the
+        # planar submit paths stage byte-planar uint8[K, bpn, width] planes
+        # — bpn/(4L) of the unpacked ring/transfer bytes — and the fold
+        # reads the packed planes directly (native) or unpacks in-graph
+        # (device). The fold math is the exact same modular sum over the
+        # exact same (validated, < order) elements, so the aggregate is
+        # byte-identical to unpacked staging.
+        self._packed = (
+            agg.packed_staging_usable() if packed is None
+            else bool(packed) and agg.packed_staging_usable()
+        )
         self._plan = None  # shards.ShardPlan while accs live  # guarded-by: _lock
         self._shard_queues: list[queue_mod.Queue] | None = None
         self._shard_workers: list[threading.Thread | None] = []
@@ -364,8 +383,10 @@ class StreamingAggregator:
                 if w is not None and w.is_alive():
                     w.join(timeout=60.0)
         if self._plan is not None:
-            # a poisoned drain left the plan live; the aggregate is
-            # unusable, just free the pool
+            # shut the plan's fold pool; the per-shard buffers stay ADOPTED
+            # by the aggregator (reduce-scatter) so finalize/unmask/snapshot
+            # after close still read the accumulator — on a poisoned
+            # pipeline they surface the error through drain() first
             self._plan.close()
             self._plan = None
 
@@ -401,6 +422,10 @@ class StreamingAggregator:
                 if kind == "planar":
                     shape = (self.max_batch, agg.n_limbs, agg.padded_length)
                     dtype = np.uint32
+                elif kind == "packed":
+                    # byte-planar packed planes: bpn/(4L) of the planar ring
+                    shape = (self.max_batch, agg.packed_width, agg.padded_length)
+                    dtype = np.uint8
                 else:  # raw wire bytes
                     shape = (self.max_batch, agg.padded_length * agg.config.bytes_per_number)
                     dtype = np.uint8
@@ -513,23 +538,36 @@ class StreamingAggregator:
         self._check(k)
         if self._sharded:
             return self._submit_sharded_planar_stack(stack, k)
+        from ..ops import limbs as host_limbs
+
+        kind = "packed" if self._packed else "planar"
         t0 = time.monotonic()
-        buf = self._ring("planar").acquire()
-        # transpose+pad straight into the ring buffer (numpy strided copy,
-        # no wire_to_planar intermediate): per-batch host allocation in the
-        # steady state is zero
+        buf = self._ring(kind).acquire()
         view = buf[:k]
-        view[:, :, : self.agg.model_length] = stack.transpose(0, 2, 1)
-        if self.agg.padded_length != self.agg.model_length:
-            view[:, :, self.agg.model_length :] = 0
+        if self._packed:
+            # pack straight into the byte-planar ring buffer: one strided
+            # transpose of the first bpn wire bytes per element — the same
+            # copy class as the planar transpose below, writing bpn/(4L)
+            # of the bytes
+            host_limbs.pack_wire(stack, self.agg.packed_width, out=view[:, :, : self.agg.model_length])
+            if self.agg.padded_length != self.agg.model_length:
+                view[:, :, self.agg.model_length :] = 0
+        else:
+            # transpose+pad straight into the ring buffer (numpy strided
+            # copy, no wire_to_planar intermediate): per-batch host
+            # allocation in the steady state is zero
+            view[:, :, : self.agg.model_length] = stack.transpose(0, 2, 1)
+            if self.agg.padded_length != self.agg.model_length:
+                view[:, :, self.agg.model_length :] = 0
+        BYTES_STAGED.labels(layout="packed" if self._packed else "unpacked").inc(view.nbytes)
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
         self._batch_seq += 1
         trace.get_tracer().record_span(
             SPAN_STAGE, start=t0, duration=time.monotonic() - t0,
-            batch=self._batch_seq, kind="planar", k=k,
+            batch=self._batch_seq, kind=kind, k=k,
         )
-        self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
+        self._dispatch((buf, view, kind, k, ticket, self._batch_seq))
         return ticket
 
     def fold_planar_rows_now(self, rows: list) -> None:
@@ -619,19 +657,26 @@ class StreamingAggregator:
         self._check(k)
         if self._sharded:
             return self._submit_sharded_planar_rows(rows, k)
+        from ..ops import limbs as host_limbs
+
+        kind = "packed" if self._packed else "planar"
         t0 = time.monotonic()
-        buf = self._ring("planar").acquire()
+        buf = self._ring(kind).acquire()
         view = buf[:k]
         for i, row in enumerate(rows):
-            np.copyto(view[i], row)
+            if self._packed:
+                host_limbs.pack_planar(row, self.agg.packed_width, out=view[i])
+            else:
+                np.copyto(view[i], row)
+        BYTES_STAGED.labels(layout="packed" if self._packed else "unpacked").inc(view.nbytes)
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
         self._batch_seq += 1
         trace.get_tracer().record_span(
             SPAN_STAGE, start=t0, duration=time.monotonic() - t0,
-            batch=self._batch_seq, kind="planar", k=k,
+            batch=self._batch_seq, kind=kind, k=k,
         )
-        self._dispatch((buf, view, "planar", k, ticket, self._batch_seq))
+        self._dispatch((buf, view, kind, k, ticket, self._batch_seq))
         return ticket
 
     def submit_wire_batch(self, raw: np.ndarray) -> StreamTicket:
@@ -653,6 +698,7 @@ class StreamingAggregator:
         view[:, : raw.shape[1]] = raw
         if agg.padded_length != agg.model_length:
             view[:, raw.shape[1] :] = 0  # zero bytes decode to zero elements
+        BYTES_STAGED.labels(layout="wire").inc(view.nbytes)
         ticket = StreamTicket(k)
         self._stage_seconds += time.monotonic() - t0
         trace.get_tracer().record_span(
@@ -667,13 +713,15 @@ class StreamingAggregator:
 
     # -- fold worker -------------------------------------------------------
 
-    def _credit(self, staged, k: int) -> None:
-        """Fold a planar batch and hand its count over atomically: the
-        nb_models credit and the in-flight drop happen under one lock, so
-        ``counted_models()`` never observes the batch twice (double count →
-        spurious TooManyModels near the cap) or zero times."""
+    def _credit(self, staged, k: int, packed: bool = False) -> None:
+        """Fold a planar (or packed byte-planar) batch and hand its count
+        over atomically: the nb_models credit and the in-flight drop happen
+        under one lock, so ``counted_models()`` never observes the batch
+        twice (double count → spurious TooManyModels near the cap) or zero
+        times."""
         agg = self.agg
-        new_acc = agg._fold(agg.acc, staged)
+        fold = agg._fold_packed if packed else agg._fold
+        new_acc = fold(agg.acc, staged)
         with self._lock:
             agg.acc = new_acc
             agg.nb_models += k
@@ -721,14 +769,29 @@ class StreamingAggregator:
                 agg.nb_models += int(ok_host.sum())
                 self._in_flight_models -= k
             return
+        packed = kind == "packed"
         agg._resolve_kernel_cheap(k)
+        if packed and agg.kernel_used is None:
+            # the auto race calibrates on a PLANAR staged batch (both
+            # candidate folds take that shape): unpack this batch once on
+            # the host for the one-time timing run, then fold the packed
+            # original through the winner
+            from ..ops import limbs as host_limbs
+
+            planar = host_limbs.unpack_planar(
+                np.asarray(payload), agg.n_limbs  # host ring view  # lint: sync-ok
+            )
+            agg._resolve_kernel(jax.device_put(planar, agg._batch_sharding))
         if agg.kernel_used == "native-u64":
             # host fold reads the ring buffer directly (synchronous)
-            # — no device staging at all
-            self._credit(payload, k)
+            # — no device staging at all (packed: the byte planes fold
+            # in place through the native packed kernel)
+            self._credit(payload, k, packed=packed)
         else:
-            staged = jax.device_put(payload, agg._batch_sharding)
-            self._credit(staged, k)
+            staged = jax.device_put(
+                payload, agg._batch_packed_sharding if packed else agg._batch_sharding
+            )
+            self._credit(staged, k, packed=packed)
             try:
                 jax.block_until_ready(staged)  # host buffer free to reuse  # lint: sync-ok
             except BaseException as e:
@@ -804,7 +867,7 @@ class StreamingAggregator:
                         outcome = self._degrade_and_retry(payload, kind, k, ticket, seq, first)
             finally:
                 if buf is not None:
-                    self._ring("wire" if kind == "wire" else "planar").release(buf)
+                    self._ring(kind).release(buf)
                 with self._lock:
                     self._fold_seconds += time.monotonic() - agg_t0
                 INFLIGHT_FOLDS.dec()
@@ -944,12 +1007,24 @@ class StreamingAggregator:
                 agg._resolve_kernel(staged)
         with self._lock:
             plan = self._plan
+        if plan is not None and agg._live_plan is not plan:
+            # an explicit accumulator write (restore/reset) superseded the
+            # adopted plan: the per-shard buffers are stale — shut its
+            # fold pool (only this producer folds into it, so nothing is
+            # in flight) and rebuild
+            plan.close()
+            plan = None
         if plan is None:
             from .shards import ShardPlan
 
             # built outside the lock (device work); the single producer is
-            # the only creator, the lock just publishes the reference
+            # the only creator, the lock just publishes the reference.
+            # The plan is ADOPTED by the aggregator (reduce-scatter): it
+            # persists across drain windows as the authoritative
+            # accumulator, so the per-drain reassemble+decompose round
+            # trip is gone — the only gathers left are explicit acc reads
             plan = ShardPlan(agg, shard_threads=self._shard_threads)
+            agg.adopt_plan(plan)
             with self._lock:
                 self._plan = plan
         return plan
@@ -960,10 +1035,16 @@ class StreamingAggregator:
             if ring is None:
                 agg = self.agg
                 width = agg.padded_length // self._n_shards
+                if self._packed:
+                    shape: tuple = (self.max_batch, agg.packed_width, width)
+                    dtype = np.uint8
+                else:
+                    shape = (self.max_batch, agg.n_limbs, width)
+                    dtype = np.uint32
                 ring = self._shard_rings[d] = _StagingRing(
                     self.staging_buffers,
-                    (self.max_batch, agg.n_limbs, width),
-                    np.uint32,
+                    shape,
+                    dtype,
                     gauge=SHARD_STAGING_DEPTH.labels(shard=str(d)),
                 )
             return ring
@@ -1014,8 +1095,11 @@ class StreamingAggregator:
             return full
 
         plan = self._ensure_plan(k, calib)
+        from ..ops import limbs as host_limbs
+
+        kind = "packed" if self._packed else "planar"
         self._batch_seq += 1
-        job = _BatchJob("planar", k, ticket, self._batch_seq, self._n_shards)
+        job = _BatchJob(kind, k, ticket, self._batch_seq, self._n_shards)
         items = []
         for d, (lo, hi) in enumerate(plan.slices):
             t0 = time.monotonic()
@@ -1024,9 +1108,21 @@ class StreamingAggregator:
             view = buf[:k]
             real_hi = min(hi, model_len)
             if lo < real_hi:
-                view[:, :, : real_hi - lo] = stack[:, lo:real_hi, :].transpose(0, 2, 1)
+                if self._packed:
+                    # pack this shard's wire slice straight into its
+                    # byte-planar ring buffer (the native plane-pack
+                    # kernel: bpn/(4L) of the bytes the planar transpose
+                    # would write, at memcpy speed)
+                    host_limbs.pack_wire_slice(
+                        stack, lo, real_hi, self.agg.packed_width, view
+                    )
+                else:
+                    view[:, :, : real_hi - lo] = stack[:, lo:real_hi, :].transpose(0, 2, 1)
             if real_hi < hi:
                 view[:, :, max(0, real_hi - lo):] = 0  # padding columns
+            BYTES_STAGED.labels(
+                layout="packed" if self._packed else "unpacked"
+            ).inc(view.nbytes)
             dt = time.monotonic() - t0
             with self._lock:
                 self._stage_seconds += dt
@@ -1043,8 +1139,11 @@ class StreamingAggregator:
         once per shard, copied into that shard's ring buffer)."""
         ticket = StreamTicket(k)
         plan = self._ensure_plan(k, lambda: np.stack([np.asarray(r) for r in rows]))  # host rows  # lint: sync-ok
+        from ..ops import limbs as host_limbs
+
+        kind = "packed" if self._packed else "planar"
         self._batch_seq += 1
-        job = _BatchJob("planar", k, ticket, self._batch_seq, self._n_shards)
+        job = _BatchJob(kind, k, ticket, self._batch_seq, self._n_shards)
         items = []
         for d, (lo, hi) in enumerate(plan.slices):
             t0 = time.monotonic()
@@ -1052,7 +1151,15 @@ class StreamingAggregator:
             buf = ring.acquire()
             view = buf[:k]
             for i, row in enumerate(rows):
-                np.copyto(view[i], row[:, lo:hi])
+                if self._packed:
+                    host_limbs.pack_planar_slice(
+                        np.asarray(row), lo, hi, self.agg.packed_width, view[i]  # host rows  # lint: sync-ok
+                    )
+                else:
+                    np.copyto(view[i], row[:, lo:hi])
+            BYTES_STAGED.labels(
+                layout="packed" if self._packed else "unpacked"
+            ).inc(view.nbytes)
             dt = time.monotonic() - t0
             with self._lock:
                 self._stage_seconds += dt
@@ -1217,16 +1324,25 @@ class StreamingAggregator:
                 piece = np.asarray(piece)  # lint: sync-ok
             plan.fold_shard(d, piece)
             return
+        packed = job.kind == "packed"
         if plan.native:
-            plan.fold_shard(d, payload)
+            if packed:
+                plan.fold_shard_packed(d, payload)
+            else:
+                plan.fold_shard(d, payload)
             return
         import jax
 
         with plan._device_dispatch_lock:
             # host-side transfer enqueue only — the copy itself proceeds
-            # async and the barrier below stays outside the lock
+            # async and the barrier below stays outside the lock (packed
+            # staging: only bpn-byte planes cross here, the unpack runs
+            # in-graph on the shard's device)
             staged = jax.device_put(payload, plan.devices[d])
-        plan.fold_shard(d, staged)
+        if packed:
+            plan.fold_shard_packed(d, staged)
+        else:
+            plan.fold_shard(d, staged)
         try:
             # the per-shard transfer out of the ring buffer must complete
             # before reuse; the fold itself stays in flight behind it
@@ -1332,7 +1448,7 @@ class StreamingAggregator:
                 job.failed = True
             job.remaining -= 1
             last = job.remaining == 0
-            if last and job.kind == "planar":
+            if last and job.kind != "wire":  # planar AND packed batches
                 self._in_flight_models -= job.k
                 if not job.failed:
                     self.agg.nb_models += job.k
@@ -1475,12 +1591,10 @@ class StreamingAggregator:
             with self._lock:
                 self.agg.nb_models += accepted
                 self._in_flight_models -= sum(t.k for t in pending)
-        if plan is not None:
-            # publish the per-shard accumulators back as the global acc;
-            # the next submit re-decomposes (zero-copy for device plans)
-            self.agg.acc = plan.reassemble()
-            plan.close()
-            with self._lock:
-                self._plan = None
+        # reduce-scatter: the plan PERSISTS across drain windows — the
+        # per-shard accumulators stay authoritative (agg.acc reads
+        # reassemble on demand; unmask subtracts per shard). The old
+        # reassemble-here / re-decompose-next-window round trip (two full
+        # accumulator copies per drain on native plans) is gone.
         self._publish_overlap()
         return accepted

@@ -137,6 +137,7 @@ class StagedAggregator:
         staging_buffers: int = 3,
         shard_parallel: bool = True,
         shard_threads: int = 0,
+        packed_staging: bool = True,
     ):
         self.config = config
         self.object_size = object_size
@@ -167,6 +168,7 @@ class StagedAggregator:
                 max_batch=self.batch_size,
                 shard_parallel=shard_parallel,
                 shard_threads=shard_threads,
+                packed=packed_staging,
             )
             # tiny unit part stays on host
             self._unit_acc = np.zeros(

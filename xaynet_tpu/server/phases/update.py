@@ -74,6 +74,7 @@ class UpdatePhase(PhaseState):
             staging_buffers=settings.aggregation.staging_buffers,
             shard_parallel=settings.aggregation.shard_parallel,
             shard_threads=settings.aggregation.shard_threads,
+            packed_staging=settings.aggregation.packed_staging,
         )
         self._seed_dict = None
         self._resumed_models = 0

@@ -108,9 +108,19 @@ class MaskSettings:
     data_type: DataType = DataType.F32
     bound_type: BoundType = BoundType.B0
     model_type: ModelType = ModelType.M3
+    # pre-mask quantization level (docs/DESIGN.md §17): level q divides the
+    # fixed-point scale by 10^q, shrinking the group order — and with it
+    # limb count, wire width, and every mask/fold/transfer byte — at the
+    # price of 10^q coarser weights. 0 = the exact catalogue config. The
+    # level rides in the round params' mask-config bytes, so participants
+    # follow automatically; gate accuracy per workload (the cifar_lenet
+    # example carries the reference gate).
+    quant: int = 0
 
     def to_config(self) -> MaskConfig:
-        return MaskConfig(self.group_type, self.data_type, self.bound_type, self.model_type)
+        return MaskConfig(
+            self.group_type, self.data_type, self.bound_type, self.model_type, self.quant
+        )
 
 
 @dataclass
@@ -209,6 +219,12 @@ class AggregationSettings:
     # splits the process-wide budget (XAYNET_NATIVE_THREADS / 2x cores)
     # across the shards; > 0 pins threads per shard
     shard_threads: int = 0
+    # packed byte-planar staging (docs/DESIGN.md §17): planar update
+    # batches stage as ceil(log2(order)/8)-byte planes instead of full
+    # uint32 limb planes — bpn/(4L) of the ring memory and host->device
+    # bytes (75% for the standard 2-limb f32 configs), byte-identical
+    # aggregate. Auto-skipped when the order fills its limbs exactly
+    packed_staging: bool = True
     # device wire ingest (requires device=true): Update masked models are
     # parsed LAZILY (raw element block kept), and unpack + per-update
     # element validity + fold all run on the accelerator — the coordinator
@@ -436,6 +452,10 @@ class Settings:
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
+        try:
+            self.mask.to_config()  # quant level vs data/bound-type ceiling
+        except ValueError as e:
+            raise SettingsError(f"mask.quant: {e}") from e
         self.ingest.validate()
         self.resilience.validate()
         self.liveness.validate()
@@ -559,6 +579,7 @@ class Settings:
                 data_type=_enum(DataType, mask_raw.get("data_type", "f32")),
                 bound_type=_enum(BoundType, mask_raw.get("bound_type", "b0")),
                 model_type=_enum(ModelType, mask_raw.get("model_type", "m3")),
+                quant=int(mask_raw.get("quant", base.mask.quant)),
             ),
             model=ModelSettings(length=int(model_raw.get("length", base.model.length))),
             api=ApiSettings(
@@ -611,6 +632,9 @@ class Settings:
                 ),
                 shard_threads=int(
                     agg_raw.get("shard_threads", base.aggregation.shard_threads)
+                ),
+                packed_staging=bool(
+                    agg_raw.get("packed_staging", base.aggregation.packed_staging)
                 ),
             ),
             ingest=IngestSettings(

@@ -78,6 +78,27 @@ def _streaming_snapshot() -> Optional[dict]:
     return out
 
 
+def _bytes_counters() -> dict[str, dict[str, float]]:
+    """Cumulative staged/reduced byte counters from the registry, keyed
+    ``{series: {label_value: total}}`` (packed-reduction observability,
+    docs/DESIGN.md §17)."""
+    from .registry import get_registry
+
+    reg = get_registry()
+    out: dict[str, dict[str, float]] = {}
+    for name, short in (
+        ("xaynet_bytes_staged_total", "staged"),
+        ("xaynet_bytes_reduced_total", "reduced"),
+    ):
+        family = reg.get(name)
+        if family is None:
+            continue
+        series = {key[0]: child.value for key, child in family.children()}
+        if series:
+            out[short] = series
+    return out
+
+
 class RoundReporter:
     """Accumulates one round's telemetry and writes it as a JSON line."""
 
@@ -87,6 +108,10 @@ class RoundReporter:
         self._lock = threading.Lock()
         self._round_id: Optional[int] = None
         self._started: float = 0.0
+        # previous cumulative byte-counter sample: the report carries
+        # per-round DELTAS (bytes moved during this round), not process
+        # totals
+        self._bytes_prev: dict[str, dict[str, float]] = {}
         self._reset()
 
     def _reset(self) -> None:
@@ -150,6 +175,22 @@ class RoundReporter:
         streaming = _streaming_snapshot()
         if streaming is not None:
             report["streaming"] = streaming
+        current = _bytes_counters()
+        deltas = {
+            short: {
+                label: int(total - self._bytes_prev.get(short, {}).get(label, 0.0))
+                for label, total in series.items()
+                if total - self._bytes_prev.get(short, {}).get(label, 0.0) > 0
+            }
+            for short, series in current.items()
+        }
+        deltas = {k: v for k, v in deltas.items() if v}
+        if deltas:
+            # bytes moved THIS round on the staging (packed/unpacked/wire
+            # layouts) and cross-shard combine (scatter/gather) paths —
+            # the per-round view of the packed-reduction counters (§17)
+            report["bytes"] = deltas
+        self._bytes_prev = current
         calibrations = drain_mask_calibrations()
         if calibrations:
             # auto-calibration verdicts that landed during this round:

@@ -18,7 +18,7 @@ logger = logging.getLogger("xaynet.native")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxaynet_native.so")
 
-_ABI_VERSION = 7
+_ABI_VERSION = 8
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -123,6 +123,44 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint32,  # n_threads (0 = process default)
         ]
         lib.xn_fold_planar_u64_strided.restype = None
+        # packed byte-planar fold (ABI 8): the staged batch arrives as
+        # uint8[K, bpn, n] byte planes (ops/limbs.py pack_planar) and folds
+        # into the planar u32 accumulator without ever unpacking
+        lib.xn_fold_packed_u64_strided.argtypes = [
+            u32p,
+            u8p,
+            u32p,
+            ctypes.c_uint64,  # width
+            ctypes.c_uint64,  # acc/out plane stride (elements)
+            ctypes.c_uint64,  # packed byte-plane stride (bytes)
+            ctypes.c_uint64,  # packed batch (update) stride (bytes)
+            ctypes.c_uint32,  # n_limbs
+            ctypes.c_uint32,  # bpn
+            ctypes.c_uint64,  # k
+            u32p,
+            ctypes.c_uint32,  # n_threads (0 = process default)
+        ]
+        lib.xn_fold_packed_u64_strided.restype = None
+        lib.xn_pack_wire_planes.argtypes = [
+            u32p,
+            ctypes.c_uint64,  # n elements
+            ctypes.c_uint32,  # n_limbs (element stride in u32)
+            ctypes.c_uint32,  # bpn
+            u8p,
+            ctypes.c_uint64,  # out plane stride (bytes)
+            ctypes.c_uint32,  # n_threads (0 = process default)
+        ]
+        lib.xn_pack_wire_planes.restype = None
+        lib.xn_pack_planar_planes.argtypes = [
+            u32p,
+            ctypes.c_uint64,  # n elements
+            ctypes.c_uint64,  # input plane stride (u32 elements)
+            ctypes.c_uint32,  # bpn
+            u8p,
+            ctypes.c_uint64,  # out plane stride (bytes)
+            ctypes.c_uint32,  # n_threads
+        ]
+        lib.xn_pack_planar_planes.restype = None
         lib.xn_fold_threads.argtypes = []
         lib.xn_fold_threads.restype = ctypes.c_uint32
         lib.xn_mod_sub.argtypes = [u32p, u32p, u32p, ctypes.c_uint64, ctypes.c_uint32, u32p]
@@ -208,6 +246,15 @@ def np_u32p(arr):
 
 def np_u64p(arr):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def np_u8p_at(arr, byte_offset: int):
+    """Pointer to ``arr``'s buffer offset by ``byte_offset`` bytes (the
+    packed-plane twin of :func:`np_u32p_at`)."""
+    return ctypes.cast(
+        ctypes.c_void_p(arr.ctypes.data + byte_offset),
+        ctypes.POINTER(ctypes.c_uint8),
+    )
 
 
 def np_u32p_at(arr, element_offset: int):
