@@ -1,0 +1,176 @@
+"""Runtime secret redaction (ISSUE 14, docs/DESIGN.md §18).
+
+The static taint pass proves no key material FLOWS into telemetry at lint
+time; this file covers the runtime complement: ``telemetry.redact()``
+(the sanctioned length/type-only projection), the ``scrub_attrs``
+deny-list filter, its wiring into flight-recorder dumps and Chrome-trace
+exports (defense-in-depth for values that become secret only
+dynamically), the ``xaynet_redactions_total`` metric, and regression
+pins for the sanctioned durable-state flows the pass suppresses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from xaynet_tpu.telemetry import recorder as recorder_mod  # noqa: E402
+from xaynet_tpu.telemetry import tracing  # noqa: E402
+from xaynet_tpu.telemetry.redact import redact, scrub_attrs  # noqa: E402
+from xaynet_tpu.telemetry.registry import get_registry  # noqa: E402
+
+S_REDACT = tracing.declare_span("test.redact")
+
+
+def _redactions(site: str) -> float:
+    return get_registry().sample_value(
+        "xaynet_redactions_total", labels={"site": site}
+    ) or 0.0
+
+
+# --- redact() ---------------------------------------------------------------
+
+
+def test_redact_is_length_type_digest_only():
+    secret = os.urandom(32)
+    out = redact(secret)
+    assert secret.hex() not in out
+    assert "bytes:32" in out
+    # the sha256 prefix correlates two mentions of the same secret
+    assert out == redact(secret)
+    assert out != redact(os.urandom(32))
+
+
+def test_redact_handles_strings_and_counts():
+    before = _redactions("redact")
+    out = redact("super-secret-token")
+    assert "super-secret-token" not in out
+    assert "str:18" in out
+    assert _redactions("redact") == before + 1
+
+
+# --- scrub_attrs ------------------------------------------------------------
+
+
+def test_scrub_attrs_denies_secret_keys_and_keeps_the_rest():
+    seed = os.urandom(32).hex()
+    attrs = {
+        "mask_seed": seed,
+        "round_seed": seed,
+        "secret_key": seed,
+        "edge_token": "hunter2",
+        "keystream_bytes": seed,
+        "private_half": seed,
+        "sk": seed,
+        "key_bytes": seed,
+        "batch": 42,
+        "outcome": "folded",
+        "edge_id": "edge-7",
+    }
+    out = scrub_attrs(attrs, "flight")
+    blob = json.dumps(out)
+    assert seed not in blob and "hunter2" not in blob
+    # shape preserved, non-denied values untouched
+    assert out["batch"] == 42
+    assert out["outcome"] == "folded"
+    assert out["edge_id"] == "edge-7"
+    assert set(out) == set(attrs)
+
+
+def test_scrub_attrs_recurses_into_nested_containers():
+    seed = os.urandom(16).hex()
+    attrs = {"ring": [{"attrs": {"seed": seed, "n": 1}}], "meta": {"token": seed}}
+    out = scrub_attrs(attrs, "trace")
+    blob = json.dumps(out)
+    assert seed not in blob
+    assert out["ring"][0]["attrs"]["n"] == 1
+
+
+# --- flight-recorder dumps are scrubbed before disk -------------------------
+
+
+def test_flight_dump_scrubs_secret_keyed_attrs(tmp_path, monkeypatch):
+    monkeypatch.setattr(recorder_mod, "_recorder", None)
+    monkeypatch.setenv("XAYNET_FLIGHT_DIR", str(tmp_path))
+    rec = recorder_mod.get_recorder()
+    tracer = tracing.get_tracer()
+    tracer.begin_round(7, tracing.new_id())
+    seed = os.urandom(32).hex()
+    # a ring span carrying a secret-keyed attr (what static analysis
+    # cannot see when the value arrived off the wire)
+    with tracer.span(S_REDACT, mask_seed=seed, batch=3):
+        pass
+    before = _redactions("flight")
+    path = rec.dump("pipeline-poison", "batch 3 poisoned", round_seed=seed, batch=3)
+    assert path is not None
+    raw = Path(path).read_text()
+    assert seed not in raw, "secret bytes reached the flight dump"
+    bundle = json.loads(raw)
+    assert bundle["attrs"]["batch"] == 3
+    assert bundle["attrs"]["round_seed"].startswith("<redacted ")
+    ring = [s for s in bundle["ring"] if s["name"] == "test.redact"]
+    assert ring and ring[0]["attrs"]["mask_seed"].startswith("<redacted ")
+    assert ring[0]["attrs"]["batch"] == 3
+    assert _redactions("flight") > before
+    tracer.end_round()
+
+
+# --- Chrome-trace exports are scrubbed before disk --------------------------
+
+
+def test_chrome_trace_export_scrubs_span_attrs():
+    span = tracing.Span("test.redact", "t" * 16, "s" * 16, None, 0.0, {})
+    seed = os.urandom(32).hex()
+    span.attrs = {"secret_key": seed, "members": 5}
+    before = _redactions("trace")
+    doc = tracing.to_chrome_trace([span])
+    blob = json.dumps(doc)
+    assert seed not in blob
+    event = next(e for e in doc["traceEvents"] if e.get("name") == "test.redact")
+    assert event["args"]["members"] == 5
+    assert event["args"]["secret_key"].startswith("<redacted ")
+    # identity args (trace/span ids) are not key material and survive
+    assert event["args"]["trace"] == "t" * 16
+    assert _redactions("trace") > before
+
+
+# --- the sanctioned durable-state flows stay functional ---------------------
+
+
+def test_coordinator_state_blob_still_carries_the_round_key():
+    """Regression for the `# lint: taint-ok` on CoordinatorState.to_bytes:
+    the suppression documents a SANCTIONED flow — a restarted coordinator
+    must recover the round's secret key from its own durable store, so the
+    blob must keep carrying it (redacting there would brick restore)."""
+    from xaynet_tpu.core.common import RoundParameters, RoundSeed
+    from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair
+    from xaynet_tpu.core.mask.config import (
+        BoundType, DataType, GroupType, MaskConfig, ModelType,
+    )
+    from xaynet_tpu.server.coordinator import CoordinatorState
+
+    keys = EncryptKeyPair.generate()
+    config = MaskConfig(
+        GroupType.INTEGER, DataType.F32, BoundType.B0, ModelType.M3
+    ).pair()
+    state = CoordinatorState(
+        keys=keys,
+        round_id=3,
+        round_params=RoundParameters(
+            pk=keys.public.as_bytes(),
+            sum=0.5,
+            update=0.5,
+            seed=RoundSeed.generate(),
+            mask_config=config,
+            model_length=4,
+        ),
+    )
+    restored = CoordinatorState.from_bytes(state.to_bytes())
+    assert restored.keys.secret.as_bytes() == keys.secret.as_bytes()
+    assert restored.round_params == state.round_params

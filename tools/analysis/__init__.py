@@ -11,7 +11,11 @@ per-file rules (:mod:`filerules`) and four deep passes:
   workers), closing the name-prefix heuristics' false negatives;
 - :mod:`invariants` — sanctioned mutation sites of ``nb_models`` and the
   per-edge seed watermark;
-- :mod:`metricscheck` — code <-> docs/DESIGN.md metric-table parity.
+- :mod:`metricscheck` — code <-> docs/DESIGN.md metric-table parity;
+- :mod:`spans` — span discipline + docs/DESIGN.md §16 span-table parity;
+- :mod:`taint` — interprocedural secret-flow analysis: key material never
+  reaches logs, span attrs, metric labels, JSON dumps, flight-recorder
+  payloads or raised exception messages (docs/DESIGN.md §18).
 
 ``tools/lint.py`` remains the CLI (tier-1/CI invocation unchanged);
 docs/DESIGN.md §14 documents conventions and how to add a rule.

@@ -105,6 +105,16 @@ rule(
     "tracing span() not used as a context manager, span name declared "
     "twice / undeclared, or code <-> DESIGN.md §16 span-table drift",
 )
+rule(
+    "taint",
+    "secret-flow: key material (mask seeds, keypair secret halves, ChaCha "
+    "keystreams, the edge token) reaching an observability or persistence "
+    "sink (logs, span attrs, metric labels, JSON dumps/reports/checkpoints, "
+    "flight-recorder payloads, exception messages) without passing a "
+    "declassifier (seal/encrypt, sha256, len/type, telemetry.redact) — "
+    "docs/DESIGN.md §18",
+    rationale_required=True,
+)
 
 
 def suppressed(rule_name: str, line: str) -> bool:

@@ -31,7 +31,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from . import filerules, invariants, locks, metricscheck, purity, spans
+from . import filerules, invariants, locks, metricscheck, purity, spans, taint
 from .cache import ResultCache, SourceCache
 from .callgraph import CallGraph, SymbolTable
 from .core import Baseline, Finding
@@ -185,6 +185,7 @@ class Analyzer:
         findings.extend(locks.run(graph))
         findings.extend(purity.run(graph))
         findings.extend(invariants.run(graph))
+        findings.extend(taint.run(graph, design))
         findings.extend(metricscheck.run(infos, design))
         findings.extend(spans.run(infos, design))
         self.results.put_project(tree_key, findings)
@@ -284,7 +285,16 @@ def run(
 def main(argv: list[str], repo: Path) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/lint.py",
-        description="pass-based static analysis gate (tools/analysis/)",
+        description=(
+            "pass-based static analysis gate (tools/analysis/): per-file "
+            "hygiene rules plus the cross-file deep passes — lock "
+            "discipline, host-sync purity, accounting invariants, "
+            "metrics/span DESIGN parity, and the secret-flow taint pass "
+            "(rule 'taint': key material must not reach logs, span attrs, "
+            "metric labels, JSON dumps, flight-recorder payloads or raised "
+            "exception messages; suppress with '# lint: taint-ok: "
+            "<rationale>' — docs/DESIGN.md §18)"
+        ),
     )
     ap.add_argument("paths", nargs="*", help="files/dirs (default: the repo tree)")
     ap.add_argument(

@@ -1,0 +1,869 @@
+"""Interprocedural secret-flow taint pass (rule ``taint``).
+
+Xaynet's value proposition is that the coordinator never *sees* an
+individual model or mask seed — yet the observability surface (structured
+logs, span attributes, flight-recorder dumps, per-round JSON reports,
+durable checkpoints, exception messages) grew for three PRs with no tool
+auditing it for secret leakage. This pass makes the invariant
+machine-checked (docs/DESIGN.md §18):
+
+- a **source registry** marks the secret producers: ``MaskSeed``
+  construction/generation, the ``.secret`` half of
+  ``EncryptKeyPair``/``SigningKeyPair``, ``SecretEncryptKey``, ChaCha
+  keys/keystreams (``keystream_blocks``/``ChaChaStream``), seeded
+  samplers (``StreamSampler``), ``PetSettings.mask_seed`` (the
+  ``mask_seed`` attribute), key-derivation seeds (``generate_seed``) and
+  the ``[edge]`` shared ``token``;
+- taint propagates through assignments, containers, f-strings/format
+  arithmetic, comprehensions and **function boundaries**: every function
+  gets a summary (which params reach which sinks, what the return value
+  carries) computed to a fixed point over the PR-9 call graph, with
+  attr-level tracking for secret-bearing containers (``self.seeds[pk] =
+  ...`` taints the attribute for the whole class, across methods);
+- a **declassifier set** terminates flows: sealing (``encrypt``),
+  hashing (``sha256``), signatures, length/type-only projections
+  (``len``/``type``/``bool``), comparisons, and ``telemetry.redact()``
+  (``scrub_attrs`` is deliberately NOT one — it only redacts deny-listed
+  keys, so taint under other keys must keep flowing);
+- a **sink registry** turns surviving flows into findings: logging
+  calls, span attributes (``span(..., k=v)`` / ``handle.set(k=v)`` /
+  ``record_span``), metric label values (``.labels(...)``), flight
+  recorder payloads (``flight_dump``), serialized JSON dumps
+  (``json.dump``/``dumps`` — round reports, checkpoint headers, durable
+  state blobs), and exception messages raised under
+  ``xaynet_tpu/{server,sdk,edge}/``.
+
+Suppression is ``# lint: taint-ok: <rationale>`` (a bare marker does NOT
+suppress). It works at two points: on the **sink** line (silences that
+finding) and on the **source** line — a suppressed source is a sanctioned
+declassification boundary, so the value's onward flow stops being tracked
+(e.g. the coordinator's durable-state blob legitimately carries the round
+secret key; suppressing the ``.secret`` read there keeps every downstream
+store write clean instead of demanding a cascade of suppressions).
+
+The source/declassifier/sink registries are cross-checked against the
+marker-delimited tables in docs/DESIGN.md §18, both directions — the
+metrics-table parity idiom applied to the taint model.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .callgraph import CallGraph, FuncInfo, _is_self, iter_owned_nodes
+from .core import Finding, suppressed, suppression_pending_rationale
+
+# --- registries (docs/DESIGN.md §18 mirrors these, machine-checked) ---------
+
+# callee simple name (or CapWord receiver of a classmethod call) -> token
+SOURCE_CALLS: dict[str, str] = {
+    "MaskSeed": "mask-seed",
+    "SecretEncryptKey": "secret-encrypt-key",
+    "StreamSampler": "seeded-sampler",
+    "ChaChaStream": "chacha-keystream",
+    "keystream_blocks": "chacha-keystream",
+    "generate_seed": "key-seed",
+}
+
+# attribute name read anywhere -> token (the secret halves / injected seeds)
+SOURCE_ATTRS: dict[str, str] = {
+    "secret": "keypair-secret-half",
+    "mask_seed": "mask-seed-setting",
+    "token": "edge-token",
+}
+
+# callee simple names that TERMINATE a flow (seal, hash, sign, project)
+DECLASSIFIERS = frozenset(
+    {
+        "encrypt",          # sealed-box seal: ciphertext is publishable
+        "sha256",           # digests don't reveal key material
+        "sign",             # Ed25519 signatures are published by protocol
+        "sign_detached",
+        "is_eligible",
+        "compare_digest",   # constant-time comparison -> bool
+        "public_key",       # secret -> public half
+        "x25519_public",
+        "ed25519_public",
+        "round_trace_id",   # sha256-derived public correlation id
+        "len",              # length/type-only projections
+        "type",
+        "bool",
+        "redact",           # telemetry.redact(): the sanctioned projection
+        # NOT scrub_attrs: it only redacts deny-listed KEYS, so a tainted
+        # value under a non-denied key passes through verbatim — modeling
+        # it as a declassifier would declare that leak clean
+    }
+)
+
+SINK_TOKENS = (
+    "log-call",
+    "span-attr",
+    "metric-label",
+    "flight-dump",
+    "serialized-dump",
+    "exception-message",
+)
+
+_SRC_DESC = {
+    "mask-seed": "mask seed material",
+    "mask-seed-setting": "the injected mask_seed setting",
+    "keypair-secret-half": "a keypair's secret half",
+    "secret-encrypt-key": "a secret encryption key",
+    "seeded-sampler": "seeded keystream-sampler output",
+    "chacha-keystream": "raw ChaCha keystream",
+    "key-seed": "key-derivation seed bytes",
+    "edge-token": "the [edge] shared token",
+}
+
+_SINK_DESC = {
+    "log-call": "a logging call",
+    "span-attr": "a tracing span attribute",
+    "metric-label": "a metric label value",
+    "flight-dump": "a flight-recorder dump payload",
+    "serialized-dump": "a serialized JSON dump (report/checkpoint/state blob)",
+    "exception-message": "an exception message",
+}
+
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "error", "exception", "critical", "log"}
+)
+_LOG_RECEIVERS = frozenset({"logger", "logging", "log"})
+
+# exception messages are a sink only where an attacker/operator-facing
+# surface raises them (ISSUE 14): the coordinator, the SDK and the edge
+_RAISE_SINK_TREES = ("xaynet_tpu/server/", "xaynet_tpu/sdk/", "xaynet_tpu/edge/")
+
+_MAX_LABELS = 12   # per-expression cap: beyond this the signal is "everything"
+_MAX_HOPS = 6      # reported path depth cap
+_MAX_ITERS = 10    # global fixed-point safety bound
+
+# --- labels ------------------------------------------------------------------
+# Src label:   ("src", token, rel)  — rel names the file the secret came from.
+# Param label: ("param", func_uid, index)
+#
+# Labels deliberately carry NO path: the taint lattice must be finite for
+# the fixed point to converge (path-carrying labels mint a fresh label per
+# distinct call chain and never saturate on cyclic graphs). Call-chain hops
+# are recorded as the FIRST-SEEN value on sink-flow entries instead — they
+# decorate the finding message without participating in set identity.
+
+
+def _src(token: str, rel: str) -> tuple:
+    return ("src", token, rel)
+
+
+class Summary:
+    """Per-function taint summary, grown monotonically to a fixed point."""
+
+    __slots__ = ("ret", "sinks", "attr_writes")
+
+    def __init__(self):
+        self.ret: set[tuple] = set()
+        # param index -> {(sink_token, sink_rel): first-seen hop chain}
+        self.sinks: dict[int, dict[tuple[str, str], tuple]] = {}
+        # param index -> {(class_name, attr)} — caller taint lands on an attr
+        self.attr_writes: dict[int, set[tuple[str, str]]] = {}
+
+    def size(self) -> tuple[int, int, int]:
+        return (
+            len(self.ret),
+            sum(len(v) for v in self.sinks.values()),
+            sum(len(v) for v in self.attr_writes.values()),
+        )
+
+
+def _callee_parts(node: ast.Call) -> tuple[Optional[str], Optional[ast.expr]]:
+    """(simple callee name, receiver expr or None)."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id, None
+    if isinstance(func, ast.Attribute):
+        return func.attr, func.value
+    return None, None
+
+
+def _param_names(fn_node) -> list[str]:
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return []
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names.extend(a.arg for a in args.kwonlyargs)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+class TaintPass:
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.symbols = graph.symbols
+        self.summaries: dict[str, Summary] = {
+            fi.uid: Summary() for fi in self.symbols.functions
+        }
+        # (class simple name, attr) -> set of Src labels
+        self.attr_taint: dict[tuple[str, str], set[tuple]] = {}
+        # (class simple name, attr) -> uids that read it (worklist deps)
+        self._attr_readers: dict[tuple[str, str], set[str]] = {}
+        self.findings: dict[tuple, Finding] = {}
+        self._changed = False
+        self._grew_attrs: set[tuple[str, str]] = set()
+
+    # -- suppression helpers ----------------------------------------------
+
+    def _line_suppressed(self, fi: FuncInfo, lineno: int) -> bool:
+        return suppressed("taint", fi.file.line(lineno))
+
+    def _note_pending_rationale(self, fi: FuncInfo, lineno: int) -> None:
+        if suppression_pending_rationale("taint", fi.file.line(lineno)):
+            key = (fi.file.rel, lineno, "pending")
+            self.findings.setdefault(
+                key,
+                Finding(
+                    "taint",
+                    fi.file.rel,
+                    lineno,
+                    "taint suppression present but missing its rationale — "
+                    "'# lint: taint-ok: <why this flow is sanctioned>'",
+                ),
+            )
+
+    # -- findings ----------------------------------------------------------
+
+    def _report(
+        self,
+        fi: FuncInfo,
+        lineno: int,
+        label: tuple,
+        sink_token: str,
+        sink_rel: str,
+        extra_hops: tuple = (),
+    ) -> None:
+        if self._line_suppressed(fi, lineno):
+            return
+        self._note_pending_rationale(fi, lineno)
+        hops = extra_hops[:_MAX_HOPS]
+        path = f" via {' -> '.join(hops)}" if hops else ""
+        where = "" if sink_rel == fi.file.rel else f" in {sink_rel}"
+        msg = (
+            f"secret flow: {_SRC_DESC.get(label[1], label[1])} "
+            f"(source: {label[2]}) reaches {_SINK_DESC.get(sink_token, sink_token)}"
+            f"{where} from '{fi.qualname}'{path} — seal/hash the value, keep a "
+            "length/type-only projection, route it through telemetry.redact(), "
+            "or annotate '# lint: taint-ok: <rationale>'"
+        )
+        key = (fi.file.rel, lineno, label[1], sink_token, hops)
+        if key not in self.findings:
+            self.findings[key] = Finding("taint", fi.file.rel, lineno, msg)
+
+    # -- per-function analysis --------------------------------------------
+
+    def analyze(self, fi: FuncInfo) -> tuple[bool, set[tuple[str, str]]]:
+        """One (re-)analysis of ``fi``; returns (summary grew, attr keys
+        whose global taint grew) so the worklist can requeue dependents."""
+        summary = self.summaries[fi.uid]
+        before = summary.size()
+        self._grew_attrs = set()
+        params = _param_names(fi.node)
+        env: dict[str, set[tuple]] = {
+            name: {("param", fi.uid, i)} for i, name in enumerate(params)
+        }
+        self._fi = fi
+        self._env = env
+        self._summary = summary
+
+        # two binding sweeps: flow-insensitive, but later-defined helpers /
+        # out-of-order reads stabilize on the second sweep
+        for _ in range(2):
+            for node in iter_owned_nodes(fi.node):
+                self._bind(node, record=False)
+        # final sweep records attr stores, sinks, returns
+        for node in iter_owned_nodes(fi.node):
+            self._bind(node, record=True)
+            if isinstance(node, ast.Return) and node.value is not None:
+                summary.ret |= self._cap(self.eval(node.value))
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                if fi.file.rel.startswith(_RAISE_SINK_TREES):
+                    exc = node.exc
+                    taint: set[tuple] = set()
+                    if isinstance(exc, ast.Call):
+                        # the message args, directly: the CapWord
+                        # constructor rule would drop positional taint
+                        for a in exc.args:
+                            taint |= self.eval(a)
+                        for kw in exc.keywords:
+                            taint |= self.eval(kw.value)
+                    else:
+                        taint = self.eval(exc)
+                    self._sink_value(taint, "exception-message", node.lineno)
+            elif isinstance(node, ast.Call):
+                self.eval(node)  # standalone/nested calls: sink detection
+
+        grew = summary.size() != before
+        if grew:
+            self._changed = True
+        return grew, self._grew_attrs
+
+    def _cap(self, labels: set[tuple]) -> set[tuple]:
+        if len(labels) <= _MAX_LABELS:
+            return labels
+        return set(sorted(labels)[:_MAX_LABELS])
+
+    def _bind(self, node, record: bool) -> None:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets, value = [node.target], node.iter
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    t = self.eval(item.context_expr)
+                    self._assign(item.optional_vars, t, record)
+            return
+        elif isinstance(node, ast.Call) and record:
+            # container mutation: self.X.append(secret) / env var likewise
+            name, recv = _callee_parts(node)
+            if name in ("append", "add", "update", "setdefault", "extend") and recv is not None:
+                arg_taint: set[tuple] = set()
+                for a in node.args:
+                    arg_taint |= self.eval(a)
+                for kw in node.keywords:
+                    arg_taint |= self.eval(kw.value)
+                if arg_taint:
+                    self._store_into(recv, arg_taint, node.lineno)
+            return
+        else:
+            return
+        if value is None:
+            return
+        taint = self.eval(value)
+        if isinstance(node, ast.AugAssign):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    taint = taint | self._env.get(t.id, set())
+        for t in targets:
+            self._assign(t, taint, record, lineno=node.lineno)
+
+    def _assign(self, target, taint: set[tuple], record: bool, lineno: int = 0) -> None:
+        if isinstance(target, ast.Name):
+            self._env[target.id] = self._cap(taint | (
+                self._env.get(target.id, set()) if record else set()
+            ))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, taint, record, lineno)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint, record, lineno)
+        elif isinstance(target, ast.Subscript):
+            # d[k] = secret taints the container
+            self._store_into(target.value, taint, lineno)
+        elif isinstance(target, ast.Attribute) and record and taint:
+            self._store_attr(target, taint, lineno)
+
+    def _store_into(self, container, taint: set[tuple], lineno: int) -> None:
+        if not taint:
+            return
+        if isinstance(container, ast.Name):
+            self._env[container.id] = self._cap(
+                self._env.get(container.id, set()) | taint
+            )
+        elif isinstance(container, ast.Attribute):
+            self._store_attr(container, taint, lineno)
+
+    def _store_attr(self, target: ast.Attribute, taint: set[tuple], lineno: int) -> None:
+        """``self.X = secret`` / ``obj.X[k] = secret``: attr-level tracking.
+
+        Src labels land in the global (class, attr) map; Param labels are
+        recorded on the summary so caller-side taint reaches the attr at
+        the call site (the fixed point ripples both onward).
+        """
+        cls = self._recv_class(target.value)
+        if cls is None:
+            return
+        if lineno and self._line_suppressed(self._fi, lineno):
+            return  # sanctioned boundary: the store is declassified
+        key = (cls, target.attr)
+        for label in taint:
+            if label[0] == "src":
+                bucket = self.attr_taint.setdefault(key, set())
+                if label not in bucket:
+                    bucket.add(label)
+                    self._changed = True
+                    self._grew_attrs.add(key)
+            elif label[0] == "param" and label[1] == self._fi.uid:
+                writes = self._summary.attr_writes.setdefault(label[2], set())
+                if key not in writes:
+                    writes.add(key)
+                    self._changed = True
+
+    def _recv_class(self, recv) -> Optional[str]:
+        """Class simple name of an attribute receiver, when known."""
+        if _is_self(recv):
+            return self._fi.cls
+        if isinstance(recv, ast.Name):
+            return self.graph._local_types(self._fi).get(recv.id)
+        if isinstance(recv, ast.Attribute) and _is_self(recv.value) and self._fi.cls:
+            return self.symbols.attr_types.get(
+                (self._fi.file.rel, self._fi.cls), {}
+            ).get(recv.attr)
+        return None
+
+    # -- expression evaluation --------------------------------------------
+
+    def eval(self, node) -> set[tuple]:
+        if node is None or isinstance(node, (ast.Constant, ast.Compare)):
+            return set()  # comparisons are boolean projections
+        if isinstance(node, ast.Name):
+            return self._env.get(node.id, set())
+        if isinstance(node, ast.Attribute):
+            return self._eval_attr(node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.Await):
+            return self.eval(node.value)
+        if isinstance(node, (ast.JoinedStr, ast.BoolOp)):
+            out: set[tuple] = set()
+            for v in node.values:
+                out |= self.eval(v)
+            return self._cap(out)
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._cap(self.eval(node.left) | self.eval(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._cap(self.eval(node.body) | self.eval(node.orelse))
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            out = set()
+            for elt in node.elts:
+                out |= self.eval(elt)
+            return self._cap(out)
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if k is not None:
+                    out |= self.eval(k)
+            for v in node.values:
+                out |= self.eval(v)
+            return self._cap(out)
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            return self._eval_comp(node)
+        if isinstance(node, ast.Slice):
+            return set()
+        if isinstance(node, ast.NamedExpr):
+            t = self.eval(node.value)
+            self._assign(node.target, t, record=False)
+            return t
+        return set()
+
+    def _eval_comp(self, node) -> set[tuple]:
+        saved = dict(self._env)
+        try:
+            for gen in node.generators:
+                t = self.eval(gen.iter)
+                self._assign(gen.target, t, record=False)
+            if isinstance(node, ast.DictComp):
+                return self._cap(self.eval(node.key) | self.eval(node.value))
+            return self.eval(node.elt)
+        finally:
+            self._env = saved
+
+    def _eval_attr(self, node: ast.Attribute) -> set[tuple]:
+        recv_taint = self.eval(node.value)
+        out = set(recv_taint)
+        token = SOURCE_ATTRS.get(node.attr)
+        if token is not None and isinstance(node.ctx, ast.Load):
+            if not self._line_suppressed(self._fi, node.lineno):
+                out.add(_src(token, self._fi.file.rel))
+            else:
+                self._note_pending_rationale(self._fi, node.lineno)
+        cls = self._recv_class(node.value)
+        if cls is not None:
+            key = (cls, node.attr)
+            self._attr_readers.setdefault(key, set()).add(self._fi.uid)
+            out |= self.attr_taint.get(key, set())
+        return self._cap(out)
+
+    def _eval_call(self, node: ast.Call) -> set[tuple]:
+        name, recv = _callee_parts(node)
+
+        # 1) explicit sinks (short-circuit: the API boundary is the sink)
+        if self._explicit_sink(node, name, recv):
+            return set()
+
+        # 2) declassifiers terminate the flow
+        if name in DECLASSIFIERS:
+            return set()
+
+        # 3) sources
+        if name in SOURCE_CALLS or (
+            isinstance(recv, ast.Name) and recv.id in SOURCE_CALLS
+        ):
+            token = SOURCE_CALLS.get(name) or SOURCE_CALLS[recv.id]
+            if self._line_suppressed(self._fi, node.lineno):
+                self._note_pending_rationale(self._fi, node.lineno)
+                return set()
+            return {_src(token, self._fi.file.rel)}
+
+        # 4) resolved project callees: apply summaries
+        callees = self._resolve(node, name, recv)
+        if callees:
+            return self._apply_summaries(node, callees, recv)
+
+        # 5) CapWord constructor of an unresolved class: attr-level only —
+        # whole-object taint through constructors drowns the signal, but a
+        # kwarg like Masker(seed=...) taints that attribute for the class
+        if name and name[:1].isupper():
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                taint = self.eval(kw.value)
+                for label in taint:
+                    if label[0] != "src":
+                        continue
+                    bucket = self.attr_taint.setdefault((name, kw.arg), set())
+                    if label not in bucket:
+                        bucket.add(label)
+                        self._changed = True
+                        self._grew_attrs.add((name, kw.arg))
+            return set()
+
+        # 6) unknown call: conservative union (str(), b"".join, .hex(), ...)
+        out: set[tuple] = set()
+        if recv is not None:
+            out |= self.eval(recv)
+        for a in node.args:
+            out |= self.eval(a)
+        for kw in node.keywords:
+            out |= self.eval(kw.value)
+        return self._cap(out)
+
+    # -- sinks -------------------------------------------------------------
+
+    def _sink_value(self, taint: set[tuple], token: str, lineno: int,
+                    sink_rel: str | None = None, hops: tuple = ()) -> None:
+        for label in taint:
+            if label[0] == "src":
+                self._report(
+                    self._fi, lineno, label, token,
+                    sink_rel or self._fi.file.rel, hops,
+                )
+            elif label[0] == "param" and label[1] == self._fi.uid:
+                flows = self._summary.sinks.setdefault(label[2], {})
+                key = (token, sink_rel or self._fi.file.rel)
+                if key not in flows:
+                    flows[key] = hops
+                    self._changed = True
+
+    @staticmethod
+    def _is_logger_recv(recv) -> bool:
+        """Every logger spelling the tree uses: a bound module-level name
+        (``logger.warning``), the chained form
+        (``logging.getLogger(...).warning``), and a logger attribute
+        (``self.logger.warning``)."""
+        if isinstance(recv, ast.Name):
+            return recv.id in _LOG_RECEIVERS
+        if isinstance(recv, ast.Attribute):
+            return recv.attr in _LOG_RECEIVERS
+        if isinstance(recv, ast.Call):
+            return _callee_parts(recv)[0] == "getLogger"
+        return False
+
+    def _explicit_sink(self, node: ast.Call, name, recv) -> bool:
+        if name in _LOG_METHODS and self._is_logger_recv(recv):
+            taint: set[tuple] = set()
+            for a in node.args:
+                taint |= self.eval(a)
+            for kw in node.keywords:
+                taint |= self.eval(kw.value)
+            self._sink_value(taint, "log-call", node.lineno)
+            return True
+        if name in ("span", "record_span") and recv is not None:
+            for kw in node.keywords:
+                if kw.arg in ("ctx", "link"):
+                    continue
+                self._sink_value(self.eval(kw.value), "span-attr", node.lineno)
+            return False  # positional args (the name) still evaluate normally
+        if name == "set" and recv is not None and node.keywords:
+            # span-handle attrs (gauges/events use positional .set(value))
+            for kw in node.keywords:
+                self._sink_value(self.eval(kw.value), "span-attr", node.lineno)
+            return True
+        if name == "labels" and recv is not None:
+            taint = set()
+            for a in node.args:
+                taint |= self.eval(a)
+            for kw in node.keywords:
+                taint |= self.eval(kw.value)
+            self._sink_value(taint, "metric-label", node.lineno)
+            return True
+        if name == "flight_dump":
+            taint = set()
+            for a in node.args:
+                taint |= self.eval(a)
+            for kw in node.keywords:
+                taint |= self.eval(kw.value)
+            self._sink_value(taint, "flight-dump", node.lineno)
+            return True
+        if name in ("dump", "dumps") and isinstance(recv, ast.Name):
+            dotted = self._fi.file.imports.get(recv.id, recv.id)
+            if dotted == "json":
+                if node.args:
+                    self._sink_value(
+                        self.eval(node.args[0]), "serialized-dump", node.lineno
+                    )
+                return True
+        return False
+
+    # -- interprocedural application ---------------------------------------
+
+    def _resolve(self, node: ast.Call, name, recv) -> list[FuncInfo]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return self.graph._resolve_name(func.id, self._fi)
+        if isinstance(func, ast.Attribute):
+            return self.graph._resolve_attr_call(
+                func, self._fi, self.graph._local_types(self._fi)
+            )
+        return []
+
+    def _apply_summaries(self, node: ast.Call, callees: list[FuncInfo], recv) -> set[tuple]:
+        out: set[tuple] = set()
+        recv_taint = self.eval(recv) if recv is not None else set()
+        for callee in callees:
+            summary = self.summaries.get(callee.uid)
+            if summary is None:
+                continue
+            bound = self._bind_args(node, callee, recv, recv_taint)
+            # returns: Src labels hop through the callee; Param labels map
+            # back to the bound argument taint
+            for label in list(summary.ret):
+                if label[0] == "src":
+                    out.add(label)
+                elif label[0] == "param" and label[1] == callee.uid:
+                    out |= bound.get(label[2], set())
+            # param -> sink flows: a tainted argument here IS the leak
+            for idx, flows in list(summary.sinks.items()):
+                arg_taint = bound.get(idx)
+                if not arg_taint:
+                    continue
+                for (token, sink_rel), hops in list(flows.items()):
+                    chained = (callee.qualname,) + hops
+                    self._sink_value(
+                        arg_taint, token, node.lineno, sink_rel, chained[:_MAX_HOPS]
+                    )
+            # param -> attr writes: caller taint lands on the class attr
+            for idx, keys in list(summary.attr_writes.items()):
+                arg_taint = bound.get(idx)
+                if not arg_taint:
+                    continue
+                for key in list(keys):
+                    for label in list(arg_taint):
+                        if label[0] == "src":
+                            bucket = self.attr_taint.setdefault(key, set())
+                            if label not in bucket:
+                                bucket.add(label)
+                                self._changed = True
+                                self._grew_attrs.add(key)
+                        elif label[0] == "param" and label[1] == self._fi.uid:
+                            writes = self._summary.attr_writes.setdefault(
+                                label[2], set()
+                            )
+                            if key not in writes:
+                                writes.add(key)
+                                self._changed = True
+        return self._cap(out)
+
+    def _bind_args(
+        self, node: ast.Call, callee: FuncInfo, recv, recv_taint: set[tuple]
+    ) -> dict[int, set[tuple]]:
+        """Call-site taint per callee param index (receiver = param 0 for
+        method calls on instances)."""
+        args_node = getattr(callee.node, "args", None)
+        if args_node is None:
+            return {}
+        pos_names = [a.arg for a in args_node.posonlyargs + args_node.args]
+        names = _param_names(callee.node)
+        index_of = {n: i for i, n in enumerate(names)}
+        vararg_idx = index_of.get(args_node.vararg.arg) if args_node.vararg else None
+        kwarg_idx = index_of.get(args_node.kwarg.arg) if args_node.kwarg else None
+        bound: dict[int, set[tuple]] = {}
+
+        def put(idx: Optional[int], taint: set[tuple]) -> None:
+            if idx is None or not taint:
+                return
+            bound[idx] = bound.get(idx, set()) | taint
+
+        offset = 0
+        is_method_call = (
+            callee.cls is not None
+            and isinstance(node.func, ast.Attribute)
+            and not (isinstance(recv, ast.Name) and recv.id[:1].isupper())
+        )
+        if is_method_call:
+            put(0, recv_taint)
+            offset = 1
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                put(vararg_idx, self.eval(a.value))
+                continue
+            pos = i + offset
+            if pos < len(pos_names):
+                put(index_of[pos_names[pos]], self.eval(a))
+            else:
+                put(vararg_idx, self.eval(a))
+        for kw in node.keywords:
+            taint = self.eval(kw.value)
+            if kw.arg is None:  # **spread
+                put(kwarg_idx, taint)
+            elif kw.arg in index_of and kw.arg not in (
+                args_node.vararg.arg if args_node.vararg else None,
+            ):
+                put(index_of[kw.arg], taint)
+            else:
+                put(kwarg_idx, taint)
+        return bound
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        """Worklist fixed point: a function re-analyzes only when a callee
+        summary or an attribute it reads grew. Taint is monotone over a
+        finite lattice, so the queue drains; findings accumulate (a flow
+        once found stays found)."""
+        from collections import deque
+
+        callers: dict[str, set[str]] = {}
+        for uid, outs in self.graph.edges.items():
+            for out in outs:
+                callers.setdefault(out, set()).add(uid)
+        order = [fi.uid for fi in self.symbols.functions]
+        queue = deque(order)
+        queued = set(order)
+        budget = len(order) * _MAX_ITERS * 4  # safety valve, never hit in practice
+        while queue and budget > 0:
+            budget -= 1
+            uid = queue.popleft()
+            queued.discard(uid)
+            fi = self.symbols.by_uid.get(uid)
+            if fi is None:
+                continue
+            grew, grew_attrs = self.analyze(fi)
+            dependents: set[str] = set()
+            if grew:
+                dependents |= callers.get(uid, set())
+            for key in grew_attrs:
+                dependents |= self._attr_readers.get(key, set())
+            for dep in dependents:
+                if dep not in queued:
+                    queued.add(dep)
+                    queue.append(dep)
+        return sorted(
+            self.findings.values(), key=lambda f: (f.file, f.line, f.message)
+        )
+
+
+# --- DESIGN.md §18 parity ----------------------------------------------------
+
+_TABLES = (
+    ("taint-source-table", "source"),
+    ("taint-declassifier-table", "declassifier"),
+    ("taint-sink-table", "sink"),
+)
+_TOKEN_RE = re.compile(r"`([A-Za-z0-9_.\-]+)`")
+
+
+def _registry_tokens() -> dict[str, set[str]]:
+    return {
+        "source": set(SOURCE_CALLS.values()) | set(SOURCE_ATTRS.values()),
+        "declassifier": set(DECLASSIFIERS),
+        "sink": set(SINK_TOKENS),
+    }
+
+
+def documented_tokens(design_text: str) -> dict[str, dict[str, int]]:
+    """kind -> {token: first documenting line} from the marked §18 tables.
+
+    Only the FIRST cell of each row carries registry identity; later cells
+    are prose (and freely backtick code that is not a registry token).
+    """
+    out: dict[str, dict[str, int]] = {kind: {} for _, kind in _TABLES}
+    active: Optional[str] = None
+    for i, line in enumerate(design_text.splitlines(), 1):
+        for marker, kind in _TABLES:
+            if f"<!-- {marker}:begin -->" in line:
+                active = kind
+            elif f"<!-- {marker}:end -->" in line:
+                active = None
+        if active is None or not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.lstrip().lstrip("|").split("|", 1)[0]
+        for token in _TOKEN_RE.findall(first_cell):
+            out[active].setdefault(token, i)
+    return out
+
+
+def _parity_findings(design_path) -> list[Finding]:
+    findings: list[Finding] = []
+    try:
+        design_text = design_path.read_text()
+    except OSError:
+        return [Finding("taint", "docs/DESIGN.md", 1, "docs/DESIGN.md is unreadable")]
+    docs = documented_tokens(design_text)
+    if not any(docs.values()):
+        return [
+            Finding(
+                "taint",
+                "docs/DESIGN.md",
+                1,
+                "no marked taint tables found (expected "
+                "'<!-- taint-source-table:begin -->' ... markers around the "
+                "§18 source/declassifier/sink tables)",
+            )
+        ]
+    registry = _registry_tokens()
+    for kind in registry:
+        for token in sorted(registry[kind] - set(docs[kind])):
+            findings.append(
+                Finding(
+                    "taint",
+                    "docs/DESIGN.md",
+                    1,
+                    f"taint {kind} '{token}' (tools/analysis/taint.py) is not "
+                    f"in the DESIGN.md §18 {kind} table (add a row inside the "
+                    f"taint-{kind}-table markers)",
+                )
+            )
+        for token, line in sorted(docs[kind].items()):
+            if token not in registry[kind]:
+                findings.append(
+                    Finding(
+                        "taint",
+                        "docs/DESIGN.md",
+                        line,
+                        f"documented taint {kind} '{token}' is not in the "
+                        "tools/analysis/taint.py registry (stale table row?)",
+                    )
+                )
+    return findings
+
+
+def run(graph: CallGraph, design_path=None) -> list[Finding]:
+    findings = TaintPass(graph).run()
+    if design_path is not None:
+        findings.extend(_parity_findings(design_path))
+    return findings

@@ -25,10 +25,14 @@ Usage:
                              [--threshold 0.10] [--list] [--with-analysis]
 
 ``--with-analysis`` additionally runs the static-analysis gate
-(tools/analysis, same checks as ``python tools/lint.py --strict``) through
-its persistent result cache — in CI the lint job has already warmed
+(tools/analysis, same checks as ``python tools/lint.py --strict``,
+including the cross-file deep passes — locks/purity/invariants/metrics/
+spans and the secret-flow taint analysis, DESIGN §18) through its
+persistent result cache — in CI the lint job has already warmed
 ``.lint-cache.json`` for the checkout, so the bench leg re-verifies the
-tree for effectively free instead of re-analyzing it.
+tree (taint artifacts included: the deep passes memoize as one unit
+keyed by the whole-tree digest) for effectively free instead of
+re-analyzing it.
 """
 
 from __future__ import annotations

@@ -13,10 +13,14 @@ tree-scoped hot-path rules: perf_counter/telemetry, unbounded queues,
 device_put staging, SDK raw transports, edge fold accounting, worker/sim
 host-sync prefixes) plus the cross-file deep passes (lock-discipline
 ``# guarded-by:`` race lint, call-graph host-sync/purity, accounting
-invariants, metrics <-> DESIGN.md parity). Suppressions are per-rule
-(``# lint: <rule>-ok``, rationale required for ``guarded``/``invariant``)
-and known findings can be baselined in ``tools/analysis/baseline.json``.
-docs/DESIGN.md §14 is the user guide.
+invariants, metrics/span <-> DESIGN.md parity, and the interprocedural
+secret-flow taint pass proving mask seeds / key halves / keystreams /
+the edge token never reach logs, span attrs, metric labels, JSON dumps,
+flight-recorder payloads or raised exception messages — docs/DESIGN.md
+§18). Suppressions are per-rule (``# lint: <rule>-ok``, rationale
+required for ``guarded``/``invariant``/``taint``) and known findings can
+be baselined in ``tools/analysis/baseline.json``. docs/DESIGN.md §14 is
+the user guide.
 
 Usage:
   python tools/lint.py [paths...]          # classic: lint these paths

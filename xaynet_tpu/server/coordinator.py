@@ -39,7 +39,10 @@ class CoordinatorState:
         )
 
     def to_bytes(self) -> bytes:
-        return json.dumps(
+        # the durable round-state blob must carry the round's secret key —
+        # a restarted coordinator cannot decrypt the round's messages
+        # without it; the blob lives in the coordinator's own store (§9)
+        return json.dumps(  # lint: taint-ok: durable round-state blob, restore needs the round key
             {
                 "public_key": self.keys.public.as_bytes().hex(),
                 "secret_key": self.keys.secret.as_bytes().hex(),

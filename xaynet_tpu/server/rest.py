@@ -299,9 +299,12 @@ class RestServer:
         if not self.edge_api.authorized(headers):
             return 401, b"bad edge token", "text/plain"
         if method == "GET" and path == "/edge/round":
+            # the round handoff IS the protocol: a trusted edge needs the
+            # round's secret key to act as the decrypt/verify tier (§11),
+            # behind the constant-time token check above
             return (
                 200,
-                json.dumps(self.edge_api.round_info()).encode(),
+                json.dumps(self.edge_api.round_info()).encode(),  # lint: taint-ok: edge round handoff
                 "application/json",
             )
         if method == "POST" and path == "/edge/envelope":

@@ -125,7 +125,12 @@ class PetMessageHandler:
             raise ServiceError("parse", str(e)) from e
         expected = _PHASE_TAGS.get(phase)
         if expected is None or tag != expected:
-            raise ServiceError("phase-filter", f"{tag.name} message during {phase.value}")
+            # the tag rides in the decrypted header, so the taint pass sees
+            # plaintext-derived bytes here — but a message-type enum name is
+            # a one-byte projection, not key material
+            raise ServiceError(  # lint: taint-ok: one-byte message-type tag, not key bytes
+                "phase-filter", f"{tag.name} message during {phase.value}"
+            )
         # signature verification + full parse
         try:
             return Message.from_bytes(raw, verify=True, lazy_update_vect=self.wire_ingest)

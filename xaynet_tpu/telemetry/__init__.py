@@ -10,11 +10,16 @@
 - ``tracing``   — the distributed round-tracing span layer (trace ids,
   bounded buffers, Chrome-trace export — docs/DESIGN.md §16);
 - ``recorder``  — the flight recorder dumping span ring + registry deltas
-  on failure triggers.
+  on failure triggers;
+- ``redact``    — runtime secret redaction: ``redact()`` (the sanctioned
+  length/type-only projection the taint pass treats as a declassifier)
+  and the deny-list ``scrub_attrs`` filter applied to flight dumps and
+  Chrome-trace exports before they hit disk (docs/DESIGN.md §18).
 """
 
 from .bridge import BridgedMetrics as BridgedMetrics
 from .recorder import FlightRecorder as FlightRecorder, flight_dump as flight_dump
+from .redact import redact as redact, scrub_attrs as scrub_attrs
 from .registry import (
     DEFAULT_BUCKETS as DEFAULT_BUCKETS,
     MetricError as MetricError,

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Optional
 
+from .redact import scrub_attrs
 from .registry import get_registry
 from .tracing import get_tracer
 
@@ -130,14 +131,21 @@ class FlightRecorder:
             self._dumps += 1
             round_id = self._round_id
         tracer = get_tracer()
+        # defense-in-depth (DESIGN §18): the static taint pass proves no
+        # key material flows here at lint time; the deny-list scrub covers
+        # what static analysis cannot see (values that became secret
+        # dynamically) before the bundle hits disk
         bundle = {
             "trigger": trigger,
             "detail": detail,
-            "attrs": attrs,
+            "attrs": scrub_attrs(attrs, "flight"),
             "ts": round(time.time(), 3),
             "round_id": round_id,
             "trace_id": (tracer.round_ctx().trace_id if tracer.round_ctx() else None),
-            "ring": [s.to_json(anchor=tracer.anchor) for s in tracer.ring_spans()],
+            "ring": [
+                scrub_attrs(s.to_json(anchor=tracer.anchor), "flight")
+                for s in tracer.ring_spans()
+            ],
             "metrics_delta": self._deltas(),
         }
         try:

@@ -519,6 +519,8 @@ def to_chrome_trace(spans: Iterable[Span], anchor: float = 0.0) -> dict:
     in ``args`` so the text report and the CI validator can rebuild the
     tree from the export alone.
     """
+    from .redact import scrub_attrs
+
     pids: dict[str, int] = {}
     tids: dict[tuple[int, str], int] = {}
     events: list[dict] = []
@@ -528,7 +530,10 @@ def to_chrome_trace(spans: Iterable[Span], anchor: float = 0.0) -> dict:
         args = {"trace": span.trace_id, "span": span.span_id}
         if span.parent_id:
             args["parent"] = span.parent_id
-        args.update(span.attrs)
+        # deny-list scrub before the export hits disk (DESIGN §18): span
+        # attrs whose key names secret material leave only a redacted
+        # length/digest projection in the Chrome trace
+        args.update(scrub_attrs(span.attrs, "trace"))
         if span.error:
             args["error"] = span.error
         events.append(
