@@ -473,6 +473,103 @@ def main() -> None:
         except Exception as e:  # diagnostics must never sink the headline
             print(f"streaming_vs_sync unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # --- multi-tenant interleaved fold (2 tenants, one mesh) --------------
+    # Two tenants with DIFFERENT model sizes fold concurrently through the
+    # production streaming pipelines over the shared paged accumulator pool
+    # and the tenant fold-batch scheduler (docs/DESIGN.md §19): tenant A at
+    # the full 25M headline size, tenant B at a quarter of it. The headline
+    # is combined 25M-equivalent updates/s (tenant B's updates scaled by
+    # its length fraction); the scheduler's fairness split is recorded next
+    # to it so a starved tenant is visible in the history, and both
+    # tenants' pool leases must balance at the end (zero leaks).
+    multi_tenant = None
+    if not on_tpu:
+        try:
+            import threading as _threading
+
+            from xaynet_tpu.parallel.aggregator import ShardedAggregator
+            from xaynet_tpu.parallel.streaming import StreamingAggregator
+            from xaynet_tpu.tenancy import get_pool, get_scheduler
+
+            k_mt, b_mt = max(2, k // 2), 3
+            len_b = model_len // 4
+            wire_a = np.ascontiguousarray(host_stack_np[:k_mt].transpose(0, 2, 1))
+            wire_b = np.ascontiguousarray(
+                host_stack_np[:k_mt, :, :len_b].transpose(0, 2, 1)
+            )
+            sched = get_scheduler()
+            streams = {}
+            for tenant, (mlen, wire) in {
+                "bench-a": (model_len, wire_a),
+                "bench-b": (len_b, wire_b),
+            }.items():
+                agg_t = ShardedAggregator(config, mlen, kernel="auto")
+                streams[tenant] = (
+                    agg_t,
+                    StreamingAggregator(
+                        agg_t, staging_buffers=2, dispatch_ahead=2,
+                        max_batch=k_mt, tenant=tenant,
+                    ),
+                    wire,
+                )
+                streams[tenant][1].submit_batch(wire)  # resolve + warm
+                streams[tenant][1].drain()
+            # capture AFTER the warm-up drains: the recorded fairness split
+            # must cover exactly the measured window's grants
+            split_before = sched.split()
+            walls = {}
+
+            def run_tenant(tenant: str) -> None:
+                _agg, stream, wire = streams[tenant]
+                t0 = time.perf_counter()
+                for _ in range(b_mt):
+                    stream.submit_batch(wire)
+                stream.drain()
+                walls[tenant] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            threads = [
+                _threading.Thread(target=run_tenant, args=(t,)) for t in streams
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            equivalent = (
+                k_mt * b_mt  # tenant A at the reference 25M size
+                + k_mt * b_mt * (len_b / model_len)  # tenant B, scaled
+            ) / wall
+            split_after = sched.split()
+            fairness = {
+                t: split_after.get(t, 0) - split_before.get(t, 0)
+                for t in streams
+            }
+            kernel_mt = streams["bench-a"][0].kernel_used
+            pool = get_pool()
+            for tenant, (agg_t, stream, _wire) in streams.items():
+                stream.close()
+                agg_t.release_plan_pages()
+                assert pool.balanced(tenant), f"{tenant} leaked pool leases"
+            multi_tenant = {
+                "value_raw": equivalent,
+                "tenants": 2,
+                "model_lens": [model_len, len_b],
+                "kernel": kernel_mt,
+                "mesh": len(jax.devices()),
+                "fairness": fairness,
+                "walls_s": {t: round(w, 2) for t, w in walls.items()},
+            }
+            print(
+                f"multi-tenant interleaved fold: {equivalent:.2f} equivalent "
+                f"updates/s over {wall:.2f}s (25M + {len_b / 1e6:.1f}M params, "
+                f"kernel {kernel_mt}, fairness {fairness})",
+                file=sys.stderr,
+            )
+            del streams, wire_a, wire_b
+        except Exception as e:  # the tenancy leg must never sink the headline
+            print(f"multi-tenant leg unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+
     # --- sim headline: whole federated rounds as ONE jitted program -------
     # A genuinely different workload from the fold headline above: per-
     # participant ChaCha mask derivation + masked-model generation +
@@ -591,6 +688,18 @@ def main() -> None:
                 "max": round(mesh8["max_raw"] * scale, 2),
             },
         }
+    multi_tenant_out = None
+    if multi_tenant is not None:
+        multi_tenant_out = {
+            "value": round(multi_tenant["value_raw"], 2),
+            "unit": "updates/s",
+            "tenants": multi_tenant["tenants"],
+            "model_lens": multi_tenant["model_lens"],
+            "kernel": multi_tenant["kernel"],
+            "mesh": multi_tenant["mesh"],
+            "fairness": multi_tenant["fairness"],
+            "walls_s": multi_tenant["walls_s"],
+        }
     print(
         json.dumps(
             {
@@ -606,6 +715,7 @@ def main() -> None:
                 "streaming_vs_sync": streaming_vs_sync,
                 "bytes_per_fold": bytes_per_fold,
                 "mesh8": mesh8_out,
+                "multi_tenant": multi_tenant_out,
                 "sim": sim_out,
                 "spread": {
                     "median_of": reps,
@@ -661,6 +771,36 @@ def main() -> None:
                     f.write(json.dumps(record) + "\n")
         except Exception as e:  # history append must never sink the bench
             print(f"BENCH_HISTORY bytes append failed: {e}", file=sys.stderr)
+    if multi_tenant_out is not None and model_len == 25_000_000:
+        # the multi-tenant interleaved series: 25M-equivalent updates/s of
+        # two tenants folding concurrently through the paged pool + tenant
+        # scheduler, with the fairness split recorded on the record (§19)
+        try:
+            hist = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+            )
+            record = {
+                "ts": time.time(),
+                "source": "bench.py:multi_tenant",
+                "parsed": {
+                    "metric": "multi-tenant interleaved fold @25M params (2 tenants)",
+                    "value": multi_tenant_out["value"],
+                    "unit": "updates/s",
+                    "platform": platform,
+                    "kernel": multi_tenant_out["kernel"],
+                    "mesh": multi_tenant_out["mesh"],
+                    "model_len": model_len,
+                    "native_threads": native_threads,
+                    "shard_threads": shard_threads,
+                    "tenants": multi_tenant_out["tenants"],
+                    "model_lens": multi_tenant_out["model_lens"],
+                    "fairness": multi_tenant_out["fairness"],
+                },
+            }
+            with open(hist, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except Exception as e:  # history append must never sink the bench
+            print(f"BENCH_HISTORY multi-tenant append failed: {e}", file=sys.stderr)
     if mesh8_out is not None and model_len == 25_000_000:
         mesh8_metric = (
             f"masked-update aggregation throughput @25M params, "

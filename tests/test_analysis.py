@@ -906,3 +906,88 @@ def test_repo_tree_analyzes_clean_with_checked_in_baseline(capsys):
 
 def test_strict_cli_flag_parses():
     assert driver.main(["--strict"], repo=REPO) == 0
+
+
+# --- tenant-scope pass (docs/DESIGN.md §19) --------------------------------
+
+from tools.analysis import tenantscope  # noqa: E402
+
+_TENANT_UNKEYED = """
+class Phase:
+    def handle(self, shared, req):
+        last = shared.edge_watermarks.get(req.edge_id)
+        return last
+"""
+
+_TENANT_KEYED = """
+class Phase:
+    def handle(self, shared, req):
+        last = shared.edge_watermarks.get(req.edge_id)
+        log(shared.tenant, last)
+        return last
+"""
+
+
+def test_tenant_pass_flags_unkeyed_scoped_state_read(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/server/phases/foo.py": _TENANT_UNKEYED})
+    findings = tenantscope.run(graph)
+    assert any("edge_watermarks" in f.message and "tenant key" in f.message
+               for f in findings)
+
+
+def test_tenant_pass_quiet_with_tenant_key_in_scope(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/server/phases/foo.py": _TENANT_KEYED})
+    assert tenantscope.run(graph) == []
+    # a `tenant` PARAMETER also keys the scope
+    param = _TENANT_UNKEYED.replace(
+        "def handle(self, shared, req):", "def handle(self, shared, req, tenant):"
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/server/phases/foo.py": param})
+    assert tenantscope.run(graph) == []
+
+
+def test_tenant_pass_scoped_to_server_and_parallel_trees(tmp_path):
+    # the same read under sim/ (not a coordinator tree) is not a finding
+    graph = _graph(tmp_path, {"xaynet_tpu/sim/foo.py": _TENANT_UNKEYED})
+    assert tenantscope.run(graph) == []
+
+
+def test_tenant_pass_suppression_requires_rationale(tmp_path):
+    bare = _TENANT_UNKEYED.replace(
+        "last = shared.edge_watermarks.get(req.edge_id)",
+        "last = shared.edge_watermarks.get(req.edge_id)  # lint: tenant-ok",
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/server/phases/foo.py": bare})
+    assert any("missing its rationale" in f.message for f in tenantscope.run(graph))
+    with_rationale = _TENANT_UNKEYED.replace(
+        "last = shared.edge_watermarks.get(req.edge_id)",
+        "last = shared.edge_watermarks.get(req.edge_id)  # lint: tenant-ok: per-tenant Shared",
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/server/phases/foo.py": with_rationale})
+    assert tenantscope.run(graph) == []
+
+
+_LEASE_ROGUE = """
+def grab(pool):
+    return pool.lease_host("t", (4, 4), "uint32")
+"""
+
+
+def test_tenant_pass_lease_site_whitelist(tmp_path):
+    # a lease call outside the sanctioned sites is the static half of the
+    # leases == releases round invariant
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/rogue.py": _LEASE_ROGUE})
+    findings = tenantscope.run(graph)
+    assert any("lease_host" in f.message and "sanctioned" in f.message
+               for f in findings)
+    # the whitelist covers the real sites (file + qualname exact)
+    graph = _graph(
+        tmp_path,
+        {"xaynet_tpu/parallel/shards.py":
+         "class ShardPlan:\n    def _alloc(self, pool):\n"
+         "        return pool.lease_host(self.tenant, (4, 4), 'uint32')\n"},
+    )
+    assert tenantscope.run(graph) == []
+    # pool-internal code is exempt wholesale
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/pool.py": _LEASE_ROGUE})
+    assert tenantscope.run(graph) == []

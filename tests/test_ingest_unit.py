@@ -249,3 +249,95 @@ def test_pipeline_prefilter_drops_before_any_queue_slot():
         assert pipe.intake.occupancy == 1
 
     asyncio.run(run())
+
+
+# --------------------------------------------------------------------------
+# Cross-tenant isolation (docs/DESIGN.md §19): one tenant's close/purge
+# paths must never strand another tenant's in-flight requests or budget.
+# --------------------------------------------------------------------------
+
+
+def test_request_channel_close_is_scoped_to_its_tenant():
+    from xaynet_tpu.server.requests import RequestReceiver, SumRequest
+    from xaynet_tpu.telemetry.registry import get_registry
+
+    def depth(tenant):
+        return get_registry().sample_value(
+            "xaynet_request_queue_depth", {"tenant": tenant}
+        )
+
+    async def run():
+        rx_a = RequestReceiver(tenant="iso-a")
+        rx_b = RequestReceiver(tenant="iso-b")
+        tx_a, tx_b = rx_a.sender(), rx_b.sender()
+        req = SumRequest(participant_pk=b"\x01" * 32, ephm_pk=b"\x02" * 32)
+        fut_a = asyncio.ensure_future(tx_a.request(req))
+        futs_b = [asyncio.ensure_future(tx_b.request(req)) for _ in range(2)]
+        await asyncio.sleep(0)
+        assert depth("iso-a") == 1 and depth("iso-b") == 2
+
+        rx_a.close()  # tenant A shuts down...
+        await asyncio.sleep(0)
+        # ...A's queued request is rejected (never hangs on a dead machine)
+        with pytest.raises(RequestError):
+            await fut_a
+        # ...but tenant B's requests are STILL PENDING, and only A's depth
+        # gauge child zeroed
+        assert all(not f.done() for f in futs_b)
+        assert depth("iso-a") == 0
+        assert depth("iso-b") == 2
+
+        env = await rx_b.next_request()
+        env.response.set_result(None)
+        await futs_b[0]
+        assert depth("iso-b") == 1
+        rx_b.close()
+        with pytest.raises(RequestError):
+            await futs_b[1]
+        assert depth("iso-b") == 0
+
+    asyncio.run(run())
+
+
+def test_pipeline_stop_returns_tenant_budget_without_touching_others():
+    from xaynet_tpu.tenancy import TenantAdmissionBudget
+
+    async def run():
+        budget = TenantAdmissionBudget(capacity=8, max_share=0.5)
+        pipe_a = IngestPipeline(
+            handler=None,
+            request_tx=None,
+            events=_stub_events(PhaseName.SUM),
+            settings=IngestSettings(enabled=True, shards=1, queue_bound=4),
+            tenant="bud-a",
+            budget=budget,
+        )
+        pipe_b = IngestPipeline(
+            handler=None,
+            request_tx=None,
+            events=_stub_events(PhaseName.SUM),
+            settings=IngestSettings(enabled=True, shards=1, queue_bound=4),
+            tenant="bud-b",
+            budget=budget,
+        )
+        # workers are NOT started: messages sit queued in the intakes
+        for _ in range(3):
+            assert (await pipe_a.submit(b"\x00" * 400)).verdict is Verdict.ADMITTED
+        assert (await pipe_b.submit(b"\x00" * 400)).verdict is Verdict.ADMITTED
+        assert budget.held("bud-a") == 3 and budget.held("bud-b") == 1
+        # tenant A is at its 50% share (4): one more sheds with Retry-After
+        assert (await pipe_a.submit(b"\x00" * 400)).verdict is Verdict.ADMITTED
+        shed = await pipe_a.submit(b"\x00" * 400)
+        assert shed.verdict is Verdict.SHED and shed.retry_after > 0
+        # ...while tenant B still has budget
+        assert (await pipe_b.submit(b"\x00" * 400)).verdict is Verdict.ADMITTED
+
+        await pipe_a.stop()  # tenant A dies with messages still queued
+        # A's entire held share returns to the process budget; B untouched
+        assert budget.held("bud-a") == 0
+        assert budget.held("bud-b") == 2
+        assert (await pipe_b.submit(b"\x00" * 400)).verdict is Verdict.ADMITTED
+        await pipe_b.stop()
+        assert budget.held("bud-b") == 0
+
+    asyncio.run(run())

@@ -25,8 +25,10 @@ from xaynet_tpu.server.requests import (
 from xaynet_tpu.telemetry.registry import get_registry
 
 
-def _depth() -> float:
-    return get_registry().sample_value("xaynet_request_queue_depth")
+def _depth(tenant: str = "default") -> float:
+    return get_registry().sample_value(
+        "xaynet_request_queue_depth", {"tenant": tenant}
+    )
 
 
 def _req(i: int = 0) -> SumRequest:

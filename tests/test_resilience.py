@@ -893,7 +893,7 @@ def test_ingest_worker_death_restarted_by_supervisor():
         phase = _Watch()
 
     install_plan(FaultPlan.parse("ingest.worker.0:error,nth=1"))
-    before = WORKER_RESTARTS.labels(shard="0").value
+    before = WORKER_RESTARTS.labels(shard="0", tenant="default").value
 
     async def run():
         pipeline = IngestPipeline(
@@ -904,11 +904,11 @@ def test_ingest_worker_death_restarted_by_supervisor():
         )
         await pipeline.start()
         for _ in range(100):
-            if WORKER_RESTARTS.labels(shard="0").value > before:
+            if WORKER_RESTARTS.labels(shard="0", tenant="default").value > before:
                 break
             await asyncio.sleep(0.02)
         assert pipeline.running
         await pipeline.stop()
 
     asyncio.run(asyncio.wait_for(run(), timeout=30))
-    assert WORKER_RESTARTS.labels(shard="0").value == before + 1
+    assert WORKER_RESTARTS.labels(shard="0", tenant="default").value == before + 1

@@ -188,7 +188,7 @@ async def _run(report_path: str) -> None:
         assert 'xaynet_kernel_elements_per_second{op="masked_add"}' in text
         assert 'xaynet_kernel_elements_per_second{op="unmask"}' in text
         # HTTP surface instruments itself too
-        assert 'xaynet_http_requests_total{method="GET",path="/metrics",status="200"}' in text
+        assert 'xaynet_http_requests_total{method="GET",path="/metrics",status="200",tenant=""}' in text
     finally:
         machine_task.cancel()
         await rest.stop()

@@ -106,6 +106,15 @@ rule(
     "twice / undeclared, or code <-> DESIGN.md §16 span-table drift",
 )
 rule(
+    "tenant",
+    "tenant-scope: code under server/ + parallel/ reading tenant-scoped "
+    "state (Shared round fields, pool pages/leases, edge watermarks) with "
+    "no tenant key in scope, or a pool lease/release call site outside the "
+    "sanctioned whitelist (the leases == releases round invariant, "
+    "docs/DESIGN.md §19)",
+    rationale_required=True,
+)
+rule(
     "taint",
     "secret-flow: key material (mask seeds, keypair secret halves, ChaCha "
     "keystreams, the edge token) reaching an observability or persistence "

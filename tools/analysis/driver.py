@@ -31,7 +31,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from . import filerules, invariants, locks, metricscheck, purity, spans, taint
+from . import filerules, invariants, locks, metricscheck, purity, spans, taint, tenantscope
 from .cache import ResultCache, SourceCache
 from .callgraph import CallGraph, SymbolTable
 from .core import Baseline, Finding
@@ -185,6 +185,7 @@ class Analyzer:
         findings.extend(locks.run(graph))
         findings.extend(purity.run(graph))
         findings.extend(invariants.run(graph))
+        findings.extend(tenantscope.run(graph))
         findings.extend(taint.run(graph, design))
         findings.extend(metricscheck.run(infos, design))
         findings.extend(spans.run(infos, design))

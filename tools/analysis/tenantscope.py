@@ -1,0 +1,158 @@
+"""Tenant-scope pass: tenant-keyed access to round state + sanctioned
+page-lease sites.
+
+Multi-tenancy (docs/DESIGN.md §19) turns formerly process-global round
+state into per-tenant state: ``Shared``'s round fields (the per-edge seed
+watermarks, the resume budget), the accumulator pool's pages, and the
+scheduler's fold slots are all keyed by tenant id. A helper that reads
+one of these without a tenant in scope is exactly how cross-tenant bleed
+starts — an edge watermark checked against the wrong tenant's map, a
+page-table probe that aggregates across tenants, a reclaim that frees a
+neighbour's pages.
+
+Two legs:
+
+1. **tenant-key-in-scope** — functions under ``xaynet_tpu/server/`` and
+   ``xaynet_tpu/parallel/`` that touch tenant-scoped state (the
+   ``Shared`` round fields ``edge_watermarks``/``resume_attempts``, or
+   the pool's tenant-keyed surface ``page_table``/``balanced``/
+   ``reclaim``) must have a tenant key in scope: a parameter named
+   ``tenant``, or a ``tenant`` attribute/name read anywhere in the
+   function (``self.tenant``, ``shared.tenant``). Sites where the scoping
+   is structural (the object itself is per-tenant and no key exists to
+   thread) carry ``# lint: tenant-ok: <rationale>`` — the rationale is
+   the review record.
+
+2. **sanctioned lease sites** — every ``lease_host``/``lease_device``
+   call outside ``xaynet_tpu/tenancy/`` must appear in
+   :data:`LEASE_SITES` with a rationale naming its paired release. This
+   is the static half of the *leases == releases at round end* invariant:
+   the whitelist below is the closed set of places pages enter
+   circulation, each reviewed to give them back (unmask release, ring
+   close, GC-finalizer backstop, Idle reclaim).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, iter_owned_nodes
+from .core import Finding, suppressed, suppression_pending_rationale
+
+# Shared round fields + pool surface reads that are tenant-keyed
+_SCOPED_ATTRS = frozenset({"edge_watermarks", "resume_attempts"})
+_SCOPED_POOL_CALLS = frozenset({"page_table", "balanced", "reclaim"})
+
+_LEASE_CALLS = frozenset({"lease_host", "lease_device"})
+
+# (file, function qualname) -> rationale naming the paired release.
+LEASE_SITES: dict[tuple[str, str], str] = {
+    ("xaynet_tpu/parallel/streaming.py", "_StagingRing.__init__"):
+        "staging ring buffers; released by ring.close() from the "
+        "pipeline's close(), GC finalizer as the crash backstop",
+    ("xaynet_tpu/parallel/shards.py", "ShardPlan._alloc"):
+        "per-shard accumulator/spare buffers; released by "
+        "release_pages() from the round's unmask tail, GC finalizer + "
+        "Idle reclaim as crash backstops",
+    ("xaynet_tpu/parallel/shards.py", "ShardPlan.__init__"):
+        "device-ledger lease for the plan's HBM footprint; released with "
+        "release_pages() exactly like the host buffers",
+}
+
+_PREFIXES = ("xaynet_tpu/server/", "xaynet_tpu/parallel/")
+
+
+def _qualname_chain(qualname: str) -> list[str]:
+    parts = qualname.split(".")
+    return [".".join(parts[:i]) for i in range(len(parts), 0, -1)]
+
+
+def _has_tenant_key(fi) -> bool:
+    """A tenant key in scope: a param named ``tenant``, or any read of a
+    ``tenant`` name/attribute inside the function body."""
+    args = fi.node.args
+    for a in (
+        *args.posonlyargs, *args.args, *args.kwonlyargs,
+        *( [args.vararg] if args.vararg else [] ),
+        *( [args.kwarg] if args.kwarg else [] ),
+    ):
+        if a.arg == "tenant":
+            return True
+    for node in iter_owned_nodes(fi.node):
+        if isinstance(node, ast.Attribute) and node.attr == "tenant":
+            return True
+        if isinstance(node, ast.Name) and node.id == "tenant":
+            return True
+    return False
+
+
+def run(graph: CallGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    for fi in graph.symbols.functions:
+        rel = fi.file.rel
+        if rel.startswith("xaynet_tpu/tenancy/"):
+            continue  # the pool/scheduler themselves
+        in_scope_tree = rel.startswith(_PREFIXES)
+        lease_allowed = any(
+            (rel, q) in LEASE_SITES for q in _qualname_chain(fi.qualname)
+        )
+        tenant_keyed: bool | None = None  # computed lazily per function
+        for node in iter_owned_nodes(fi.node):
+            # -- leg 2: sanctioned lease sites (whole xaynet_tpu tree) ----
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LEASE_CALLS
+                and not lease_allowed
+            ):
+                line = fi.file.line(node.lineno)
+                if suppressed("tenant", line):
+                    continue
+                msg = (
+                    f"page lease ({node.func.attr}) outside the sanctioned "
+                    f"sites (in '{fi.qualname}') — every lease site must "
+                    "pair with a release for the leases == releases round "
+                    "invariant (DESIGN §19); add the site to "
+                    "tools/analysis/tenantscope.py LEASE_SITES with its "
+                    "paired release, or annotate "
+                    "'# lint: tenant-ok: <rationale>'"
+                )
+                if suppression_pending_rationale("tenant", line):
+                    msg += " [suppression present but missing its rationale]"
+                findings.append(Finding("tenant", rel, node.lineno, msg))
+                continue
+            if not in_scope_tree:
+                continue
+            # -- leg 1: tenant key in scope ------------------------------
+            scoped = None
+            if isinstance(node, ast.Attribute) and node.attr in _SCOPED_ATTRS:
+                # skip the dataclass field DEFINITIONS (AnnAssign targets
+                # at class scope are not owned by any function, so they
+                # never reach here anyway)
+                scoped = node.attr
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SCOPED_POOL_CALLS
+            ):
+                scoped = f"{node.func.attr}()"
+            if scoped is None:
+                continue
+            if tenant_keyed is None:
+                tenant_keyed = _has_tenant_key(fi)
+            if tenant_keyed:
+                continue
+            line = fi.file.line(node.lineno)
+            if suppressed("tenant", line):
+                continue
+            msg = (
+                f"tenant-scoped state ({scoped}) read in '{fi.qualname}' "
+                "with no tenant key in scope — thread the tenant id (or "
+                "read it: self.tenant / shared.tenant) so the access is "
+                "visibly scoped, or annotate "
+                "'# lint: tenant-ok: <rationale>' (DESIGN §19)"
+            )
+            if suppression_pending_rationale("tenant", line):
+                msg += " [suppression present but missing its rationale]"
+            findings.append(Finding("tenant", rel, node.lineno, msg))
+    return findings

@@ -63,6 +63,11 @@ ELEMENTS_UNIT = "elements/s"
 BYTES_PREFIX = "bytes moved per fold"
 BYTES_UNIT = "bytes/fold"
 LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT})
+# multi-tenant interleaved fold (bench.py:multi_tenant, DESIGN §19): two
+# tenants' concurrent folds through the paged pool + tenant scheduler,
+# in 25M-equivalent updates/s (tenant B's updates scaled by its length
+# fraction); the record also carries the scheduler's fairness split
+TENANT_PREFIX = "multi-tenant interleaved fold"
 # families gated independently when no explicit --metric-prefix is given
 DEFAULT_FAMILIES = (
     (HEADLINE_PREFIX, HEADLINE_UNIT),
@@ -70,6 +75,7 @@ DEFAULT_FAMILIES = (
     (SUM2_PREFIX, ELEMENTS_UNIT),
     (UNMASK_PREFIX, ELEMENTS_UNIT),
     (BYTES_PREFIX, BYTES_UNIT),
+    (TENANT_PREFIX, HEADLINE_UNIT),
 )
 
 

@@ -104,6 +104,235 @@ filter = "info"
 
 N_CHAOS_UPDATERS = 6
 
+# per-tenant mask-config/model-size diversity for --tenants N: tenant i
+# gets MODEL_LENS[i % ...] params and GROUPS[i % ...] group arithmetic, so
+# the multi-tenant smoke genuinely packs variable-length models with
+# different group orders into one pool (docs/DESIGN.md §19)
+TENANT_MODEL_LENS = (1500, 2200, 900, 3000)
+TENANT_GROUPS = ("integer", "prime", "power2", "integer")
+
+
+def _tenant_config(port: int, model_len: int, group: str, model_dir: str) -> str:
+    """One tenant's FULL override settings file (loaded standalone by the
+    multi-tenant runner; [api] is unused there — the process listener comes
+    from the base config)."""
+    base = CONFIG.format(
+        port=port,
+        model_len=model_len,
+        model_dir=model_dir,
+        agg_device="true",
+        agg_wire_ingest="false",
+        agg_batch=2,
+        agg_kernel="auto",
+        update_min=3,
+        update_max=3,
+        update_quorum_line="",
+        stall_grace=1.0,
+        edge_enabled_line="",
+    )
+    return base + f'\n[mask]\ngroup_type = "{group}"\n'
+
+
+def _drive_tenant_rounds(
+    url: str, rounds: int, model_len: int, expected: bytes | None, label: str
+) -> bytes:
+    """Drive ``rounds`` PET rounds against ``url`` (a bare or /t/<tenant>
+    base) with DETERMINISTIC participant models; every completed round's
+    global model must equal ``expected`` (byte-identity vs the
+    single-tenant control) when given. Returns the last model bytes."""
+    from fractions import Fraction
+
+    import numpy as np
+
+    from xaynet_tpu.sdk.client import HttpClient
+    from xaynet_tpu.sdk.participant import Participant
+    from xaynet_tpu.sdk.simulation import keys_for_task
+
+    def fetch_params():
+        return asyncio.run(HttpClient(url, keep_alive=False).get_round_params())
+
+    def fetch_model() -> bytes:
+        model = asyncio.run(HttpClient(url, keep_alive=False).get_model())
+        return np.asarray(model, dtype=np.float64).tobytes()
+
+    completed = 0
+    last_seed = None
+    model_bytes = b""
+    while completed < rounds:
+        params = fetch_params()
+        if params.seed.as_bytes() == last_seed:
+            time.sleep(0.01)
+            continue
+        last_seed = params.seed.as_bytes()
+        seed = last_seed
+        summer = keys_for_task(seed, params.sum, params.update, "sum")
+        upd, start = [], 0
+        while len(upd) < 3:
+            k = keys_for_task(seed, params.sum, params.update, "update", start=start)
+            start += 100000
+            if all(k.public != u.public for u in upd) and k.public != summer.public:
+                upd.append(k)
+        parts = [Participant(url, keys=summer, scalar=Fraction(1, 3))]
+        for i, k in enumerate(upd):
+            p = Participant(url, keys=k, scalar=Fraction(1, 3))
+            p.set_model(np.full(model_len, 0.25 * (i + 1), dtype=np.float32))
+            parts.append(p)
+        for _ in range(600):
+            for p in parts:
+                p.tick()
+            if fetch_params().seed.as_bytes() != seed:
+                break
+        else:
+            raise RuntimeError(f"{label}: round {completed + 1} did not complete")
+        model_bytes = fetch_model()
+        if expected is not None and model_bytes != expected:
+            raise RuntimeError(
+                f"{label}: round {completed + 1} NOT byte-identical to the "
+                "single-tenant control"
+            )
+        completed += 1
+    return model_bytes
+
+
+def run_multi_tenant_soak(args) -> None:
+    """--tenants N: N tenants with distinct mask configs/model sizes in ONE
+    coordinator process, each driven concurrently over /t/<tenant>/... and
+    checked byte-identical to its single-tenant control run."""
+    import socket
+    import threading
+
+    n = args.tenants
+    tenants = [f"t{i}" for i in range(n)]
+    spec = {
+        tid: (
+            TENANT_MODEL_LENS[i % len(TENANT_MODEL_LENS)],
+            TENANT_GROUPS[i % len(TENANT_GROUPS)],
+        )
+        for i, tid in enumerate(tenants)
+    }
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    def wait_listening(port: int, proc) -> None:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    return
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError("coordinator exited during startup")
+                time.sleep(0.25)
+        raise RuntimeError("coordinator did not start listening in 90s")
+
+    t0 = time.perf_counter()
+    controls: dict[str, bytes] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_dir = os.path.join(tmp, "tenants")
+        os.makedirs(cfg_dir)
+        for tid, (mlen, group) in spec.items():
+            with open(os.path.join(cfg_dir, f"{tid}.toml"), "w") as f:
+                f.write(
+                    _tenant_config(
+                        args.port, mlen, group, os.path.join(tmp, f"models-{tid}")
+                    )
+                )
+        # --- single-tenant control runs: one round each, alone ------------
+        for tid, (mlen, group) in spec.items():
+            log = open(os.path.join(tmp, f"control-{tid}.log"), "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "xaynet_tpu.server.runner",
+                 "-c", os.path.join(cfg_dir, f"{tid}.toml")],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
+            try:
+                wait_listening(args.port, proc)
+                controls[tid] = _drive_tenant_rounds(
+                    f"http://127.0.0.1:{args.port}", 1, mlen, None,
+                    f"control {tid}",
+                )
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                log.close()
+            print(f"control {tid}: model {len(controls[tid])} bytes", file=sys.stderr)
+        # --- the multi-tenant run -----------------------------------------
+        base_cfg = os.path.join(tmp, "multi.toml")
+        with open(base_cfg, "w") as f:
+            f.write(
+                _tenant_config(
+                    args.port,
+                    spec[tenants[0]][0],
+                    spec[tenants[0]][1],
+                    os.path.join(tmp, "models-multi"),
+                )
+                + "\n[tenancy]\nenabled = true\n"
+                + f'tenants = "{",".join(tenants)}"\n'
+                + f'config_dir = "{cfg_dir}"\n'
+            )
+        log_path = os.path.join(tmp, "multi.log")
+        log = open(log_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", base_cfg],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        try:
+            wait_listening(args.port, proc)
+            errors: list[BaseException] = []
+
+            def drive(tid: str) -> None:
+                mlen, _ = spec[tid]
+                try:
+                    _drive_tenant_rounds(
+                        f"http://127.0.0.1:{args.port}/t/{tid}",
+                        args.rounds,
+                        mlen,
+                        controls[tid],
+                        f"tenant {tid}",
+                    )
+                except BaseException as err:
+                    errors.append(err)
+
+            threads = [
+                threading.Thread(target=drive, args=(tid,), daemon=True)
+                for tid in tenants
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            if errors:
+                raise errors[0]
+            rss = _rss_kb(proc.pid)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            log.close()
+    print(
+        json.dumps(
+            {
+                "tenants": {
+                    tid: {"model_len": spec[tid][0], "group": spec[tid][1]}
+                    for tid in tenants
+                },
+                "rounds_per_tenant": args.rounds,
+                "byte_identical": True,
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "rss_kb": rss,
+            }
+        )
+    )
+
 
 def run_chaos_soak_sync(
     port: int, rounds: int, model_len: int, dropout: float, stragglers: int
@@ -428,6 +657,17 @@ def main() -> None:
         "(default 10; --edges 4 therefore drives 40 participants)",
     )
     ap.add_argument(
+        "--tenants",
+        type=int,
+        default=None,
+        metavar="N",
+        help="multi-tenant soak: N tenants with distinct mask configs and "
+        "model sizes in ONE coordinator process (device aggregation over "
+        "the shared paged pool), each driven concurrently over "
+        "/t/<tenant>/... and checked byte-identical to its single-tenant "
+        "control run (docs/DESIGN.md §19)",
+    )
+    ap.add_argument(
         "--faults",
         type=int,
         default=None,
@@ -446,6 +686,13 @@ def main() -> None:
     args = ap.parse_args()
     if args.wire_ingest and not args.device_kernel:
         ap.error("--wire-ingest requires --device-kernel")
+    if args.tenants is not None:
+        if args.tenants < 2:
+            ap.error("--tenants must be >= 2 (one tenant is the ordinary soak)")
+        if args.edges or args.dropout is not None or args.stragglers is not None:
+            ap.error("--tenants is a separate soak from --edges/--dropout")
+        run_multi_tenant_soak(args)
+        return
     chaos = args.dropout is not None or args.stragglers is not None
     dropout = args.dropout or 0.0
     stragglers = args.stragglers or 0

@@ -46,24 +46,26 @@ class EdgeRestServer(RestServer):
         )
         self.service = service
 
-    async def _dispatch(self, method: str, url, body: bytes, headers=None):
-        path = url.path
+    async def _dispatch(self, method: str, path: str, query: str, body: bytes,
+                        headers, routes):
         try:
             if method == "POST" and path == "/message":
                 if self.service.accepting_updates:
                     # the local fold path: admission -> intake -> decrypt ->
                     # coalesce -> EdgeAggregator (super()'s pipeline branch)
-                    return await super()._dispatch(method, url, body, headers)
+                    return await super()._dispatch(
+                        method, path, query, body, headers, routes
+                    )
                 return await self._forward(body)
             if method == "GET" and path in _PROXY_PATHS:
-                return await self._proxy(url)
+                return await self._proxy(path, query)
             if method == "GET" and path == "/params" and not self.service.synced:
                 # no round learned yet: the local params are placeholders
-                return await self._proxy(url)
+                return await self._proxy(path, query)
         except Exception as err:  # proxy/forward faults must not 500-loop
             logger.warning("edge relay failed: %s %s: %s", method, path, err)
             return 502, str(err).encode(), "text/plain"
-        return await super()._dispatch(method, url, body, headers)
+        return await super()._dispatch(method, path, query, body, headers, routes)
 
     async def _forward(self, body: bytes):
         """Relay an opaque upload upstream (non-update phases)."""
@@ -78,9 +80,9 @@ class EdgeRestServer(RestServer):
             return 502, f"upstream unavailable: {err}".encode(), "text/plain"
         return 200, b"", "text/plain"
 
-    async def _proxy(self, url):
+    async def _proxy(self, path: str, query: str):
         """One-shot upstream read, status/body passed through verbatim."""
-        target = url.path + (f"?{url.query}" if url.query else "")
+        target = path + (f"?{query}" if query else "")
         try:
             status, headers, payload = await self.service.upstream.proxy_get(target)
         except ClientError as err:

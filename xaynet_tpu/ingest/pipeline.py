@@ -34,7 +34,7 @@ from ..server.settings import IngestSettings
 from ..telemetry import tracing as trace
 from ..telemetry.registry import get_registry
 from ..utils import tracing
-from .admission import BATCH_SIZE_HIST, Admission, AdmissionController
+from .admission import BATCH_SIZE_HIST, Admission, AdmissionController, Verdict
 from .coalescer import UpdateCoalescer
 from .intake import ShardedIntake, ShardFull
 
@@ -47,8 +47,8 @@ SPAN_DECRYPT_BATCH = trace.declare_span("ingest.decrypt_batch")
 WORKER_RESTARTS = get_registry().counter(
     "xaynet_ingest_worker_restarts_total",
     "Ingest decrypt workers restarted by the supervisor after dying "
-    "unexpectedly, by shard.",
-    ("shard",),
+    "unexpectedly, by shard and tenant.",
+    ("shard", "tenant"),
 )
 
 # backoff between restarts of a crash-looping worker: capped doubling, so a
@@ -72,12 +72,21 @@ class IngestPipeline:
         request_tx: RequestSender,
         events,
         settings: IngestSettings,
+        tenant: str = "default",
+        budget=None,
     ):
         settings.validate()
         self.handler = handler
         self.request_tx = request_tx
         self.events = events
         self.settings = settings
+        # multi-tenant seam (docs/DESIGN.md §19): the tenant id labels this
+        # pipeline's logs/metrics; `budget` (tenancy.TenantAdmissionBudget)
+        # layers the per-tenant share of the PROCESS-wide intake on top of
+        # this pipeline's own AdmissionController — a flooding tenant sheds
+        # before it can crowd other tenants' decrypt capacity
+        self.tenant = tenant
+        self.budget = budget
         self.intake = ShardedIntake(settings.shards, settings.queue_bound)
         self.admission = AdmissionController(
             capacity=self.intake.capacity,
@@ -126,6 +135,12 @@ class IngestPipeline:
         self._workers = []
         if self.coalescer is not None:
             await self.coalescer.close()
+        if self.budget is not None:
+            # return this tenant's entire held share: messages still queued
+            # in the intake die with this pipeline, and a stopped tenant
+            # must not keep budget charged against the OTHER tenants'
+            # process-wide capacity (docs/DESIGN.md §19)
+            self.budget.discharge(self.tenant, self.budget.held(self.tenant))
 
     @property
     def running(self) -> bool:
@@ -149,14 +164,29 @@ class IngestPipeline:
             # phase is accepting messages at all
             return self.admission.dropped("pre-filter")
         request_id = tracing.new_request_id()
-        with trace.get_tracer().span(SPAN_ADMISSION, rid=request_id) as span:
+        with trace.get_tracer().span(
+            SPAN_ADMISSION, rid=request_id, tenant=self.tenant
+        ) as span:
+            if self.budget is not None and not self.budget.charge(self.tenant):
+                # per-tenant budget exceeded: shed BEFORE the shared
+                # controller — this tenant is over its share even if the
+                # process as a whole has headroom
+                span.set(verdict="shed-budget")
+                return Admission(
+                    Verdict.SHED,
+                    retry_after=self.admission.retry_after(self.intake.occupancy),
+                )
             verdict = self.admission.admit(self.intake.occupancy)
             if verdict.shed:
+                if self.budget is not None:
+                    self.budget.discharge(self.tenant)
                 span.set(verdict="shed")
                 return verdict
             try:
                 self.intake.put_nowait((request_id, time.monotonic(), encrypted))
             except ShardFull:
+                if self.budget is not None:
+                    self.budget.discharge(self.tenant)
                 span.set(verdict="shed-shard-full")
                 return self.admission.shed_shard_full(self.intake.occupancy)
             self.admission.count_admitted()
@@ -179,7 +209,7 @@ class IngestPipeline:
             except asyncio.CancelledError:
                 raise
             except Exception:
-                WORKER_RESTARTS.labels(shard=str(shard.index)).inc()
+                WORKER_RESTARTS.labels(shard=str(shard.index), tenant=self.tenant).inc()
                 logger.exception(
                     "ingest worker %d died; restarting in %.2fs", shard.index, backoff
                 )
@@ -196,6 +226,10 @@ class IngestPipeline:
                 self.settings.max_batch, self.settings.linger_ms / 1000.0
             )
             self.intake.drained()
+            if self.budget is not None:
+                # the drained messages leave this tenant's share of the
+                # process-wide budget the moment they leave the queue
+                self.budget.discharge(self.tenant, len(batch))
             self.admission.observe(self.intake.occupancy)
             BATCH_SIZE_HIST.labels(stage="decrypt").observe(len(batch))
             # the oldest member's wait IS the batch's queue-wait span: it
@@ -274,7 +308,7 @@ class IngestPipeline:
         """Saturation snapshot for GET /healthz."""
         occupancy = self.intake.occupancy
         self.admission.observe(occupancy)
-        return {
+        out = {
             "saturated": self.admission.saturated,
             "occupancy": occupancy,
             "capacity": self.intake.capacity,
@@ -284,3 +318,8 @@ class IngestPipeline:
             # watching an edge's backlog need the pre-seal depth too)
             "coalescer_pending": self.coalescer.pending if self.coalescer else 0,
         }
+        if self.budget is not None:
+            out["tenant"] = self.tenant
+            out["budget_held"] = self.budget.held(self.tenant)
+            out["budget_limit"] = self.budget.per_tenant
+        return out

@@ -306,6 +306,16 @@ class ShardedAggregator:
         reassemble on demand."""
         self._live_plan = plan
 
+    def release_plan_pages(self) -> None:
+        """Give the adopted plan's pool pages back (the round's unmask
+        tail, docs/DESIGN.md §19) and drop the plan — the buffers may be
+        re-leased to another tenant, so the accumulator must never be
+        reassembled from them again."""
+        plan = self._live_plan
+        if plan is not None:
+            self._live_plan = None
+            plan.release_pages()
+
     def _to_planar_padded(self, stack: np.ndarray) -> np.ndarray:
         """Wire ``[K, n, L]`` -> planar padded ``[K, L, padded_len]`` (host)."""
         planar = wire_to_planar(stack)

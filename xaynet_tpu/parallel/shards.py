@@ -53,6 +53,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -63,6 +64,12 @@ from .mesh import shard_slices
 logger = logging.getLogger(__name__)
 
 SHARD_THREADS_ENV = "XAYNET_NATIVE_SHARD_THREADS"
+
+
+def _release_plan_leases(pool, leases: list) -> None:
+    """Module-level so a plan's GC finalizer holds no plan reference."""
+    for lease in leases:
+        pool.release(lease)
 
 
 def shard_thread_budget(n_shards: int, explicit: int = 0) -> int:
@@ -89,10 +96,22 @@ class ShardPlan:
     tests race plans without touching the live accumulator).
     """
 
-    def __init__(self, agg, shard_threads: int = 0, zero_accs: bool = False):
+    def __init__(self, agg, shard_threads: int = 0, zero_accs: bool = False,
+                 pool=None, tenant: str = "default"):
         if agg.kernel_used is None:
             raise ValueError("kernel must be resolved before building a shard plan")
         self.agg = agg
+        # paged-pool seam (docs/DESIGN.md §19): with a pool, the per-shard
+        # accumulator/spare buffers are page runs LEASED from the shared
+        # arena under this plan's tenant instead of privately-owned
+        # allocations — tenants' variable-length plans pack into one slab
+        # set. Device plans lease from the capacity LEDGER (fold kernels
+        # donate buffers, so page identity cannot survive a fold there).
+        # Pages release at the round's unmask (`release_pages`), with a GC
+        # finalizer + the Idle-phase reclaim as crash-path backstops.
+        self.tenant = tenant
+        self._pool = pool
+        self._pool_leases: list = []
         self.native = agg.kernel_used == "native-u64"
         self.n_shards = agg.mesh.devices.size
         self.slices = shard_slices(agg.padded_length, self.n_shards)
@@ -127,14 +146,16 @@ class ShardPlan:
         if self.native:
             if zero_accs:
                 self.accs = [  # guarded-by: _device_dispatch_lock
-                    np.zeros((agg.n_limbs, hi - lo), dtype=np.uint32)
+                    self._alloc((agg.n_limbs, hi - lo))
                     for lo, hi in self.slices
                 ]
             else:
                 acc_np = np.asarray(agg.acc)
-                self.accs = [
-                    np.ascontiguousarray(acc_np[:, lo:hi]) for lo, hi in self.slices
-                ]
+                self.accs = []
+                for lo, hi in self.slices:
+                    buf = self._alloc((agg.n_limbs, hi - lo))
+                    np.copyto(buf, acc_np[:, lo:hi])
+                    self.accs.append(buf)
                 from .aggregator import BYTES_REDUCED
 
                 # host memory has no sharded view: decomposing the global
@@ -142,7 +163,9 @@ class ShardPlan:
                 # keeps the plan across drain windows, so this is per
                 # round, not per drain)
                 BYTES_REDUCED.labels(path="scatter").inc(int(acc_np.nbytes))
-            self.spares: list = [np.empty_like(a) for a in self.accs]  # guarded-by: _device_dispatch_lock
+            self.spares: list = [  # guarded-by: _device_dispatch_lock
+                self._alloc(a.shape) for a in self.accs
+            ]
         else:
             import jax
             import jax.numpy as jnp
@@ -172,6 +195,41 @@ class ShardPlan:
                 }
                 self.accs = [by_start[lo] for lo, _ in self.slices]
             self.spares = []
+            if self._pool is not None:
+                # device plans lease from the CAPACITY LEDGER: the
+                # accumulator's HBM footprint is charged to the tenant so
+                # a plan that would not fit fails fast at build time
+                self._pool_leases.append(
+                    self._pool.lease_device(
+                        self.tenant, agg.n_limbs * agg.padded_length * 4
+                    )
+                )
+        if self._pool is not None:
+            # crash-path backstop: a plan dropped without release_pages()
+            # gives its pages back at collection time (by then nothing can
+            # alias the leased runs); Idle's reclaim covers the rest
+            weakref.finalize(
+                self, _release_plan_leases, self._pool, self._pool_leases
+            )
+
+    def _alloc(self, shape) -> np.ndarray:
+        """A zeroed uint32 host buffer: a page-run lease from the shared
+        pool when one is attached, a private allocation otherwise."""
+        if self._pool is None:
+            return np.zeros(shape, dtype=np.uint32)
+        lease = self._pool.lease_host(self.tenant, shape, np.uint32)
+        self._pool_leases.append(lease)
+        return lease.array
+
+    def release_pages(self) -> None:
+        """Release every page lease this plan holds (the round's unmask
+        path; idempotent against the GC finalizer and the Idle reclaim).
+        The per-shard buffers must no longer be read past this point —
+        the pool may re-lease their pages to another tenant."""
+        if self._pool is None:
+            return
+        for lease in self._pool_leases:
+            self._pool.release(lease)
 
     # -- folds ------------------------------------------------------------
 

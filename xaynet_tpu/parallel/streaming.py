@@ -82,6 +82,8 @@ from ..telemetry import profiling
 from ..telemetry import tracing as trace
 from ..telemetry.recorder import flight_dump
 from ..telemetry.registry import get_registry
+from ..tenancy.pool import get_pool
+from ..tenancy.scheduler import get_scheduler
 from .aggregator import ShardedAggregator
 
 logger = logging.getLogger(__name__)
@@ -213,22 +215,45 @@ class _BatchJob:
         self.global_release = None  # wire: (ring, buf) released at commit
 
 
+def _release_ring_leases(pool, leases: list) -> None:
+    """Module-level so a ring's GC finalizer holds no ring reference."""
+    for lease in leases:
+        pool.release(lease)
+
+
 class _StagingRing:
     """Fixed pool of pre-allocated host staging buffers.
 
     ``acquire`` blocks while every buffer is owned by an in-flight batch —
     this is the pipeline's memory bound (the producer can run at most
     ``size`` batches ahead of the fold worker).
+
+    Buffers are page runs LEASED from the shared accumulator pool
+    (``tenancy.pool``) under the ring's tenant — staging planes (packed
+    byte-planar included) page exactly like the shard accumulators, so
+    concurrent tenants' rings pack into one arena. ``close()`` releases
+    the leases; a GC finalizer backstops abandoned pipelines.
     """
 
-    def __init__(self, size: int, shape: tuple, dtype, gauge=None):
+    def __init__(self, size: int, shape: tuple, dtype, gauge=None,
+                 pool=None, tenant: str = "default"):
         self._free: queue_mod.Queue = queue_mod.Queue()
         self.size = size
         # per-shard rings report on the shard-labelled gauge; the global
         # depth gauge keeps counting every owned buffer either way
         self._gauge = gauge
-        for _ in range(size):
-            self._free.put(np.zeros(shape, dtype=dtype))
+        self._pool = pool if pool is not None else get_pool()
+        self._leases = [self._pool.lease_host(tenant, shape, dtype) for _ in range(size)]
+        for lease in self._leases:
+            self._free.put(lease.array)
+        # abandoned pipelines (dropped without close()) give their pages
+        # back when the ring is collected — by then nothing can alias them
+        weakref.finalize(self, _release_ring_leases, self._pool, self._leases)
+
+    def close(self) -> None:
+        """Release the ring's page leases (idempotent; the buffers must no
+        longer be in flight — the pipeline drains before closing)."""
+        _release_ring_leases(self._pool, self._leases)
 
     def acquire(self, timeout: float | None = None) -> np.ndarray:
         buf = self._free.get(timeout=timeout)
@@ -288,6 +313,9 @@ class StreamingAggregator:
         shard_parallel: bool | None = None,
         shard_threads: int = 0,
         packed: bool | None = None,
+        tenant: str = "default",
+        pool=None,
+        scheduler=None,
     ):
         if staging_buffers < 2:
             raise ValueError("staging_buffers must be >= 2 (no overlap below that)")
@@ -318,6 +346,17 @@ class StreamingAggregator:
             agg.packed_staging_usable() if packed is None
             else bool(packed) and agg.packed_staging_usable()
         )
+        # multi-tenant seam (docs/DESIGN.md §19): the tenant id labels this
+        # pipeline's page leases, scheduler slots, spans and flight dumps;
+        # the shared pool backs the staging rings and shard-plan buffers;
+        # the scheduler interleaves this tenant's fold batches with other
+        # tenants' on the one mesh (fairness + global in-flight bound)
+        self.tenant = tenant
+        self._pool = pool if pool is not None else get_pool()
+        self._sched = scheduler if scheduler is not None else get_scheduler()
+        self._sched_owner = self._sched.new_owner()
+        # abandoned pipelines give their slots back at collection time
+        weakref.finalize(self, self._sched.release_owner, self._sched_owner)
         self._plan = None  # shards.ShardPlan while accs live  # guarded-by: _lock
         self._shard_queues: list[queue_mod.Queue] | None = None
         self._shard_workers: list[threading.Thread | None] = []
@@ -389,6 +428,17 @@ class StreamingAggregator:
             # pipeline they surface the error through drain() first
             self._plan.close()
             self._plan = None
+        # staging pages go back to the pool (nothing is in flight past the
+        # drain/joins above); the shard plan's accumulator pages stay
+        # leased — unmask still reads them — and release through
+        # StagedAggregator.release_pool / the round-boundary reclaim
+        with self._lock:
+            rings = list(self._rings.values()) + list(self._shard_rings.values())
+            self._rings.clear()
+            self._shard_rings.clear()
+        for ring in rings:
+            ring.close()
+        self._sched.release_owner(self._sched_owner)
 
     # -- producer side -----------------------------------------------------
 
@@ -431,8 +481,25 @@ class StreamingAggregator:
                     dtype = np.uint8
                 # first-call buffer allocation happens under the lock: once
                 # per kind, before any overlap exists to lose
-                ring = self._rings[kind] = _StagingRing(self.staging_buffers, shape, dtype)
+                ring = self._rings[kind] = _StagingRing(
+                    self.staging_buffers, shape, dtype,
+                    pool=self._pool, tenant=self.tenant,
+                )
             return ring
+
+    # -- tenant fold-batch slots (docs/DESIGN.md §19) ----------------------
+    #
+    # Every batch holds ONE scheduler slot from dispatch until its fold
+    # settles (worker completion / last-shard commit / the degraded-path
+    # finally). The slot is the cross-tenant interleave point: the
+    # scheduler grants it fairly across tenants and bounds the mesh-wide
+    # in-flight total, which is the multi-tenant backpressure.
+
+    def _slot_acquire(self) -> None:
+        self._sched.acquire(self.tenant, self._sched_owner)
+
+    def _slot_release(self) -> None:
+        self._sched.release(self._sched_owner)
 
     def _flight_poison(self, cause: BaseException, seq: int | None) -> None:
         """ONE forensic dump per pipeline (idempotent under the lock): the
@@ -448,6 +515,7 @@ class StreamingAggregator:
             "pipeline-poison",
             f"batch {seq}: {type(cause).__name__}: {cause}",
             batch=seq,
+            tenant=self.tenant,
         )
 
     def _poison_error(self) -> StreamingError:
@@ -481,6 +549,7 @@ class StreamingAggregator:
         """Queue to the fold worker — or, once degraded, fold synchronously
         on the caller's thread (same math, no overlap)."""
         buf, payload, kind, k, ticket, seq = item
+        self._slot_acquire()  # released when the fold settles (_process)
         with self._lock:
             self._in_flight_models += k
             degraded = self._degraded
@@ -521,6 +590,7 @@ class StreamingAggregator:
             BATCHES_TOTAL.labels(stage="failed").inc()
             raise self._poison_error() from cause
         finally:
+            self._slot_release()
             self._ring(kind).release(buf)
             with self._lock:
                 self._fold_seconds += time.monotonic() - t0
@@ -604,7 +674,13 @@ class StreamingAggregator:
             staged = jax.device_put(jnp.stack(piece), agg._batch_sharding)
             n_piece = len(piece)
             del piece
-            agg.acc = agg._fold(agg.acc, staged)
+            # caller-thread folds hold a scheduler slot per chunk too, so
+            # the device-resident fast path cannot starve other tenants
+            self._slot_acquire()
+            try:
+                agg.acc = agg._fold(agg.acc, staged)
+            finally:
+                self._slot_release()
             with self._lock:
                 agg.nb_models += n_piece
 
@@ -643,7 +719,11 @@ class StreamingAggregator:
         if self._closed:
             raise StreamingError("pipeline is closed")
         agg._resolve_kernel_cheap(k)
-        new_acc = agg._fold(agg.acc, stacked)
+        self._slot_acquire()
+        try:
+            new_acc = agg._fold(agg.acc, stacked)
+        finally:
+            self._slot_release()
         with self._lock:
             agg.acc = new_acc
             agg.nb_models += k
@@ -866,6 +946,7 @@ class StreamingAggregator:
                     else:
                         outcome = self._degrade_and_retry(payload, kind, k, ticket, seq, first)
             finally:
+                self._slot_release()
                 if buf is not None:
                     self._ring(kind).release(buf)
                 with self._lock:
@@ -1011,8 +1092,9 @@ class StreamingAggregator:
             # an explicit accumulator write (restore/reset) superseded the
             # adopted plan: the per-shard buffers are stale — shut its
             # fold pool (only this producer folds into it, so nothing is
-            # in flight) and rebuild
+            # in flight), give its pages back, and rebuild
             plan.close()
+            plan.release_pages()
             plan = None
         if plan is None:
             from .shards import ShardPlan
@@ -1023,7 +1105,12 @@ class StreamingAggregator:
             # persists across drain windows as the authoritative
             # accumulator, so the per-drain reassemble+decompose round
             # trip is gone — the only gathers left are explicit acc reads
-            plan = ShardPlan(agg, shard_threads=self._shard_threads)
+            plan = ShardPlan(
+                agg,
+                shard_threads=self._shard_threads,
+                pool=self._pool,
+                tenant=self.tenant,
+            )
             agg.adopt_plan(plan)
             with self._lock:
                 self._plan = plan
@@ -1046,6 +1133,8 @@ class StreamingAggregator:
                     shape,
                     dtype,
                     gauge=SHARD_STAGING_DEPTH.labels(shard=str(d)),
+                    pool=self._pool,
+                    tenant=self.tenant,
                 )
             return ring
 
@@ -1175,6 +1264,7 @@ class StreamingAggregator:
         """Queue one item per shard worker — or, once degraded, fold every
         shard on the caller's thread after a full queue barrier (same math,
         no overlap; the batch still commits atomically)."""
+        self._slot_acquire()  # one slot per BATCH; the last shard releases
         with self._lock:
             self._in_flight_models += job.k
             degraded = self._degraded
@@ -1220,6 +1310,7 @@ class StreamingAggregator:
             BATCHES_TOTAL.labels(stage="failed").inc()
             raise self._poison_error() from cause
         finally:
+            self._slot_release()
             for i, (_jb, _d, _p, ring, buf) in enumerate(items):
                 if not released[i] and ring is not None:
                     ring.release(buf)
@@ -1241,6 +1332,8 @@ class StreamingAggregator:
         agg = self.agg
         self._batch_seq += 1
         seq = self._batch_seq
+        self._slot_acquire()  # covers the mesh unpack below; the last
+        # shard's commit (or a failure here) releases it
         try:
             staged = jax.device_put(view, agg._batch_bytes_sharding)
             planar_mesh, ok = profiling.timed_kernel(
@@ -1250,6 +1343,7 @@ class StreamingAggregator:
             )
             plan = self._ensure_plan(k, lambda: planar_mesh)
         except BaseException as e:
+            self._slot_release()
             ring.release(buf)
             self._poison(e, seq)
             BATCHES_TOTAL.labels(stage="failed").inc()
@@ -1295,6 +1389,7 @@ class StreamingAggregator:
                 BATCHES_TOTAL.labels(stage="failed").inc()
                 raise self._poison_error() from cause
             finally:
+                self._slot_release()
                 if not released:
                     ring.release(buf)
             BATCHES_TOTAL.labels(stage="folded").inc()
@@ -1474,6 +1569,7 @@ class StreamingAggregator:
             finally:
                 job.staged = None
                 ring.release(buf)
+        self._slot_release()
         INFLIGHT_FOLDS.dec()
         failed = job.failed  # lint: guarded-ok: last-shard tail, single owner
         retried = job.retried  # lint: guarded-ok: last-shard tail, single owner
@@ -1501,16 +1597,20 @@ class StreamingAggregator:
         row-chunked caller-thread paths (one copy, not three: the
         ``by_start`` shard addressing and the credit ordering are exactly
         the PR-7-hardened sequence a missed divergent copy would break)."""
-        if plan.native:
-            full = np.asarray(stacked)  # lint: sync-ok
-            for d in range(plan.n_shards):
-                plan.fold_shard_slice(d, full)
-        else:
-            by_start = {
-                s.index[-1].start or 0: s.data for s in stacked.addressable_shards
-            }
-            for d, (lo, _hi) in enumerate(plan.slices):
-                plan.fold_shard(d, by_start[lo])
+        self._slot_acquire()
+        try:
+            if plan.native:
+                full = np.asarray(stacked)  # lint: sync-ok
+                for d in range(plan.n_shards):
+                    plan.fold_shard_slice(d, full)
+            else:
+                by_start = {
+                    s.index[-1].start or 0: s.data for s in stacked.addressable_shards
+                }
+                for d, (lo, _hi) in enumerate(plan.slices):
+                    plan.fold_shard(d, by_start[lo])
+        finally:
+            self._slot_release()
         with self._lock:
             self.agg.nb_models += k
 

@@ -162,6 +162,11 @@ class HttpClient(XaynetClient):
                 self.tls = ssl.create_default_context()
         elif base_url.startswith("http://"):
             base_url = base_url[len("http://") :]
+        # a path suffix scopes every request (multi-tenant coordinators
+        # serve per-tenant routes under /t/<tenant>/..., docs/DESIGN.md
+        # §19): "host:port/t/a" prefixes "/t/a" onto each request path
+        base_url, _, prefix = base_url.partition("/")
+        self.path_prefix = f"/{prefix.rstrip('/')}" if prefix else ""
         self.host, _, port = base_url.partition(":")
         self.port = int(port or (443 if self.tls is not None else 80))
         self.timeout = timeout
@@ -245,6 +250,8 @@ class HttpClient(XaynetClient):
         non-idempotent POST; those surface to the caller's retry policy,
         which understands protocol-level idempotence.
         """
+        if self.path_prefix:
+            path = self.path_prefix + path
         ctx = trace.current_ctx()
         if ctx is not None:
             # propagate the trace across the wire: the coordinator's REST

@@ -120,6 +120,12 @@ class DeviceAggregation(Aggregation):
         values = limb_ops.limbs_to_ints(n_vect)
         return Model(decode_vect_exact(values, self._config.vect, self.nb_models, scalar_sum))
 
+    def release_pool(self) -> None:
+        """Round-end page release (the Unmask phase calls this AFTER the
+        unmasked model is decoded and persisted — see
+        ``StagedAggregator.release_pool``)."""
+        self._device.release_plan_pages()
+
 
 class StagedAggregator:
     """Stages validated masked updates and folds them in batches."""
@@ -138,9 +144,11 @@ class StagedAggregator:
         shard_parallel: bool = True,
         shard_threads: int = 0,
         packed_staging: bool = True,
+        tenant: str = "default",
     ):
         self.config = config
         self.object_size = object_size
+        self.tenant = tenant
         self.batch_size = max(1, batch_size)
         self._staged_vect: list = []  # device: futures of planar arrays
         self._staged_unit: list[np.ndarray] = []
@@ -169,6 +177,7 @@ class StagedAggregator:
                 shard_parallel=shard_parallel,
                 shard_threads=shard_threads,
                 packed=packed_staging,
+                tenant=tenant,
             )
             # tiny unit part stays on host
             self._unit_acc = np.zeros(
@@ -473,6 +482,15 @@ class StagedAggregator:
         )
         agg.nb_models = self._device.nb_models
         return agg
+
+    def release_pool(self) -> None:
+        """Round-end page release (the Unmask tail, docs/DESIGN.md §19):
+        the shard plan's leased accumulator pages go back to the shared
+        pool once the unmasked model is decoded — nothing reads the
+        accumulator past this point, so the pool may re-lease the pages to
+        another tenant immediately."""
+        if self._device is not None:
+            self._device.release_plan_pages()
 
     def finalize_inplace(self) -> Aggregation:
         """The Unmask handoff WITHOUT gathering the accumulator.

@@ -125,6 +125,10 @@ class Shared:
     store: Store
     settings: Settings
     metrics: Optional[object] = None
+    # the tenant this round state belongs to (docs/DESIGN.md §19): keys the
+    # aggregator's pool leases and scheduler slots, labels phase spans,
+    # flight dumps and tenant metric families, scopes checkpoints/storage
+    tenant: str = "default"
     # Failure-phase round-resume budget for the CURRENT round (reset by
     # Idle); bounds how often one round may re-enter Update from its
     # checkpoint before falling back to a restart
@@ -244,7 +248,10 @@ class PhaseState:
             trace.TraceContext(trace.new_id()) if self.NAME is PhaseName.IDLE else None
         )
         with trace.get_tracer().span(
-            _PHASE_SPANS[self.NAME.value], ctx=idle_ctx, round_id=self.shared.round_id
+            _PHASE_SPANS[self.NAME.value],
+            ctx=idle_ctx,
+            round_id=self.shared.round_id,
+            tenant=self.shared.tenant,
         ):
             try:
                 await self.process()
@@ -359,6 +366,7 @@ class PhaseState:
                 f"{counter.discarded} discarded",
                 phase=self.NAME.value,
                 round_id=self.shared.round_id,
+                tenant=self.shared.tenant,
             )
         if self.shared.round_ctl is not None:
             self.shared.round_ctl.observe_phase(

@@ -126,7 +126,7 @@ class Failure(PhaseState):
             # checkpoint when it completes — this guard covers the window
             # where that deletion itself failed.
             return None
-        attempts = self.shared.resume_attempts
+        attempts = self.shared.resume_attempts  # lint: tenant-ok: budget lives on this tenant's own Shared
         if attempts >= res.max_resume_attempts:
             logger.warning(
                 "round %d: resume budget exhausted (%d); restarting round",
@@ -150,7 +150,7 @@ class Failure(PhaseState):
             )
             ckpt_mod.RESUMES.labels(outcome="invalid").inc()
             return None
-        self.shared.resume_attempts = attempts + 1
+        self.shared.resume_attempts = attempts + 1  # lint: tenant-ok: budget lives on this tenant's own Shared
         ckpt_mod.RESUMES.labels(outcome="resumed").inc()
         logger.info(
             "round %d: resuming update phase from checkpoint (%d models, attempt %d/%d)",

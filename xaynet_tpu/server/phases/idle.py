@@ -36,10 +36,11 @@ class Idle(PhaseState):
         # the previous round's mid-round checkpoint (and its resume budget)
         # cannot outlive the dictionaries it is consistent with
         await self.shared.store.coordinator.delete_round_checkpoint()
-        self.shared.resume_attempts = 0
+        self.shared.resume_attempts = 0  # lint: tenant-ok: round reset within this tenant's own Shared
+        self._reconcile_pool()
         # per-edge envelope watermarks are round-scoped: window sequences
         # restart at 0 with every round's fresh window state on the edges
-        self.shared.edge_watermarks.clear()
+        self.shared.edge_watermarks.clear()  # lint: tenant-ok: round reset within this tenant's own Shared
         self._gen_round_keypair()
         self._update_round_probabilities()
         self._update_round_seed()
@@ -67,6 +68,24 @@ class Idle(PhaseState):
         return SumPhase(self.shared)
 
     # --- internals --------------------------------------------------------
+
+    def _reconcile_pool(self) -> None:
+        """Round-boundary page accounting (docs/DESIGN.md §19): at Idle the
+        tenant must hold ZERO pool leases — the previous round's unmask
+        released them on the clean path. A crashed round (Failure -> Idle)
+        leaks its aggregator's leases instead: run the GC so dropped plans'
+        finalizers return their pages safely (the buffers are unreachable,
+        nothing can alias them), then force-reclaim any stragglers, counted
+        on ``xaynet_pool_reclaimed_total`` so the invariant break is
+        visible on /metrics rather than silent."""
+        from ...tenancy.pool import get_pool
+
+        pool = get_pool()
+        if not pool.balanced(self.shared.tenant):
+            import gc
+
+            gc.collect()
+            pool.reclaim(self.shared.tenant)
 
     def _gen_round_keypair(self) -> None:
         keys = EncryptKeyPair.generate()

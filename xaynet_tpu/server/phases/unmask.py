@@ -72,6 +72,13 @@ class Unmask(PhaseState):
             )
         await self._save_global_model()
         await self._publish_proof()
+        # round-end page release (docs/DESIGN.md §19): the accumulator's
+        # pool pages go back the moment the unmasked model is decoded and
+        # persisted — this is the clean half of the leases == releases
+        # round invariant (Idle's reclaim is the crash-path backstop)
+        release = getattr(self.model_agg, "release_pool", None)
+        if release is not None:
+            release()
 
     def broadcast(self) -> None:
         assert self.global_model is not None

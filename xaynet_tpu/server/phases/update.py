@@ -75,6 +75,7 @@ class UpdatePhase(PhaseState):
             shard_parallel=settings.aggregation.shard_parallel,
             shard_threads=settings.aggregation.shard_threads,
             packed_staging=settings.aggregation.packed_staging,
+            tenant=shared.tenant,
         )
         self._seed_dict = None
         self._resumed_models = 0
@@ -274,8 +275,9 @@ class UpdatePhase(PhaseState):
         EDGE_ENVELOPES.labels(outcome="accepted").inc()
         EDGE_MEMBERS_FOLDED.inc(len(req))
         logger.info(
-            "round %d: folded edge envelope %s/%d (%d members, one dispatch)",
+            "round %d [tenant %s]: folded edge envelope %s/%d (%d members, one dispatch)",
             shared.round_id,
+            shared.tenant,
             req.edge_id,
             req.window_seq,
             len(req),

@@ -22,10 +22,15 @@ from ..core.message import Message, Sum, Sum2, Update
 from ..telemetry.registry import get_registry
 
 # depth of the services -> state-machine queue: the leading indicator of a
-# phase falling behind its ingest (scraped via GET /metrics)
+# phase falling behind its ingest (scraped via GET /metrics). Labelled per
+# TENANT: each tenant runs its own channel, and one tenant's close/purge
+# must never zero (or double-count into) another tenant's depth — the
+# cross-tenant isolation contract of docs/DESIGN.md §19.
 _QUEUE_DEPTH = get_registry().gauge(
     "xaynet_request_queue_depth",
-    "State-machine requests enqueued and not yet handled by a phase.",
+    "State-machine requests enqueued and not yet handled by a phase, "
+    "by tenant.",
+    ("tenant",),
 )
 
 
@@ -174,7 +179,7 @@ class RequestReceiver:
     enqueue, dequeue, phase-end purge (via ``try_recv``) and close.
     """
 
-    def __init__(self, maxsize: int = 0):
+    def __init__(self, maxsize: int = 0, tenant: str = "default"):
         # one queue carries both envelopes and the single shutdown sentinel;
         # the +1 slack below keeps a full bounded channel closable
         self._queue: asyncio.Queue[Optional[_Envelope]] = (
@@ -184,6 +189,8 @@ class RequestReceiver:
             else asyncio.Queue(maxsize + 1)
         )
         self.maxsize = maxsize
+        self.tenant = tenant
+        self._gauge = _QUEUE_DEPTH.labels(tenant=tenant)
         self._depth = 0
         self._closed = False
 
@@ -194,12 +201,12 @@ class RequestReceiver:
             raise RequestError(RequestError.Kind.INTERNAL, "request channel full")
         self._queue.put_nowait(env)
         self._depth += 1
-        _QUEUE_DEPTH.set(self._depth)
+        self._gauge.set(self._depth)
 
     def _dequeued(self, env: Optional[_Envelope]) -> Optional[_Envelope]:
         if env is not None:
             self._depth -= 1
-            _QUEUE_DEPTH.set(self._depth)
+            self._gauge.set(self._depth)
         return env
 
     async def next_request(self) -> _Envelope:
@@ -221,7 +228,12 @@ class RequestReceiver:
 
     def close(self) -> None:
         """Shut the channel: every queued request is rejected immediately so
-        an in-flight ``request()`` can never hang on a dead state machine."""
+        an in-flight ``request()`` can never hang on a dead state machine.
+
+        Scope: strictly THIS channel. The purge resolves only futures
+        queued here, and only this tenant's depth gauge child zeroes —
+        closing one tenant's channel must never strand or misaccount
+        another tenant's in-flight requests (docs/DESIGN.md §19)."""
         if self._closed:
             return
         self._closed = True
@@ -238,7 +250,7 @@ class RequestReceiver:
             if not env.response.done():
                 env.response.set_exception(error)
         self._depth = 0
-        _QUEUE_DEPTH.set(0)
+        self._gauge.set(0)
         self._queue.put_nowait(None)
 
     def sender(self) -> "RequestSender":

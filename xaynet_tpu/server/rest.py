@@ -25,6 +25,7 @@ import logging
 import math
 import ssl
 import time
+from dataclasses import dataclass
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
@@ -36,6 +37,22 @@ from .requests import RequestError
 from .services import Fetcher, PetMessageHandler, ServiceError
 
 logger = logging.getLogger("xaynet.rest")
+
+
+@dataclass
+class TenantRoutes:
+    """One tenant's REST surface: what ``/t/<tenant>/...`` dispatches to.
+
+    The default tenant's routes double as the bare legacy paths
+    (``/params`` == ``/t/<default>/params``), so single-tenant deployments
+    and old SDKs keep working unchanged (docs/DESIGN.md §19).
+    """
+
+    fetcher: Fetcher
+    handler: PetMessageHandler
+    pipeline: object = None  # ingest.IngestPipeline
+    edge_api: object = None  # edge.api.EdgeCoordinatorApi
+    health_extra: object = None  # zero-arg callable merged into /healthz
 
 MAX_BODY = 1 << 32  # u32 length field ceiling, as in the reference
 
@@ -71,6 +88,7 @@ class RestServer:
         pipeline=None,
         edge_api=None,
         health_extra=None,
+        tenants: Optional[dict[str, TenantRoutes]] = None,
     ):
         # `registry` selects what GET /metrics renders. Hot-path modules
         # (request queue, message pipeline, kernel profiling, dispatcher)
@@ -86,18 +104,30 @@ class RestServer:
         # `health_extra` is a zero-arg callable whose dict is merged into
         # the /healthz payload (the edge runner reports its upstream link
         # and envelope backlog through this hook).
+        # `tenants` maps tenant id -> TenantRoutes for /t/<tenant>/...
+        # routing; the positional args above stay the DEFAULT tenant (and
+        # the bare legacy routes). None = single-tenant, as before.
         self.fetcher = fetcher
         self.handler = handler
         self.pipeline = pipeline
         self.edge_api = edge_api
         self.health_extra = health_extra
+        self._default_routes = TenantRoutes(
+            fetcher=fetcher,
+            handler=handler,
+            pipeline=pipeline,
+            edge_api=edge_api,
+            health_extra=health_extra,
+        )
+        self.tenants: dict[str, TenantRoutes] = dict(tenants or {})
         self.read_timeout = read_timeout  # slow-client defense
         self.registry = registry if registry is not None else get_registry()
         self._started_at = time.monotonic()
         self._http_requests = self.registry.counter(
             "xaynet_http_requests_total",
-            "REST requests by method, route and status code.",
-            ("method", "path", "status"),
+            "REST requests by method, route, status code and tenant "
+            "('' = the bare single-tenant routes).",
+            ("method", "path", "status", "tenant"),
         )
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -157,42 +187,69 @@ class RestServer:
             except Exception:  # lint: swallow-ok (best-effort socket teardown)
                 pass
 
+    def _resolve_tenant(self, path: str):
+        """Split a ``/t/<tenant>/<sub>`` target into (tenant id, sub path,
+        routes); bare paths resolve to the default tenant's routes with an
+        empty tenant label. Unknown tenants resolve to ``routes=None``."""
+        if path != "/t" and not path.startswith("/t/"):
+            return "", path, self._default_routes
+        parts = path.split("/", 3)  # ["", "t", tenant, rest]
+        tid = parts[2] if len(parts) > 2 else ""
+        routes = self.tenants.get(tid)
+        sub = "/" + (parts[3] if len(parts) > 3 else "")
+        return tid, sub, routes
+
     async def _route(self, method: str, target: str, body: bytes, headers=None):
         url = urlparse(target)
         headers = headers or {}
+        tenant, path, routes = self._resolve_tenant(url.path)
+        if routes is None:
+            # unknown tenant: closed-cardinality labels (the id is
+            # attacker-controlled), no dispatch
+            self._http_requests.labels(
+                method=method if method in _KNOWN_METHODS else "other",
+                path="other",
+                status=404,
+                tenant="other",
+            ).inc()
+            return 404, b"unknown tenant", "text/plain", None
         # handlers return (status, payload, ctype) or + an extra-headers dict
-        if url.path in _UNTRACED_PATHS:
-            result = await self._dispatch(method, url, body, headers)
+        if path in _UNTRACED_PATHS:
+            result = await self._dispatch(method, path, url.query, body, headers, routes)
         else:
             # the request span adopts the caller's trace (X-Xaynet-Trace:
             # SDK / edge hop) and sets the ambient context, so the ingest
             # admission span below lands in the same trace
             remote = trace.parse_header(headers.get(trace.TRACE_HEADER.lower()))
             with trace.get_tracer().span(
-                SPAN_REQUEST, link=remote, method=method, path=url.path
+                SPAN_REQUEST, link=remote, method=method, path=path, tenant=tenant
             ) as span:
-                result = await self._dispatch(method, url, body, headers)
+                result = await self._dispatch(method, path, url.query, body, headers, routes)
                 span.set(status=result[0])
         status, payload, ctype = result[:3]
         extra = result[3] if len(result) > 3 else None
         self._http_requests.labels(
             method=method if method in _KNOWN_METHODS else "other",
-            path=url.path if url.path in _KNOWN_PATHS else "other",
+            path=path if path in _KNOWN_PATHS else "other",
             status=status,
+            # tenant ids come from the operator's [tenancy] config (a
+            # validated closed set), never from the wire: unknown ids
+            # bounced above with tenant="other"
+            tenant=tenant,
         ).inc()
         return status, payload, ctype, extra
 
-    async def _dispatch(self, method: str, url, body: bytes, headers=None):
-        path = url.path
+    async def _dispatch(self, method: str, path: str, query: str, body: bytes,
+                        headers, routes: TenantRoutes):
         try:
             if method == "POST" and path == "/message":
-                return await self._post_message(body)
-            if self.edge_api is not None and path.startswith("/edge/"):
-                return await self._edge_route(method, path, body, headers or {})
+                return await self._post_message(body, routes)
+            if routes.edge_api is not None and path.startswith("/edge/"):
+                return await self._edge_route(method, path, body, headers or {}, routes)
             if method == "GET" and path == "/params":
-                return 200, json.dumps(self.fetcher.round_params().to_dict()).encode(), "application/json"
+                return 200, json.dumps(routes.fetcher.round_params().to_dict()).encode(), "application/json"
             if method == "GET" and path == "/sums":
-                sums = self.fetcher.sum_dict()
+                sums = routes.fetcher.sum_dict()
                 if sums is None:
                     return 204, b"", "text/plain"
                 return (
@@ -201,11 +258,11 @@ class RestServer:
                     "application/json",
                 )
             if method == "GET" and path == "/seeds":
-                qs = parse_qs(url.query)
+                qs = parse_qs(query)
                 pk_hex = (qs.get("pk") or [""])[0]
                 if not pk_hex:
                     return 400, b"missing pk", "text/plain"
-                seeds = self.fetcher.seeds_for(bytes.fromhex(pk_hex))
+                seeds = routes.fetcher.seeds_for(bytes.fromhex(pk_hex))
                 if seeds is None:
                     return 204, b"", "text/plain"
                 return (
@@ -221,27 +278,30 @@ class RestServer:
                 )
             if method == "GET" and path == "/healthz":
                 # liveness + the coarse round position, cheap enough to poll
-                payload = self._health_payload()
+                payload = self._health_payload(routes)
                 payload["status"] = "ok"
                 payload["uptime_seconds"] = round(time.monotonic() - self._started_at, 3)
-                if self.pipeline is not None:
-                    ingest = self.pipeline.health()
+                if routes.pipeline is not None:
+                    ingest = routes.pipeline.health()
                     payload["ingest"] = ingest
                     if ingest["saturated"]:
                         payload["status"] = "saturated"
                 streaming = self._streaming_health()
                 if streaming is not None:
                     payload["pipeline"] = streaming
-                if self.health_extra is not None:
+                tenancy = self._tenancy_health()
+                if tenancy is not None:
+                    payload["tenancy"] = tenancy
+                if routes.health_extra is not None:
                     # role-specific sections (the edge runner reports its
                     # upstream link + envelope backlog here); an extra
                     # "status" key overrides ok (e.g. upstream unreachable)
-                    payload.update(self.health_extra())
+                    payload.update(routes.health_extra())
                 return 200, json.dumps(payload).encode(), "application/json"
             if method == "GET" and path == "/health":
-                return 200, json.dumps(self._health_payload()).encode(), "application/json"
+                return 200, json.dumps(self._health_payload(routes)).encode(), "application/json"
             if method == "GET" and path == "/model":
-                model = self.fetcher.model()
+                model = routes.fetcher.model()
                 if model is None:
                     return 204, b"", "text/plain"
                 return 200, np.asarray(model, dtype=np.float64).tobytes(), "application/octet-stream"
@@ -249,6 +309,25 @@ class RestServer:
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)
             return 500, str(err).encode(), "text/plain"
+
+    def _tenancy_health(self) -> dict | None:
+        """The multi-tenant /healthz section: registered tenants, each
+        tenant's phase/round, and the shared pool's page accounting.
+        ``None`` (no section) for single-tenant deployments."""
+        if not self.tenants:
+            return None
+        from ..tenancy.pool import get_pool
+
+        return {
+            "tenants": {
+                tid: {
+                    "phase": r.fetcher.phase().value,
+                    "round_id": r.fetcher.events.params.get_latest().round_id,
+                }
+                for tid, r in self.tenants.items()
+            },
+            "pool": get_pool().stats(),
+        }
 
     def _streaming_health(self) -> dict | None:
         """The streaming-fold ``pipeline`` section of /healthz, read from
@@ -285,7 +364,8 @@ class RestServer:
             }
         return section
 
-    async def _edge_route(self, method: str, path: str, body: bytes, headers: dict):
+    async def _edge_route(self, method: str, path: str, body: bytes, headers: dict,
+                          routes: TenantRoutes):
         """Edge-tier endpoints (served only with ``[edge] enabled = true``).
 
         Status mapping for POST /edge/envelope keeps the edge's retry
@@ -296,7 +376,8 @@ class RestServer:
         """
         from ..edge.envelope import EnvelopeError
 
-        if not self.edge_api.authorized(headers):
+        edge_api = routes.edge_api
+        if not edge_api.authorized(headers):
             return 401, b"bad edge token", "text/plain"
         if method == "GET" and path == "/edge/round":
             # the round handoff IS the protocol: a trusted edge needs the
@@ -304,12 +385,12 @@ class RestServer:
             # behind the constant-time token check above
             return (
                 200,
-                json.dumps(self.edge_api.round_info()).encode(),  # lint: taint-ok: edge round handoff
+                json.dumps(edge_api.round_info()).encode(),  # lint: taint-ok: edge round handoff
                 "application/json",
             )
         if method == "POST" and path == "/edge/envelope":
             try:
-                accepted, detail = await self.edge_api.submit_envelope(body)
+                accepted, detail = await edge_api.submit_envelope(body)
             except EnvelopeError as err:
                 return 400, f"bad envelope: {err}".encode(), "text/plain"
             except RequestError as err:
@@ -321,16 +402,16 @@ class RestServer:
             return 200, b"", "text/plain"
         return 404, b"not found", "text/plain"
 
-    def _health_payload(self) -> dict:
+    def _health_payload(self, routes: TenantRoutes) -> dict:
         """Shared by /health (legacy shape) and /healthz (superset)."""
         return {
-            "phase": self.fetcher.phase().value,
-            "round_id": self.fetcher.events.params.get_latest().round_id,
+            "phase": routes.fetcher.phase().value,
+            "round_id": routes.fetcher.events.params.get_latest().round_id,
         }
 
-    async def _post_message(self, body: bytes):
-        if self.pipeline is not None:
-            verdict = await self.pipeline.submit(body)
+    async def _post_message(self, body: bytes, routes: TenantRoutes):
+        if routes.pipeline is not None:
+            verdict = await routes.pipeline.submit(body)
             if verdict.shed:
                 retry = str(max(1, math.ceil(verdict.retry_after)))
                 return (
@@ -344,7 +425,7 @@ class RestServer:
             # progression, not the POST status
             return 200, b"", "text/plain"
         try:
-            await self.handler.handle_message(body)
+            await routes.handler.handle_message(body)
         except (ServiceError, RequestError) as err:
             # the reference answers 200 regardless and logs the drop —
             # clients learn outcomes from round progression, not the POST

@@ -36,7 +36,17 @@ from .state_machine import StateMachineInitializer
 logger = logging.getLogger("xaynet.coordinator")
 
 
-def init_store(settings: Settings) -> Store:
+def init_store(settings: Settings, tenant: str = "default") -> Store:
+    # tenant-scoped storage keys (docs/DESIGN.md §19): a non-default tenant
+    # prefixes every durable key — redis keys get "t:<tenant>:", file/
+    # filesystem backends get a "t-<tenant>" subtree — so N tenants share
+    # one backend without key collisions. The default tenant keeps the
+    # historical flat layout (single-tenant deployments are unchanged).
+    scoped_dir = settings.storage.model_dir
+    if tenant != "default":
+        import os as _os
+
+        scoped_dir = _os.path.join(settings.storage.model_dir, f"t-{tenant}")
     if settings.storage.coordinator == "redis":
         from ..storage.redis import RedisCoordinatorStorage
 
@@ -44,17 +54,19 @@ def init_store(settings: Settings) -> Store:
             host=settings.storage.redis_host,
             port=settings.storage.redis_port,
             db=settings.storage.redis_db,
+            key_prefix="" if tenant == "default" else f"t:{tenant}:",
         )
     elif settings.storage.coordinator == "file":
         import os
 
+        os.makedirs(scoped_dir, exist_ok=True)
         coordinator = FileCoordinatorStorage(
-            os.path.join(settings.storage.model_dir, "coordinator_state.json")
+            os.path.join(scoped_dir, "coordinator_state.json")
         )
     else:
         coordinator = InMemoryCoordinatorStorage()
     if settings.storage.backend == "filesystem":
-        models = FilesystemModelStorage(settings.storage.model_dir)
+        models = FilesystemModelStorage(scoped_dir)
     elif settings.storage.backend == "s3":
         from ..storage.s3 import S3ModelStorage
 
@@ -106,6 +118,11 @@ def init_logging(settings: Settings) -> None:
 
 
 async def serve(settings: Settings, store: Optional[Store] = None) -> None:
+    if settings.tenancy.enabled:
+        # multi-tenant wiring: one process, one REST listener, N tenant
+        # round pipelines over the shared mesh/pool/scheduler (§19)
+        await serve_tenants(settings)
+        return
     init_logging(settings)
     store = store if store is not None else init_store(settings)
     if settings.storage.backend == "s3":
@@ -210,6 +227,190 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         # ... and the in-flight round's trace window (Chrome export)
         trace.get_tracer().end_round()
         logger.info("coordinator stopped")
+
+
+def _tenant_settings(base: Settings, tenant: str) -> Settings:
+    """One tenant's effective settings: ``config_dir/<tenant>.toml`` when
+    present (full settings file, normal loader + env overrides), else a
+    copy of the base. The per-tenant copy never re-enters multi-tenant
+    wiring (its [tenancy] section is cleared)."""
+    import copy
+
+    from .settings import TenancySettings
+
+    cfg = None
+    if base.tenancy.config_dir:
+        path = os.path.join(base.tenancy.config_dir, f"{tenant}.toml")
+        if os.path.exists(path):
+            cfg = Settings.load(path)
+            logger.info("tenant %s: settings loaded from %s", tenant, path)
+    if cfg is None:
+        cfg = copy.deepcopy(base)
+    cfg.tenancy = TenancySettings()
+    return cfg
+
+
+async def serve_tenants(settings: Settings) -> None:
+    """Multi-tenant coordinator (docs/DESIGN.md §19): one process serves
+    every ``[tenancy] tenants`` id — each a full, independent round
+    pipeline (scoped store, request channel, ingest, phase machine) —
+    over ONE mesh, ONE paged accumulator pool, ONE fold-batch scheduler
+    and ONE REST listener routing ``/t/<tenant>/...`` (the first tenant
+    also serves the bare legacy routes)."""
+    from ..ingest import IngestPipeline
+    from ..resilience import wrap_store
+    from ..telemetry import recorder as flight_recorder, tracing as trace
+    from ..tenancy import (
+        TenantAdmissionBudget,
+        TenantContext,
+        TenantRegistry,
+        configure_pool,
+        configure_scheduler,
+    )
+    from .rest import TenantRoutes
+
+    init_logging(settings)
+    ten = settings.tenancy
+    configure_pool(ten.page_kib, ten.slab_pages, ten.host_pages, ten.device_pages)
+    configure_scheduler(ten.max_inflight_folds)
+    budget = TenantAdmissionBudget(ten.ingest_capacity, ten.max_share)
+    if settings.resilience.fault_plan:
+        from ..resilience import FaultPlan, install_plan
+
+        install_plan(FaultPlan.parse(settings.resilience.fault_plan))
+        logger.warning("fault plan installed: %s", settings.resilience.fault_plan)
+    trace.get_tracer().configure(
+        mode=settings.metrics.trace or None,
+        trace_dir=settings.metrics.trace_dir or None,
+    )
+    flight_recorder.get_recorder().configure(settings.metrics.flight_dir or None)
+
+    registry = TenantRegistry()
+    routes: dict[str, TenantRoutes] = {}
+    for tenant in ten.tenants:
+        tset = _tenant_settings(settings, tenant)
+        raw_store = init_store(tset, tenant)
+        if tset.storage.backend == "s3":
+            # same startup contract as the single-tenant serve() path:
+            # the bucket must exist before the first model save
+            from ..storage.s3 import S3ModelStorage
+
+            if isinstance(raw_store.models, S3ModelStorage):
+                await raw_store.models.create_bucket()
+        store = wrap_store(raw_store, tset.resilience)
+        reporter = (
+            RoundReporter(tset.metrics.round_report_path, tenant=tenant)
+            if tset.metrics.round_report_path
+            else None
+        )
+        metrics = BridgedMetrics(sink=init_metrics(tset), reporter=reporter)
+        initializer = StateMachineInitializer(tset, store, metrics, tenant=tenant)
+        machine, request_tx, events = await initializer.init()
+        handler = PetMessageHandler(
+            events, request_tx, wire_ingest=tset.aggregation.wire_ingest
+        )
+        fetcher = Fetcher(events)
+        pipeline = None
+        if tset.ingest.enabled:
+            pipeline = IngestPipeline(
+                handler, request_tx, events, tset.ingest,
+                tenant=tenant, budget=budget,
+            )
+            await pipeline.start()
+        edge_api = None
+        if tset.edge.enabled:
+            from ..edge.api import EdgeCoordinatorApi
+
+            edge_api = EdgeCoordinatorApi(events, request_tx, token=tset.edge.token)
+        registry.add(
+            TenantContext(
+                tenant=tenant,
+                settings=tset,
+                store=store,
+                machine=machine,
+                request_tx=request_tx,
+                events=events,
+                handler=handler,
+                fetcher=fetcher,
+                pipeline=pipeline,
+                edge_api=edge_api,
+                metrics=metrics,
+            )
+        )
+        routes[tenant] = TenantRoutes(
+            fetcher=fetcher,
+            handler=handler,
+            pipeline=pipeline,
+            edge_api=edge_api,
+        )
+        logger.info(
+            "tenant %s: model_len=%d group=%s (round pipeline up)",
+            tenant,
+            tset.model.length,
+            tset.mask.group_type.name,
+        )
+
+    default = registry.default
+    rest = RestServer(
+        default.fetcher,
+        default.handler,
+        registry=default.metrics.registry,
+        pipeline=default.pipeline,
+        edge_api=default.edge_api,
+        tenants=routes,
+    )
+    host, _, port = settings.api.bind_address.partition(":")
+    tls = None
+    if settings.api.tls_certificate:
+        import ssl
+
+        tls = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        tls.load_cert_chain(settings.api.tls_certificate, settings.api.tls_key)
+        if settings.api.tls_client_auth:
+            tls.verify_mode = ssl.CERT_REQUIRED
+            tls.load_verify_locations(settings.api.tls_client_auth)
+    await rest.start(host or "127.0.0.1", int(port or 8081), tls)
+    logger.info(
+        "multi-tenant coordinator up: %d tenants (%s), default=%s",
+        len(registry),
+        ", ".join(registry.ids()),
+        default.tenant,
+    )
+
+    stop = asyncio.get_running_loop().create_future()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            asyncio.get_running_loop().add_signal_handler(sig, lambda: stop.cancel())
+        except NotImplementedError:  # pragma: no cover (non-unix)
+            pass
+
+    for ctx in registry.contexts():
+        ctx.task = asyncio.create_task(
+            ctx.machine.run(), name=f"machine-{ctx.tenant}"
+        )
+    tasks = [ctx.task for ctx in registry.contexts()]
+    try:
+        done, _ = await asyncio.wait(
+            [*tasks, stop], return_when=asyncio.FIRST_COMPLETED
+        )
+    except asyncio.CancelledError:
+        pass
+    finally:
+        for ctx in registry.contexts():
+            if ctx.task is not None:
+                ctx.task.cancel()
+            # same rationale as the single-tenant path: reject queued +
+            # in-flight requests so draining components fail fast —
+            # strictly per channel, one tenant's shutdown never strands
+            # another tenant's requests
+            ctx.request_tx.close()
+        await rest.stop()
+        for ctx in registry.contexts():
+            if ctx.pipeline is not None:
+                await ctx.pipeline.stop()
+            ctx.metrics.close()
+        trace.get_tracer().end_round()
+        logger.info("multi-tenant coordinator stopped")
 
 
 def _pin_jax_platform() -> None:

@@ -434,6 +434,66 @@ class EdgeSettings:
 
 
 @dataclass
+class TenancySettings:
+    """``[tenancy]`` — multi-tenant coordinator over the paged accumulator
+    pool (docs/DESIGN.md §19).
+
+    With ``enabled = true`` one coordinator process runs one full round
+    pipeline per id in ``tenants`` — each with its own mask config, model
+    length and liveness policy (per-tenant override TOML in
+    ``config_dir/<tenant>.toml``, loaded through the normal settings
+    loader) — sharing the mesh, the page pool and the REST listener. The
+    FIRST id doubles as the default tenant serving the bare legacy routes;
+    every tenant is also reachable under ``/t/<tenant>/...``.
+
+    Pool knobs size the shared arena (pages of ``page_kib`` KiB; 0 caps =
+    uncapped, the host arena grows by ``slab_pages``-page slabs);
+    ``max_inflight_folds`` bounds fold batches in flight across ALL
+    tenants (the scheduler's backpressure); ``ingest_capacity`` and
+    ``max_share`` shape the per-tenant admission budget layered on each
+    tenant's AdmissionController.
+    """
+
+    enabled: bool = False
+    tenants: list = field(default_factory=list)  # validated tenant ids
+    config_dir: str = ""  # per-tenant override TOMLs: <dir>/<tenant>.toml
+    page_kib: int = 1024  # pool page size (multiple of 4 KiB)
+    slab_pages: int = 64  # host-arena growth granularity
+    host_pages: int = 0  # 0 = uncapped
+    device_pages: int = 0  # 0 = uncapped
+    max_inflight_folds: int = 8  # cross-tenant fold-batch bound
+    ingest_capacity: int = 4096  # process-wide admission budget (messages)
+    max_share: float = 0.6  # one tenant's ceiling of that budget
+
+    def validate(self) -> None:
+        from ..tenancy.registry import validate_tenant_id
+
+        if self.enabled and not self.tenants:
+            raise SettingsError("tenancy.enabled requires at least one tenant id")
+        seen = set()
+        for tid in self.tenants:
+            try:
+                validate_tenant_id(str(tid))
+            except ValueError as e:
+                raise SettingsError(f"tenancy.tenants: {e}") from e
+            if tid in seen:
+                raise SettingsError(f"tenancy.tenants: duplicate id {tid!r}")
+            seen.add(tid)
+        if self.page_kib < 4 or self.page_kib % 4:
+            raise SettingsError("tenancy.page_kib must be a multiple of 4 (>= 4)")
+        if self.slab_pages < 1:
+            raise SettingsError("tenancy.slab_pages must be >= 1")
+        if self.host_pages < 0 or self.device_pages < 0:
+            raise SettingsError("tenancy.host_pages/device_pages must be >= 0")
+        if self.max_inflight_folds < 1:
+            raise SettingsError("tenancy.max_inflight_folds must be >= 1")
+        if self.ingest_capacity < 1:
+            raise SettingsError("tenancy.ingest_capacity must be >= 1")
+        if not (0.0 < self.max_share <= 1.0):
+            raise SettingsError("tenancy.max_share must be in (0, 1]")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -448,10 +508,12 @@ class Settings:
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings)
     liveness: LivenessSettings = field(default_factory=LivenessSettings)
     edge: EdgeSettings = field(default_factory=EdgeSettings)
+    tenancy: TenancySettings = field(default_factory=TenancySettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
+        self.tenancy.validate()
         try:
             self.mask.to_config()  # quant level vs data/bound-type ceiling
         except ValueError as e:
@@ -567,6 +629,8 @@ class Settings:
         live_base = base.liveness
         edge_raw = raw.get("edge", {})
         edge_base = base.edge
+        ten_raw = raw.get("tenancy", {})
+        ten_base = base.tenancy
 
         return cls(
             pet=PetSettings(
@@ -716,6 +780,28 @@ class Settings:
                 max_members=int(edge_raw.get("max_members", edge_base.max_members)),
                 linger_s=float(edge_raw.get("linger_s", edge_base.linger_s)),
                 poll_s=float(edge_raw.get("poll_s", edge_base.poll_s)),
+            ),
+            tenancy=TenancySettings(
+                enabled=bool(ten_raw.get("enabled", ten_base.enabled)),
+                # a TOML array, or a comma-separated string (env overrides
+                # and the mini-TOML fallback deliver strings)
+                tenants=(
+                    [t.strip() for t in ten_raw["tenants"].split(",") if t.strip()]
+                    if isinstance(ten_raw.get("tenants"), str)
+                    else [str(t) for t in ten_raw.get("tenants", ten_base.tenants)]
+                ),
+                config_dir=str(ten_raw.get("config_dir", ten_base.config_dir)),
+                page_kib=int(ten_raw.get("page_kib", ten_base.page_kib)),
+                slab_pages=int(ten_raw.get("slab_pages", ten_base.slab_pages)),
+                host_pages=int(ten_raw.get("host_pages", ten_base.host_pages)),
+                device_pages=int(ten_raw.get("device_pages", ten_base.device_pages)),
+                max_inflight_folds=int(
+                    ten_raw.get("max_inflight_folds", ten_base.max_inflight_folds)
+                ),
+                ingest_capacity=int(
+                    ten_raw.get("ingest_capacity", ten_base.ingest_capacity)
+                ),
+                max_share=float(ten_raw.get("max_share", ten_base.max_share)),
             ),
         )
 

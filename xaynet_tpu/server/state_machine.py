@@ -54,10 +54,15 @@ class RestoreError(RuntimeError):
 class StateMachineInitializer:
     """Builds (StateMachine, RequestSender, EventSubscriber) from settings."""
 
-    def __init__(self, settings: Settings, store: Store, metrics=None):
+    def __init__(self, settings: Settings, store: Store, metrics=None,
+                 tenant: str = "default"):
         settings.validate()
         self.settings = settings
         self.store = store
+        # the tenant id this machine's round state belongs to: threads into
+        # Shared (pool leases, scheduler slots, span/flight labels) and the
+        # per-tenant round counters (docs/DESIGN.md §19)
+        self.tenant = tenant
         # phase histograms and message counters must reach GET /metrics even
         # when no external sink is configured: default to a registry-only
         # bridge (callers may still inject any recorder, e.g. test spies)
@@ -131,7 +136,7 @@ class StateMachineInitializer:
         def factory(shared: Shared) -> PhaseState:
             from .phases.update import UpdatePhase
 
-            shared.resume_attempts += 1
+            shared.resume_attempts += 1  # lint: tenant-ok: budget lives on this tenant's own Shared
             return UpdatePhase(shared, resume_from=ckpt)
 
         return factory
@@ -149,7 +154,7 @@ class StateMachineInitializer:
             phase=PhaseName.IDLE,
             model=model_update,
         )
-        request_rx = RequestReceiver()
+        request_rx = RequestReceiver(tenant=self.tenant)
         round_ctl = None
         if self.settings.liveness.adaptive:
             from .round_controller import RoundController
@@ -163,6 +168,7 @@ class StateMachineInitializer:
             settings=self.settings,
             metrics=self.metrics,
             round_ctl=round_ctl,
+            tenant=self.tenant,
         )
         initial = initial_factory(shared) if initial_factory is not None else Idle(shared)
         machine = StateMachine(initial)

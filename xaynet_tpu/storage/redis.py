@@ -237,24 +237,32 @@ _K_ROUND_CKPT = b"round_checkpoint"
 class RedisCoordinatorStorage(CoordinatorStorage):
     """Coordinator storage over Redis with Lua-scripted atomicity."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379, db: int = 0,
+                 key_prefix: str = ""):
+        # `key_prefix` namespaces every round-state key (multi-tenant
+        # coordinators share one redis db with per-tenant prefixes,
+        # docs/DESIGN.md §19); "" keeps the historical flat keyspace
         self.client = RespClient(host, port, db)
+        self._p = key_prefix.encode()
+
+    def _k(self, key: bytes) -> bytes:
+        return self._p + key
 
     async def set_coordinator_state(self, state: bytes) -> None:
-        await self.client.command(b"SET", _K_STATE, state)
+        await self.client.command(b"SET", self._k(_K_STATE), state)
 
     async def coordinator_state(self) -> Optional[bytes]:
-        return await self.client.command(b"GET", _K_STATE)
+        return await self.client.command(b"GET", self._k(_K_STATE))
 
     async def add_sum_participant(self, pk: bytes, ephm_pk: bytes) -> Optional[SumPartAddError]:
         ok = await self.client.command(
-            b"EVAL", ADD_SUM_PARTICIPANT, b"1", _K_SUM_DICT, pk, ephm_pk,
+            b"EVAL", ADD_SUM_PARTICIPANT, b"1", self._k(_K_SUM_DICT), pk, ephm_pk,
             replay_safe=False,
         )
         return None if ok == 1 else SumPartAddError.ALREADY_EXISTS
 
     async def sum_dict(self):
-        flat = await self.client.command(b"HGETALL", _K_SUM_DICT)
+        flat = await self.client.command(b"HGETALL", self._k(_K_SUM_DICT))
         if not flat:
             return None
         return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
@@ -267,7 +275,7 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             seed_bytes = seed.as_bytes() if isinstance(seed, EncryptedMaskSeed) else bytes(seed)
             argv += [sum_pk, seed_bytes]
         code = await self.client.command(
-            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", _K_SUM_DICT, _K_UPDATE_SET, *argv,
+            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", self._k(_K_SUM_DICT), self._k(_K_UPDATE_SET), *argv,
             replay_safe=False,
         )
         return {
@@ -284,7 +292,7 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             return None
         out = {}
         for sum_pk in sums:
-            flat = await self.client.command(b"HGETALL", b"seed_dict:" + sum_pk)
+            flat = await self.client.command(b"HGETALL", self._k(b"seed_dict:") + sum_pk)
             out[sum_pk] = {
                 flat[i]: EncryptedMaskSeed(flat[i + 1]) for i in range(0, len(flat), 2)
             }
@@ -295,9 +303,9 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             b"EVAL",
             INCR_MASK_SCORE,
             b"3",
-            _K_SUM_DICT,
-            _K_MASK_SUBMITTED,
-            _K_MASK_DICT,
+            self._k(_K_SUM_DICT),
+            self._k(_K_MASK_SUBMITTED),
+            self._k(_K_MASK_DICT),
             pk,
             serialize_mask_object(mask),
             replay_safe=False,
@@ -310,7 +318,7 @@ class RedisCoordinatorStorage(CoordinatorStorage):
 
     async def best_masks(self):
         reply = await self.client.command(
-            b"ZREVRANGE", _K_MASK_DICT, b"0", b"1", b"WITHSCORES"
+            b"ZREVRANGE", self._k(_K_MASK_DICT), b"0", b"1", b"WITHSCORES"
         )
         if not reply:
             return None
@@ -321,32 +329,48 @@ class RedisCoordinatorStorage(CoordinatorStorage):
         return out
 
     async def number_of_unique_masks(self) -> int:
-        return int(await self.client.command(b"ZCARD", _K_MASK_DICT))
+        return int(await self.client.command(b"ZCARD", self._k(_K_MASK_DICT)))
 
     async def delete_coordinator_data(self) -> None:
-        await self.client.command(b"FLUSHDB")
+        if not self._p:
+            await self.client.command(b"FLUSHDB")
+            return
+        # prefixed (multi-tenant) keyspaces: flush ONLY this tenant's keys
+        # — FLUSHDB would wipe every other tenant sharing the db. Cursor
+        # SCAN, not KEYS: a blocking full-keyspace walk would stall every
+        # OTHER tenant's round operations on a shared production server
+        cursor = b"0"
+        while True:
+            reply = await self.client.command(
+                b"SCAN", cursor, b"MATCH", self._p + b"*", b"COUNT", b"500"
+            )
+            cursor, keys = reply[0], reply[1]
+            if keys:
+                await self.client.command(b"DEL", *keys)
+            if cursor in (b"0", 0, "0"):
+                break
 
     async def delete_dicts(self) -> None:
-        sums = await self.client.command(b"HKEYS", _K_SUM_DICT) or []
-        keys = [_K_SUM_DICT, _K_UPDATE_SET, _K_MASK_SUBMITTED, _K_MASK_DICT]
-        keys += [b"seed_dict:" + pk for pk in sums]
+        sums = await self.client.command(b"HKEYS", self._k(_K_SUM_DICT)) or []
+        keys = [self._k(_K_SUM_DICT), self._k(_K_UPDATE_SET), self._k(_K_MASK_SUBMITTED), self._k(_K_MASK_DICT)]
+        keys += [self._k(b"seed_dict:") + pk for pk in sums]
         await self.client.command(b"DEL", *keys)
 
     async def set_latest_global_model_id(self, model_id: str) -> None:
-        await self.client.command(b"SET", _K_LATEST_MODEL, model_id.encode())
+        await self.client.command(b"SET", self._k(_K_LATEST_MODEL), model_id.encode())
 
     async def latest_global_model_id(self) -> Optional[str]:
-        v = await self.client.command(b"GET", _K_LATEST_MODEL)
+        v = await self.client.command(b"GET", self._k(_K_LATEST_MODEL))
         return v.decode() if v is not None else None
 
     async def set_round_checkpoint(self, data: bytes) -> None:
-        await self.client.command(b"SET", _K_ROUND_CKPT, data)
+        await self.client.command(b"SET", self._k(_K_ROUND_CKPT), data)
 
     async def round_checkpoint(self):
-        return await self.client.command(b"GET", _K_ROUND_CKPT)
+        return await self.client.command(b"GET", self._k(_K_ROUND_CKPT))
 
     async def delete_round_checkpoint(self) -> None:
-        await self.client.command(b"DEL", _K_ROUND_CKPT)
+        await self.client.command(b"DEL", self._k(_K_ROUND_CKPT))
 
     async def is_ready(self) -> None:
         pong = await self.client.command(b"PING")

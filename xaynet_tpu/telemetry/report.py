@@ -99,11 +99,30 @@ def _bytes_counters() -> dict[str, dict[str, float]]:
     return out
 
 
+def _fairness_snapshot() -> Optional[dict]:
+    """Per-tenant fold-batch grants since the previous round flush, read
+    from the tenant scheduler (lazy import: telemetry must not pull the
+    tenancy machinery into processes that never aggregate). ``None`` until
+    the scheduler has granted slots to MORE than one tenant — single-tenant
+    reports don't grow a trivial section."""
+    from ..tenancy.scheduler import get_scheduler
+
+    split = get_scheduler().split()
+    # cumulative (not a drained window): each tenant's reporter flushes on
+    # its own round cadence, and a shared drained delta would let one
+    # tenant's flush steal another's window; consumers diff consecutive
+    # reports for per-round rates
+    return split if len(split) >= 2 else None
+
+
 class RoundReporter:
     """Accumulates one round's telemetry and writes it as a JSON line."""
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None, tenant: str = "default"):
         self.path = path
+        # the tenant this reporter's rounds belong to: stamped on every
+        # report line so N tenants can share one JSONL file (§19)
+        self.tenant = tenant
         self.last_report: Optional[dict] = None
         self._lock = threading.Lock()
         self._round_id: Optional[int] = None
@@ -164,6 +183,7 @@ class RoundReporter:
         report = {
             "ts": round(time.time(), 3),
             "round_id": self._round_id,
+            "tenant": self.tenant,
             "seconds": round(time.time() - self._started, 3),
             "phases": self._phases,
             "phase_durations": dict(self._durations),
@@ -172,6 +192,13 @@ class RoundReporter:
             "kernels": profiling.drain_round_stats(),
             "events": self._events,
         }
+        fairness = _fairness_snapshot()
+        if fairness is not None:
+            # the tenant scheduler's fold-batch split since the last round
+            # flush: how this round's device work interleaved across
+            # tenants (docs/DESIGN.md §19). Only present once the
+            # scheduler has actually granted multi-tenant slots.
+            report["fairness"] = fairness
         streaming = _streaming_snapshot()
         if streaming is not None:
             report["streaming"] = streaming

@@ -1,0 +1,37 @@
+"""Multi-tenant coordinator plumbing (docs/DESIGN.md §19).
+
+- :mod:`pool` — the paged accumulator pool: fixed-size pages, host slab
+  arena + device capacity ledger, per-tenant page tables, lease/release
+  accounting with the round-end leases == releases invariant.
+- :mod:`scheduler` — the tenant fold-batch scheduler: bounded in-flight
+  slots across tenants, deficit-round-robin fairness, the round report's
+  fairness split.
+- :mod:`registry` — tenant specs/contexts, id validation, and the
+  per-tenant admission budget layered on the ingest pipeline.
+"""
+
+from .pool import PageLease, PagePool, PoolExhausted, configure_pool, get_pool
+from .registry import (
+    DEFAULT_TENANT,
+    TenantAdmissionBudget,
+    TenantContext,
+    TenantRegistry,
+    validate_tenant_id,
+)
+from .scheduler import TenantScheduler, configure_scheduler, get_scheduler
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "PageLease",
+    "PagePool",
+    "PoolExhausted",
+    "TenantAdmissionBudget",
+    "TenantContext",
+    "TenantRegistry",
+    "TenantScheduler",
+    "configure_pool",
+    "configure_scheduler",
+    "get_pool",
+    "get_scheduler",
+    "validate_tenant_id",
+]

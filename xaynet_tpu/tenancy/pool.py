@@ -1,0 +1,341 @@
+"""Paged accumulator pool: fixed-size limb-plane pages under lease accounting.
+
+The Ragged Paged Attention idiom (PAPERS.md) applied to aggregation
+accumulators instead of KV cache: tenants' variable-length masked models
+pack into one shared memory arena as runs of fixed-size pages, so many
+models of different lengths coexist without per-tenant worst-case
+reservations and without allocator fragmentation across rounds — a
+released run coalesces back into the free list and the next tenant's
+lease reuses the same physical pages.
+
+Two arenas, one accounting discipline:
+
+- **host arena** — real paging: a set of page-aligned uint8 slabs; a
+  lease carves a *contiguous page run* out of a slab and hands back a
+  typed numpy view. Contiguity per lease is the design point: every
+  existing fold kernel (native strided C++, XLA, pallas) reads plain
+  C-contiguous buffers, so paging lives at the allocator layer and the
+  hot path is byte-identical to owning a private buffer. Leased memory is
+  ZEROED before handoff — a page run previously owned by another tenant
+  must never leak that tenant's masked bytes (the PR-14 secret-hygiene
+  posture extended to memory reuse).
+- **device arena** — a capacity ledger: device fold kernels donate their
+  accumulators (`donate_argnums`), so a device buffer's identity is
+  ephemeral by design and literal page views cannot survive a fold. What
+  multi-tenant admission needs from HBM is the *budget*: the ledger
+  tracks pages leased per tenant against the configured capacity and
+  fails fast when a new tenant's plan would not fit.
+
+Accounting invariant (checked at round boundaries and by the
+``tenant-scope`` analysis pass's sanctioned-site whitelist): **leases ==
+releases at round end** — every page run leased for a round's shard plan
+and staging rings is released when the round's accumulator dies. The
+clean path releases explicitly (`StagedAggregator.release_pool`, ring
+close); `reclaim()` is the crash-path backstop the next round's Idle
+phase runs, counting every straggler it had to force-release.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..telemetry.registry import get_registry
+
+logger = logging.getLogger("xaynet.tenancy")
+
+_registry = get_registry()
+POOL_PAGES = _registry.gauge(
+    "xaynet_pool_pages",
+    "Pool pages currently leased, by arena (host | device) and tenant.",
+    ("arena", "tenant"),
+)
+POOL_LEASES = _registry.counter(
+    "xaynet_pool_leases_total",
+    "Page-run leases granted, by arena and tenant.",
+    ("arena", "tenant"),
+)
+POOL_RELEASES = _registry.counter(
+    "xaynet_pool_releases_total",
+    "Page-run leases released, by arena and tenant (reclaimed releases "
+    "count here too).",
+    ("arena", "tenant"),
+)
+POOL_RECLAIMED = _registry.counter(
+    "xaynet_pool_reclaimed_total",
+    "Leases force-released by the round-boundary reclaim (a crashed or "
+    "abandoned round leaked them past its unmask release).",
+    ("tenant",),
+)
+
+DEFAULT_PAGE_BYTES = 1 << 20  # 1 MiB: a few limb-plane columns per page
+DEFAULT_SLAB_PAGES = 64
+
+
+class PoolExhausted(RuntimeError):
+    """The arena's configured page capacity cannot satisfy the lease."""
+
+
+@dataclass
+class PageLease:
+    """One granted page run. ``array`` is the typed view for host leases
+    (None for device-ledger leases). Release is idempotent."""
+
+    tenant: str
+    arena: str  # "host" | "device"
+    lease_id: int
+    pages: int
+    slab: int = -1  # host: owning slab index
+    offset: int = -1  # host: first page within the slab
+    array: Optional[np.ndarray] = None
+    released: bool = field(default=False, repr=False)
+
+
+class _Slab:
+    """One page-aligned host slab with a sorted free-run list."""
+
+    def __init__(self, n_pages: int, page_bytes: int):
+        self.n_pages = n_pages
+        self.page_bytes = page_bytes
+        self.buf = np.zeros(n_pages * page_bytes, dtype=np.uint8)
+        self.free: list[tuple[int, int]] = [(0, n_pages)]  # (start, length)
+
+    def take(self, pages: int) -> Optional[int]:
+        """First-fit contiguous run; returns the start page or None."""
+        for i, (start, length) in enumerate(self.free):
+            if length >= pages:
+                if length == pages:
+                    del self.free[i]
+                else:
+                    self.free[i] = (start + pages, length - pages)
+                return start
+        return None
+
+    def give(self, start: int, pages: int) -> None:
+        """Return a run, coalescing with its neighbours."""
+        runs = self.free
+        runs.append((start, pages))
+        runs.sort()
+        merged: list[tuple[int, int]] = []
+        for s, l in runs:
+            if merged and merged[-1][0] + merged[-1][1] == s:
+                merged[-1] = (merged[-1][0], merged[-1][1] + l)
+            else:
+                merged.append((s, l))
+        self.free[:] = merged
+
+    @property
+    def free_pages(self) -> int:
+        return sum(l for _, l in self.free)
+
+
+class PagePool:
+    """Host-slab page allocator + device capacity ledger with per-tenant
+    page tables and lease/release accounting (docs/DESIGN.md §19)."""
+
+    def __init__(
+        self,
+        page_bytes: int = DEFAULT_PAGE_BYTES,
+        slab_pages: int = DEFAULT_SLAB_PAGES,
+        host_pages: int = 0,
+        device_pages: int = 0,
+    ):
+        if page_bytes < 4096 or page_bytes % 4096:
+            raise ValueError("page_bytes must be a positive multiple of 4096")
+        if slab_pages < 1:
+            raise ValueError("slab_pages must be >= 1")
+        self.page_bytes = page_bytes
+        self.slab_pages = slab_pages
+        # 0 = uncapped (the arena grows by slabs on demand); a cap makes
+        # lease() raise PoolExhausted instead of over-committing
+        self.host_pages = host_pages
+        self.device_pages = device_pages
+        self._lock = threading.Lock()
+        self._slabs: list[_Slab] = []  # guarded-by: _lock
+        self._leases: dict[int, PageLease] = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._in_use = {"host": 0, "device": 0}  # pages  # guarded-by: _lock
+
+    # -- leasing ------------------------------------------------------------
+
+    def pages_for(self, nbytes: int) -> int:
+        return max(1, -(-int(nbytes) // self.page_bytes))
+
+    def lease_host(self, tenant: str, shape: tuple, dtype) -> PageLease:
+        """Lease a contiguous page run and return it as a ZEROED
+        C-contiguous ``dtype[shape]`` view. Raises :class:`PoolExhausted`
+        only when a configured ``host_pages`` cap cannot fit the run."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        pages = self.pages_for(nbytes)
+        with self._lock:
+            if self.host_pages and self._in_use["host"] + pages > self.host_pages:
+                raise PoolExhausted(
+                    f"host arena: {pages} pages requested, "
+                    f"{self.host_pages - self._in_use['host']} of "
+                    f"{self.host_pages} available"
+                )
+            slab_idx, start = -1, None
+            for i, slab in enumerate(self._slabs):
+                start = slab.take(pages)
+                if start is not None:
+                    slab_idx = i
+                    break
+            if start is None:
+                # no run fits: grow the arena by one slab sized for the
+                # request (large models get a dedicated slab; small ones
+                # share the default slab granularity)
+                slab = _Slab(max(self.slab_pages, pages), self.page_bytes)
+                self._slabs.append(slab)
+                slab_idx = len(self._slabs) - 1
+                start = slab.take(pages)
+            lease = self._grant_locked(tenant, "host", pages, slab_idx, start)
+        raw = self._slabs[slab_idx].buf[
+            start * self.page_bytes : start * self.page_bytes + nbytes
+        ]
+        view = raw.view(dtype).reshape(shape)
+        view.fill(0)  # cross-tenant hygiene: never hand over another
+        # tenant's masked bytes
+        lease.array = view
+        return lease
+
+    def lease_device(self, tenant: str, nbytes: int) -> PageLease:
+        """Ledger-only device lease: accounts ``nbytes`` of HBM as pages
+        against the device capacity (device kernels donate buffers, so
+        literal page views cannot survive a fold — DESIGN §19)."""
+        pages = self.pages_for(nbytes)
+        with self._lock:
+            if self.device_pages and self._in_use["device"] + pages > self.device_pages:
+                raise PoolExhausted(
+                    f"device arena: {pages} pages requested, "
+                    f"{self.device_pages - self._in_use['device']} of "
+                    f"{self.device_pages} available"
+                )
+            return self._grant_locked(tenant, "device", pages, -1, -1)
+
+    def _grant_locked(
+        self, tenant: str, arena: str, pages: int, slab: int, offset: int
+    ) -> PageLease:
+        self._next_id += 1
+        lease = PageLease(
+            tenant=tenant,
+            arena=arena,
+            lease_id=self._next_id,
+            pages=pages,
+            slab=slab,
+            offset=offset if offset is not None else -1,
+        )
+        self._leases[lease.lease_id] = lease
+        self._in_use[arena] += pages
+        POOL_PAGES.labels(arena=arena, tenant=tenant).inc(pages)
+        POOL_LEASES.labels(arena=arena, tenant=tenant).inc()
+        return lease
+
+    def release(self, lease: PageLease) -> None:
+        """Return a lease's pages (idempotent: the GC finalizer backstop
+        and the explicit unmask-path release may both run)."""
+        with self._lock:
+            if lease.released or lease.lease_id not in self._leases:
+                return
+            lease.released = True
+            del self._leases[lease.lease_id]
+            self._in_use[lease.arena] -= lease.pages
+            if lease.arena == "host" and 0 <= lease.slab < len(self._slabs):
+                self._slabs[lease.slab].give(lease.offset, lease.pages)
+        lease.array = None
+        POOL_PAGES.labels(arena=lease.arena, tenant=lease.tenant).dec(lease.pages)
+        POOL_RELEASES.labels(arena=lease.arena, tenant=lease.tenant).inc()
+
+    # -- accounting ---------------------------------------------------------
+
+    def outstanding(self, tenant: Optional[str] = None) -> list[PageLease]:
+        with self._lock:
+            return [
+                l
+                for l in self._leases.values()
+                if tenant is None or l.tenant == tenant
+            ]
+
+    def balanced(self, tenant: str) -> bool:
+        """True when the tenant holds zero leases (the round-end invariant:
+        every lease was released)."""
+        return not self.outstanding(tenant)
+
+    def reclaim(self, tenant: str) -> int:
+        """Force-release every lease the tenant still holds — the
+        round-boundary backstop for rounds that died before their unmask
+        release. Returns the number reclaimed (0 on the healthy path)."""
+        stale = self.outstanding(tenant)
+        for lease in stale:
+            self.release(lease)
+        if stale:
+            POOL_RECLAIMED.labels(tenant=tenant).inc(len(stale))
+            logger.warning(
+                "pool: reclaimed %d leaked lease(s) (%d pages) from tenant %s",
+                len(stale),
+                sum(l.pages for l in stale),
+                tenant,
+            )
+        return len(stale)
+
+    def page_table(self, tenant: str) -> dict[int, dict]:
+        """The tenant's logical->physical mapping: lease id -> arena, slab,
+        page offset, run length (host leases; device leases carry -1)."""
+        with self._lock:
+            return {
+                l.lease_id: {
+                    "arena": l.arena,
+                    "slab": l.slab,
+                    "offset": l.offset,
+                    "pages": l.pages,
+                }
+                for l in self._leases.values()
+                if l.tenant == tenant
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "page_bytes": self.page_bytes,
+                "slabs": len(self._slabs),
+                "host_pages_in_use": self._in_use["host"],
+                "host_pages_free": sum(s.free_pages for s in self._slabs),
+                "device_pages_in_use": self._in_use["device"],
+                "leases": len(self._leases),
+            }
+
+
+_pool_lock = threading.Lock()
+_pool: Optional[PagePool] = None
+
+
+def get_pool() -> PagePool:
+    """The process-wide accumulator pool (configured from ``[tenancy]`` by
+    the runner; defaults are fine for tests and single-tenant use)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = PagePool()
+        return _pool
+
+
+def configure_pool(
+    page_kib: int, slab_pages: int, host_pages: int, device_pages: int
+) -> PagePool:
+    """Install the configured process pool (runner startup). Replaces the
+    default instance; existing leases on the old pool keep their slabs
+    alive through their own references."""
+    global _pool
+    pool = PagePool(
+        page_bytes=page_kib * 1024,
+        slab_pages=slab_pages,
+        host_pages=host_pages,
+        device_pages=device_pages,
+    )
+    with _pool_lock:
+        _pool = pool
+    return pool
