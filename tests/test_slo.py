@@ -1,0 +1,310 @@
+"""Per-tenant SLO engine + operator console (ISSUE 16, docs/DESIGN.md §20).
+
+Covers the burn-rate math over cumulative samples, the warn/page
+transition machinery (both-windows gate, transition counter, bounded
+ring, flight dump on page), the scrubbed ``/alerts`` payload, the
+``[slo]`` settings section (parsing, validation, env override), and the
+live REST surface: ``GET /statusz`` renders the console HTML and
+``GET /alerts`` serves the engine's JSON — with the console import
+provably jax-free (the zero-jax claim of the REST layer).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from xaynet_tpu.server.settings import SettingsError, SloSettings  # noqa: E402
+from xaynet_tpu.telemetry import slo as slo_mod  # noqa: E402
+from xaynet_tpu.telemetry.registry import get_registry  # noqa: E402
+from xaynet_tpu.telemetry.slo import SloConfig, SloEngine  # noqa: E402
+
+
+def _engine(**overrides) -> SloEngine:
+    cfg = dict(
+        enabled=True,
+        round_wall_s=1.0,
+        round_wall_budget=0.05,
+        degraded_budget=0.1,
+        shed_budget=0.05,
+        fast_window_s=3600.0,
+        slow_window_s=3600.0,
+        warn_burn=6.0,
+        page_burn=14.4,
+    )
+    cfg.update(overrides)
+    return SloEngine(SloConfig(**cfg))
+
+
+def _gauge(name: str, tenant: str, slo: str):
+    return get_registry().sample_value(name, {"tenant": tenant, "slo": slo})
+
+
+# --- burn math ---------------------------------------------------------------
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    eng = _engine()
+    t = "slo-burn"
+    for rid, wall in enumerate((2.0, 0.5, 2.0, 0.5)):  # 2 bad of 4
+        eng.on_round(t, rid, wall, degraded=False)
+    # (2/4) / 0.05 = 10.0
+    assert _gauge("xaynet_slo_burn_rate", t, "round_wall") == pytest.approx(10.0)
+    assert _gauge("xaynet_slo_budget_remaining", t, "round_wall") == pytest.approx(
+        1.0 - 10.0, abs=1e-6
+    )
+    # all rounds healthy on the degraded SLO
+    assert _gauge("xaynet_slo_burn_rate", t, "degraded") == 0.0
+    assert _gauge("xaynet_slo_budget_remaining", t, "degraded") == 1.0
+
+
+def test_per_tenant_targets_move_gauges_independently():
+    eng = _engine(tenant_round_wall_s={"strict": 0.1})
+    # the same 0.5s wall is healthy for the default target, bad for 'strict'
+    eng.on_round("slo-lax", 1, 0.5, degraded=False)
+    eng.on_round("strict", 1, 0.5, degraded=False)
+    assert _gauge("xaynet_slo_burn_rate", "slo-lax", "round_wall") == 0.0
+    assert _gauge("xaynet_slo_burn_rate", "strict", "round_wall") == pytest.approx(20.0)
+
+
+def test_degraded_slo_counts_degraded_rounds():
+    eng = _engine()
+    t = "slo-degr"
+    eng.on_round(t, 1, 0.1, degraded=True)
+    eng.on_round(t, 2, 0.1, degraded=False)
+    # (1/2) / 0.1 = 5.0
+    assert _gauge("xaynet_slo_burn_rate", t, "degraded") == pytest.approx(5.0)
+
+
+def test_disabled_engine_records_nothing():
+    eng = _engine(enabled=False)
+    eng.on_round("slo-off", 1, 99.0, degraded=True)
+    assert _gauge("xaynet_slo_burn_rate", "slo-off", "round_wall") is None
+    assert eng.active_alerts() == []
+
+
+# --- alert transitions -------------------------------------------------------
+
+
+def test_warn_then_page_transitions_counter_and_ring(monkeypatch):
+    dumps = []
+    monkeypatch.setattr(
+        slo_mod, "time", slo_mod.time
+    )  # keep module ref (clarity only)
+    import xaynet_tpu.telemetry.recorder as recorder_mod
+
+    monkeypatch.setattr(
+        recorder_mod, "flight_dump", lambda *a, **kw: dumps.append((a, kw)) or "/x"
+    )
+    t = "slo-trip"
+    before = slo_mod.SLO_ALERTS.labels(slo="round_wall", severity="page").value
+    eng = _engine()
+    for rid in range(3):  # every round slow: burn (1.0)/0.05 = 20 >= 14.4
+        eng.on_round(t, rid, 5.0, degraded=False)
+    active = eng.active_alerts()
+    assert {"tenant": t, "slo": "round_wall", "severity": "page"} in active
+    after = slo_mod.SLO_ALERTS.labels(slo="round_wall", severity="page").value
+    assert after == before + 1  # ONE transition, not one per round
+    # the page dropped a forensic bundle through the flight recorder
+    assert len(dumps) == 1
+    args, kwargs = dumps[0]
+    assert args[0] == "slo-page"
+    assert kwargs["tenant"] == t and kwargs["slo"] == "round_wall"
+    ring = [e for e in eng.recent_alerts() if e["tenant"] == t]
+    assert ring[-1]["severity"] == "page" and ring[-1]["previous"] == "ok"
+    # recovery: enough fast rounds drain the bad fraction below warn
+    for rid in range(3, 60):
+        eng.on_round(t, rid, 0.1, degraded=False)
+    assert eng.active_alerts() == []
+    ring = [e for e in eng.recent_alerts() if e["tenant"] == t]
+    # the burn drains gradually, so recovery steps page -> warn -> ok
+    assert [e["severity"] for e in ring] == ["page", "warn", "ok"]
+    # clearing is NOT a new alert transition
+    assert slo_mod.SLO_ALERTS.labels(slo="round_wall", severity="page").value == after
+
+
+def test_both_windows_must_burn(monkeypatch):
+    """A fast spike with a clean slow window must not alert: the effective
+    burn is min(fast, slow)."""
+    eng = _engine(fast_window_s=10.0, slow_window_s=3600.0)
+    t = "slo-spike"
+    now = [1000.0]
+    monkeypatch.setattr(slo_mod.time, "monotonic", lambda: now[0])
+    # a long healthy history ages into the slow window only
+    for rid in range(50):
+        now[0] += 30.0
+        eng.on_round(t, rid, 0.1, degraded=False)
+    # then a slow-round spike, alone inside the fast window
+    now[0] += 25.0
+    eng.on_round(t, 50, 5.0, degraded=False)
+    fast = _gauge("xaynet_slo_burn_rate", t, "round_wall")
+    assert fast == pytest.approx(20.0)  # 1/1 bad in the fast window
+    assert eng.active_alerts() == []  # slow window kept it from firing
+
+
+def test_alerts_payload_shape_and_scrub():
+    eng = _engine(tenant_round_wall_s={"edge": 2.0})
+    # a dynamically-secret key sneaking into the ring must not survive
+    # export (defense-in-depth §18; scrub_attrs also runs at append time)
+    eng._ring.append({"tenant": "x", "api_token": "hunter2-very-secret"})
+    payload = eng.alerts_payload()
+    assert set(payload) == {"enabled", "targets", "active", "recent"}
+    assert payload["targets"]["tenants"] == {"edge": 2.0}
+    blob = json.dumps(payload)
+    assert "hunter2-very-secret" not in blob
+    assert "<redacted" in blob
+
+
+# --- [slo] settings section --------------------------------------------------
+
+
+def test_slo_settings_tenant_targets_parse():
+    s = SloSettings(tenant_round_wall_s="alpha=3.0, beta=9")
+    assert s.tenant_targets() == {"alpha": 3.0, "beta": 9.0}
+    SloSettings().validate()  # defaults are valid
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"round_wall_s": 0.0},
+        {"tenant_round_wall_s": "alpha=x"},
+        {"tenant_round_wall_s": "=3.0"},
+        {"round_wall_budget": 0.0},
+        {"degraded_budget": 1.5},
+        {"fast_window_s": 600.0, "slow_window_s": 300.0},
+        {"warn_burn": 10.0, "page_burn": 5.0},
+    ],
+)
+def test_slo_settings_validation_rejects(kwargs):
+    with pytest.raises(SettingsError):
+        SloSettings(**kwargs).validate()
+
+
+def test_slo_settings_env_override(monkeypatch):
+    from xaynet_tpu.server.settings import Settings
+
+    monkeypatch.setenv("XAYNET__SLO__ROUND_WALL_S", "12.5")
+    monkeypatch.setenv("XAYNET__SLO__TENANT_ROUND_WALL_S", "a=3.0,b=9")
+    s = Settings.load(None)
+    assert s.slo.round_wall_s == 12.5
+    assert s.slo.tenant_targets() == {"a": 3.0, "b": 9.0}
+
+
+def test_configure_from_settings_section():
+    eng_before = slo_mod.get_engine().config
+    try:
+        slo_mod.configure(SloSettings(round_wall_s=42.0, tenant_round_wall_s="t=7"))
+        cfg = slo_mod.get_engine().config
+        assert cfg.round_wall_s == 42.0
+        assert cfg.target_for("t") == 7.0
+        assert cfg.target_for("other") == 42.0
+    finally:
+        slo_mod.get_engine().configure(eng_before)
+
+
+# --- REST surface ------------------------------------------------------------
+
+
+def test_console_module_needs_no_jax():
+    """The /statusz path renders from registry/timeline/SLO state only —
+    importing it must not drag jax into the process."""
+    code = (
+        "import sys; import xaynet_tpu.server.console, xaynet_tpu.server.rest; "
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(REPO), capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+async def _http_get(host: str, port: int, path: str):
+    # raw-socket GET (test_telemetry_endpoint idiom, inlined so this file
+    # needs no crypto-gated imports)
+    import asyncio
+
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except Exception:
+        pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    head_lines = head.decode().split("\r\n")
+    status = int(head_lines[0].split()[1])
+    headers = {}
+    for line in head_lines[1:]:
+        name, _, value = line.partition(":")
+        headers[name.strip().lower()] = value.strip()
+    return status, headers, body
+
+
+def test_statusz_and_alerts_endpoints():
+    import asyncio
+
+    from xaynet_tpu.server.rest import RestServer
+    from xaynet_tpu.server.services import Fetcher, PetMessageHandler
+    from xaynet_tpu.server.settings import Settings
+    from xaynet_tpu.server.state_machine import StateMachineInitializer
+    from xaynet_tpu.storage.memory import (
+        InMemoryCoordinatorStorage,
+        InMemoryModelStorage,
+        NoOpTrustAnchor,
+    )
+    from xaynet_tpu.storage.traits import Store
+    from xaynet_tpu.telemetry import BridgedMetrics
+
+    async def _run() -> None:
+        settings = Settings.load(None)
+        settings.model.length = 7
+        store = Store(
+            InMemoryCoordinatorStorage(), InMemoryModelStorage(), NoOpTrustAnchor()
+        )
+        metrics = BridgedMetrics()
+        machine, request_tx, events = await StateMachineInitializer(
+            settings, store, metrics
+        ).init()
+        rest = RestServer(
+            Fetcher(events), PetMessageHandler(events, request_tx),
+            registry=metrics.registry,
+        )
+        host, port = await rest.start("127.0.0.1", 0)
+        machine_task = asyncio.create_task(machine.run())
+        try:
+            status, headers, body = await _http_get(host, port, "/statusz")
+            assert status == 200
+            assert headers["content-type"].startswith("text/html")
+            page = body.decode()
+            assert page.startswith("<!doctype html>")
+            assert "xaynet-tpu coordinator" in page
+            assert "default" in page  # the bare-route tenant row
+
+            status, headers, body = await _http_get(host, port, "/alerts")
+            assert status == 200
+            assert headers["content-type"].startswith("application/json")
+            payload = json.loads(body)
+            assert set(payload) == {"enabled", "targets", "active", "recent"}
+        finally:
+            machine_task.cancel()
+            await rest.stop()
+            try:
+                await machine_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            metrics.close()
+
+    asyncio.run(asyncio.wait_for(_run(), timeout=60))

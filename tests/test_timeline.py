@@ -1,0 +1,242 @@
+"""Round-wall timeline fold (ISSUE 16, docs/DESIGN.md §20).
+
+Covers the streaming fold's contracts: the Idle-close→Unmask-complete
+bracket, the exact decomposition identity ``sum(phase walls) - overlap +
+gap == wall``, the degraded flag, the top-k heap's exclusions, the
+per-tenant accumulation across interleaved multi-tenant flush windows
+(a tenant's round may span several shared-tracer windows), the
+``xaynet_round_wall_seconds`` histogram, and the flight recorder's
+histogram ``_sum``/``_count`` delta regression (round-wall latency
+evidence must survive into forensic bundles).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from xaynet_tpu.telemetry import recorder as recorder_mod  # noqa: E402
+from xaynet_tpu.telemetry import timeline as timeline_mod  # noqa: E402
+from xaynet_tpu.telemetry.registry import get_registry  # noqa: E402
+from xaynet_tpu.telemetry.timeline import (  # noqa: E402
+    ROUND_WALL,
+    RoundTimeline,
+    fold_spans,
+)
+from xaynet_tpu.telemetry.tracing import Span  # noqa: E402
+
+
+def _span(name, start, duration, **attrs):
+    s = Span(name, "t", f"s{name}-{start}", None, start, attrs)
+    s.duration = duration
+    return s
+
+
+def _round_spans(tenant="default", round_id=7, base=100.0, outcome="full"):
+    """idle + the four work phases back to back, with a root span."""
+    spans = [_span("phase.idle", base, 1.0, tenant=tenant, round_id=round_id)]
+    t = base + 1.0
+    for phase, dur in (("sum", 2.0), ("update", 3.0), ("sum2", 1.5), ("unmask", 0.5)):
+        spans.append(
+            _span(
+                f"phase.{phase}", t, dur,
+                tenant=tenant, round_id=round_id, outcome=outcome,
+            )
+        )
+        t += dur
+    root = _span("round", base, t - base, round_id=round_id)
+    spans.append(root)
+    return spans
+
+
+def _wall_count(tenant: str) -> float:
+    return float(ROUND_WALL.labels(tenant=tenant).count)
+
+
+# --- fold_spans --------------------------------------------------------------
+
+
+def test_fold_bracket_is_idle_close_to_unmask_complete():
+    decomp = fold_spans(7, _round_spans())
+    # idle ends at 101.0, unmask ends at 101 + 2 + 3 + 1.5 + 0.5 = 108.0
+    assert decomp["wall_s"] == pytest.approx(7.0, abs=1e-6)
+    assert decomp["round_id"] == 7
+    assert decomp["tenant"] == "default"
+    assert set(decomp["phases"]) == {"sum", "update", "sum2", "unmask"}
+    assert decomp["degraded"] is False
+
+
+def test_fold_identity_exact_with_overlap_and_gap():
+    # sum [1,3], update [2,5] (1s overlap), sum2 [6,7] (1s gap), unmask [7,8]
+    spans = [
+        _span("phase.idle", 0.0, 1.0, tenant="default"),
+        _span("phase.sum", 1.0, 2.0, tenant="default", outcome="full"),
+        _span("phase.update", 2.0, 3.0, tenant="default", outcome="full"),
+        _span("phase.sum2", 6.0, 1.0, tenant="default", outcome="full"),
+        _span("phase.unmask", 7.0, 1.0, tenant="default"),
+        _span("round", 0.0, 8.0, round_id=3),
+    ]
+    decomp = fold_spans(3, spans)
+    assert decomp["wall_s"] == pytest.approx(7.0, abs=1e-6)
+    assert decomp["overlap_s"] == pytest.approx(1.0, abs=1e-6)
+    assert decomp["gap_s"] == pytest.approx(1.0, abs=1e-6)
+    total = sum(p["wall_s"] for p in decomp["phases"].values())
+    # the §20 identity: phase walls minus overlap plus gap IS the wall
+    assert total - decomp["overlap_s"] + decomp["gap_s"] == pytest.approx(
+        decomp["wall_s"], abs=5e-6
+    )
+    # self time: sum has 1s of its 2s overlapped by update
+    assert decomp["phases"]["sum"]["self_s"] == pytest.approx(1.0, abs=1e-6)
+    assert decomp["phases"]["update"]["self_s"] == pytest.approx(2.0, abs=1e-6)
+
+
+def test_fold_degraded_flag_from_span_outcome():
+    assert fold_spans(1, _round_spans(outcome="degraded"))["degraded"] is True
+    assert fold_spans(1, _round_spans(outcome="timeout"))["degraded"] is True
+    assert fold_spans(1, _round_spans(outcome="full"))["degraded"] is False
+
+
+def test_fold_topk_excludes_idle_and_root_and_ranks():
+    spans = _round_spans()
+    # a slow streaming child must outrank the phases in the top-k
+    spans.insert(3, _span("stream.fold", 103.0, 6.0, batch=1))
+    decomp = fold_spans(7, spans)
+    names = [entry["span"] for entry in decomp["slowest"]]
+    assert names[0] == "stream.fold"
+    assert "phase.idle" not in names
+    assert "round" not in names
+    assert len(names) <= 5
+    durations = [entry["seconds"] for entry in decomp["slowest"]]
+    assert durations == sorted(durations, reverse=True)
+
+
+def test_fold_falls_back_to_root_when_no_phases():
+    root = _span("round", 10.0, 4.0, round_id=9)
+    decomp = fold_spans(9, [root])
+    assert decomp["wall_s"] == pytest.approx(4.0, abs=1e-6)
+    assert decomp["phases"] == {}
+
+
+def test_fold_no_usable_spans_returns_none():
+    assert fold_spans(1, []) is None
+    assert fold_spans(1, [_span("stream.fold", 0.0, 1.0)]) is None
+
+
+# --- RoundTimeline: per-tenant accumulation ---------------------------------
+
+
+def test_timeline_folds_on_unmask_and_observes_histogram():
+    tl = RoundTimeline()
+    before = _wall_count("tl-t1")
+    tl.on_round(7, _round_spans(tenant="tl-t1"))
+    assert _wall_count("tl-t1") == before + 1
+    last = tl.last("tl-t1")
+    assert last is not None and last["round_id"] == 7
+    assert last["wall_s"] == pytest.approx(7.0, abs=1e-6)
+    assert tl.recent_walls("tl-t1") == [(7, last["wall_s"])]
+    assert tl.rounds_folded() == 1
+    assert tl.tenants() == ["tl-t1"]
+
+
+def test_timeline_multi_tenant_interleaved_windows():
+    """A shared flush window carries both tenants' spans; tenant B's round
+    completes only in the NEXT window — its wall must still bracket the
+    idle from the first window."""
+    tl = RoundTimeline()
+    a = _round_spans(tenant="tl-a", round_id=4, base=0.0)
+    # B: idle + sum land in window 1, the rest in window 2
+    b_early = [
+        _span("phase.idle", 0.0, 2.0, tenant="tl-b", round_id=9),
+        _span("phase.sum", 2.0, 1.0, tenant="tl-b", round_id=9, outcome="full"),
+    ]
+    b_late = [
+        _span("phase.update", 3.0, 1.0, tenant="tl-b", round_id=9, outcome="full"),
+        _span("phase.sum2", 4.0, 1.0, tenant="tl-b", round_id=9, outcome="full"),
+        _span("phase.unmask", 5.0, 1.0, tenant="tl-b", round_id=9),
+    ]
+    tl.on_round(4, a + b_early)
+    assert tl.last("tl-a") is not None  # A folded from window 1
+    assert tl.last("tl-b") is None  # B still pending
+    tl.on_round(5, b_late)
+    last_b = tl.last("tl-b")
+    assert last_b is not None
+    assert last_b["round_id"] == 9  # rid from the unmask span, not the window
+    assert last_b["wall_s"] == pytest.approx(4.0, abs=1e-6)  # idle end 2 -> 6
+
+
+def test_timeline_spans_after_unmask_seed_next_window():
+    tl = RoundTimeline()
+    spans = _round_spans(tenant="tl-seed", round_id=1, base=0.0)
+    # the next round's idle flushes in the same window
+    spans.append(_span("phase.idle", 9.0, 1.0, tenant="tl-seed", round_id=2))
+    tl.on_round(1, spans)
+    assert tl.last("tl-seed")["round_id"] == 1
+    pending = tl._pending.get("tl-seed", [])
+    assert [s.name for s in pending] == ["phase.idle"]
+
+
+def test_timeline_untagged_spans_join_single_tenant_window():
+    tl = RoundTimeline()
+    spans = _round_spans(tenant="tl-solo")
+    spans.insert(2, _span("stream.fold", 102.0, 5.0, batch=0))  # no tenant attr
+    tl.on_round(7, spans)
+    names = [e["span"] for e in tl.last("tl-solo")["slowest"]]
+    assert names[0] == "stream.fold"
+
+
+def test_timeline_pending_cap_bounds_memory():
+    tl = RoundTimeline()
+    spans = [
+        _span("phase.sum", float(i), 0.5, tenant="tl-cap", outcome="full")
+        for i in range(timeline_mod._PENDING_CAP + 100)
+    ]
+    tl.on_round(1, spans)  # no unmask: everything pends, trimmed to the cap
+    assert len(tl._pending["tl-cap"]) == timeline_mod._PENDING_CAP
+
+
+def test_fold_for_report_falls_back_to_last_fold():
+    tl = RoundTimeline()
+    tl.on_round(7, _round_spans(tenant="tl-report"))
+    decomp = tl.fold_for_report("tl-report", 7)
+    assert decomp is not None and decomp["round_id"] == 7
+    assert tl.fold_for_report("tl-report", 99) is None
+
+
+def test_module_singleton_is_registered_flush_hook():
+    from xaynet_tpu.telemetry.timeline import get_timeline
+    from xaynet_tpu.telemetry.tracing import get_tracer
+
+    assert get_timeline().on_round in get_tracer()._flush_hooks
+
+
+# --- flight recorder: histogram deltas (satellite regression) ---------------
+
+H_DELTA = get_registry().histogram(
+    "test_timeline_delta_seconds",
+    "test-only histogram for the flight-dump delta regression",
+    ("tenant",),
+)
+
+
+def test_flight_dump_carries_histogram_sum_count_deltas(tmp_path):
+    rec = recorder_mod.FlightRecorder(directory=str(tmp_path))
+    H_DELTA.labels(tenant="fd").observe(1.0)
+    rec.on_round(1)  # baseline AFTER the first observation
+    H_DELTA.labels(tenant="fd").observe(2.5)
+    path = rec.dump("test-histo-delta", "delta regression")
+    assert path is not None
+    bundle = json.loads(Path(path).read_text())
+    deltas = bundle["metrics_delta"]
+    sum_key = 'test_timeline_delta_seconds_sum{fd}'
+    count_key = 'test_timeline_delta_seconds_count{fd}'
+    assert deltas[sum_key] == {"before": 1.0, "now": 3.5}
+    assert deltas[count_key] == {"before": 1.0, "now": 2.0}
+    # per-bucket vectors stay OUT of the bundle (size discipline)
+    assert not any("_bucket" in key for key in deltas)

@@ -104,6 +104,8 @@ SINK_TOKENS = (
     "flight-dump",
     "serialized-dump",
     "exception-message",
+    "statusz-page",
+    "alerts-payload",
 )
 
 _SRC_DESC = {
@@ -124,6 +126,8 @@ _SINK_DESC = {
     "flight-dump": "a flight-recorder dump payload",
     "serialized-dump": "a serialized JSON dump (report/checkpoint/state blob)",
     "exception-message": "an exception message",
+    "statusz-page": "the /statusz operator console page",
+    "alerts-payload": "the /alerts SLO payload",
 }
 
 _LOG_METHODS = frozenset(
@@ -618,6 +622,24 @@ class TaintPass:
             for kw in node.keywords:
                 taint |= self.eval(kw.value)
             self._sink_value(taint, "flight-dump", node.lineno)
+            return True
+        if name == "render_statusz":
+            # the operator console (ISSUE 16): everything flowing into the
+            # page builder lands in browser-served HTML
+            taint = set()
+            for a in node.args:
+                taint |= self.eval(a)
+            for kw in node.keywords:
+                taint |= self.eval(kw.value)
+            self._sink_value(taint, "statusz-page", node.lineno)
+            return True
+        if name == "alerts_payload" and recv is not None:
+            # the /alerts JSON body: the engine receiver's state IS the
+            # export surface (the builder takes no data args)
+            taint = self.eval(recv)
+            for a in node.args:
+                taint |= self.eval(a)
+            self._sink_value(taint, "alerts-payload", node.lineno)
             return True
         if name in ("dump", "dumps") and isinstance(recv, ast.Name):
             dotted = self._fi.file.imports.get(recv.id, recv.id)

@@ -62,7 +62,13 @@ ELEMENTS_UNIT = "elements/s"
 # bytes than the best (smallest) prior round tolerates.
 BYTES_PREFIX = "bytes moved per fold"
 BYTES_UNIT = "bytes/fold"
-LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT})
+# round-wall family (tools/bench_round.py, DESIGN §20): the end-to-end
+# round wall the SLO engine budgets in production. LOWER is better, like
+# the bytes family — the gate fails when the latest round takes LONGER
+# than the best (fastest) prior round tolerates.
+ROUND_WALL_PREFIX = "round wall"
+ROUND_WALL_UNIT = "s/round"
+LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT, ROUND_WALL_UNIT})
 # multi-tenant interleaved fold (bench.py:multi_tenant, DESIGN §19): two
 # tenants' concurrent folds through the paged pool + tenant scheduler,
 # in 25M-equivalent updates/s (tenant B's updates scaled by its length
@@ -76,6 +82,7 @@ DEFAULT_FAMILIES = (
     (UNMASK_PREFIX, ELEMENTS_UNIT),
     (BYTES_PREFIX, BYTES_UNIT),
     (TENANT_PREFIX, HEADLINE_UNIT),
+    (ROUND_WALL_PREFIX, ROUND_WALL_UNIT),
 )
 
 

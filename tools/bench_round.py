@@ -386,6 +386,18 @@ def main() -> None:
             "wall_s": round(t_unmask, 3),
             **common,
         },
+        # the operator headline (docs/DESIGN.md §20): end-to-end round wall
+        # — update phase + sum2 + unmask, the same bracket the always-on
+        # timeline fold reports in production. LOWER is better: the gate
+        # inverts its floor for the s/round unit (the §17 bytes idiom)
+        {
+            "metric": f"round wall @{model_len} params",
+            "value": round(total, 3),
+            "unit": "s/round",
+            "kernel": agg_kernel_used,
+            "updates": n_batches * k_batch,
+            **common,
+        },
     ]
     result = {
         "metric": "e2e update-phase throughput",

@@ -34,6 +34,45 @@ def _rss_kb(pid: int) -> int:
     return 0
 
 
+def _scrape_console(port: int, require_tenants: list[str] | None = None) -> dict:
+    """GET /statusz + /alerts off the live coordinator (DESIGN §20 smoke).
+
+    Runs while the coordinator is still up — asserts the operator console
+    renders (200, HTML, every tenant id present) and the SLO alert payload
+    parses, and folds both into the soak's result JSON so CI carries the
+    evidence."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://127.0.0.1:{port}/statusz", timeout=10) as resp:
+        page = resp.read().decode("utf-8", "replace")
+        if resp.status != 200 or "<html" not in page:
+            raise RuntimeError(f"/statusz not healthy: {resp.status}")
+    missing = [tid for tid in (require_tenants or []) if tid not in page]
+    if missing:
+        raise RuntimeError(f"/statusz missing tenants: {missing}")
+    with urlopen(f"http://127.0.0.1:{port}/alerts", timeout=10) as resp:
+        if resp.status != 200:
+            raise RuntimeError(f"/alerts not healthy: {resp.status}")
+        alerts = json.loads(resp.read())
+    # per-tenant SLO burn gauges off /metrics: the soak's evidence that the
+    # engine tracks tenants independently, not one merged series
+    with urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    burn_tenants = sorted(
+        {
+            line.split('tenant="', 1)[1].split('"', 1)[0]
+            for line in text.splitlines()
+            if line.startswith("xaynet_slo_burn_rate{")
+        }
+    )
+    return {
+        "statusz_bytes": len(page),
+        "alerts_active": alerts.get("active", []),
+        "alerts_recent": len(alerts.get("recent", [])),
+        "slo_burn_tenants": burn_tenants,
+    }
+
+
 CONFIG = """
 [api]
 bind_address = "127.0.0.1:{port}"
@@ -309,6 +348,7 @@ def run_multi_tenant_soak(args) -> None:
                 th.join()
             if errors:
                 raise errors[0]
+            console = _scrape_console(args.port, require_tenants=tenants)
             rss = _rss_kb(proc.pid)
         finally:
             proc.terminate()
@@ -329,6 +369,7 @@ def run_multi_tenant_soak(args) -> None:
                 "byte_identical": True,
                 "wall_s": round(time.perf_counter() - t0, 2),
                 "rss_kb": rss,
+                "console": console,
             }
         )
     )
@@ -925,6 +966,7 @@ def main() -> None:
                     "stragglers": stragglers if chaos else None,
                     "flight_dir": flight_dir,
                     "flight_dumps": _flight_dumps(),
+                    "console": _scrape_console(args.port),
                 }
             )
             print(json.dumps(result))

@@ -9,10 +9,16 @@ the DESIGN §16 policy is: the default stays ``[metrics] trace = "on"``
 while the overhead is <2%, else the default flips to failure-only
 sampling.
 
+A second leg bounds the ALWAYS-ON timeline fold (DESIGN §20): the per-round
+``fold_spans`` pass over a realistic synthetic buffer, reported in µs and
+as a share of the measured window wall — the §20 policy keeps the fold
+always-on while that share is ≤0.1%.
+
 Usage:
   JAX_PLATFORMS=cpu python tools/trace_overhead.py [--model-len N]
                     [--k K] [--batches B] [--reps R]
-Prints one JSON line: {updates_per_s_on, updates_per_s_off, overhead_pct}.
+Prints one JSON line: {updates_per_s_on, updates_per_s_off, overhead_pct,
+timeline_fold_us, timeline_fold_pct_of_window, ...}.
 """
 
 from __future__ import annotations
@@ -135,6 +141,50 @@ def main() -> None:
         with tracer.span(probe, batch=1):
             pass
     span_cost_us = (time.perf_counter() - t0) / n_probe * 1e6
+
+    # the always-on timeline fold (DESIGN §20): one O(n) pass per round
+    # over the span buffer. Time it on a synthetic buffer shaped like a
+    # real round (phase spans + streaming children, half the 8192 cap) and
+    # bound it against the measured ON window wall — a real round wall is
+    # LONGER than one window, so the reported share is conservative. The
+    # §20 policy: the fold stays always-on while this is <=0.1%.
+    from xaynet_tpu.telemetry.timeline import fold_spans
+    from xaynet_tpu.telemetry.tracing import Span
+
+    def _synthetic_round(n_children: int) -> list:
+        spans = []
+        t = 1000.0
+        idle = Span("phase.idle", "t", "s0", None, t, {"tenant": "default"})
+        idle.duration = 0.05
+        spans.append(idle)
+        t += idle.duration
+        for j, phase in enumerate(("sum", "update", "sum2", "unmask")):
+            p = Span(f"phase.{phase}", "t", f"p{j}", None, t, {
+                "tenant": "default", "round_id": 7, "outcome": "full",
+            })
+            p.duration = 2.0
+            spans.append(p)
+            per = max(1, n_children // 4)
+            for c in range(per):
+                ch = Span("stream.fold", "t", f"c{j}-{c}", f"p{j}",
+                          t + c * (p.duration / per), {"batch": c})
+                ch.duration = p.duration / per
+                spans.append(ch)
+            t += p.duration
+        root = Span("round", "t", "r", None, spans[0].start, {"round_id": 7})
+        root.duration = t - spans[0].start
+        spans.append(root)
+        return spans
+
+    buffer = _synthetic_round(4096)
+    n_folds = 50
+    t0 = time.perf_counter()
+    for _ in range(n_folds):
+        decomp = fold_spans(7, buffer)
+    fold_cost_us = (time.perf_counter() - t0) / n_folds * 1e6
+    assert decomp is not None and decomp["spans"] == len(buffer)
+    window_wall_s = args.k * args.batches / on
+    fold_pct_of_window = fold_cost_us / 1e6 / window_wall_s * 100.0
     print(
         json.dumps(
             {
@@ -143,6 +193,9 @@ def main() -> None:
                 "overhead_pct": round(overhead, 2),
                 "pair_ratios": [round(r, 4) for r in ratios],
                 "span_cost_us": round(span_cost_us, 2),
+                "timeline_fold_us": round(fold_cost_us, 2),
+                "timeline_fold_spans": len(buffer),
+                "timeline_fold_pct_of_window": round(fold_pct_of_window, 4),
                 "model_len": args.model_len,
                 "k": args.k,
                 "batches": args.batches,

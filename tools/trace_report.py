@@ -20,18 +20,26 @@ asks of it:
   round report JSONL (``[metrics] round_report_path``): the two artifacts
   measure the same bracket, so a drift beyond tolerance means one of them
   is lying.
+- ``--slo <config>`` — the offline §20 check: recompute the round wall
+  (Idle-close -> Unmask-complete) from the trace events, require it to
+  agree with the report's in-process ``round_wall`` fold to within the
+  span clock's resolution, and flag a breach of the ``[slo]`` target.
 
 Usage:
   python tools/trace_report.py round_3.trace.json
   python tools/trace_report.py --validate round_3.trace.json
   python tools/trace_report.py --round-report reports.jsonl round_3.trace.json
+  python tools/trace_report.py --slo config.toml --round-report r.jsonl round_3.trace.json
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # child may start marginally before its parent's first sample or end after
 # (thread scheduling between the monotonic reads); anything past this is a
@@ -46,6 +54,12 @@ _REQUIRED_PHASES = ("phase.sum", "phase.update", "phase.sum2", "phase.unmask")
 # bracket the same process+purge region, so they agree to scheduling noise
 _PHASE_WALL_REL_TOL = 0.25
 _PHASE_WALL_ABS_TOL_S = 0.25
+
+# --slo wall agreement: the in-process fold and the Chrome export read the
+# SAME monotonic samples, so the only drift is quantization — the export's
+# 0.1 us grid and the decomposition's 1 us rounding. Two ticks of the
+# coarser (1 us) clock covers both edges' rounding compounding.
+_SLO_WALL_TOL_S = 2e-6
 
 
 def load_events(path: str) -> list[dict]:
@@ -154,6 +168,79 @@ def cross_check(events: list[dict], report: dict) -> list[str]:
     return problems
 
 
+def trace_round_wall(events: list[dict]) -> float | None:
+    """The round wall recomputed from trace events alone: Idle-close ->
+    Unmask-complete, the exact bracket the in-process timeline fold uses
+    (docs/DESIGN.md §20); ``None`` when the trace never reached unmask."""
+    unmask_end = max(
+        (e["ts"] + e["dur"] for e in events if e.get("name") == "phase.unmask"),
+        default=None,
+    )
+    if unmask_end is None:
+        return None
+    idle_end = max(
+        (e["ts"] + e["dur"] for e in events if e.get("name") == "phase.idle"),
+        default=None,
+    )
+    if idle_end is None:
+        # same fallback as the fold: a buffer that lost idle brackets from
+        # the earliest work-phase start
+        idle_end = min(
+            (
+                e["ts"]
+                for e in events
+                if str(e.get("name", "")).startswith("phase.")
+                and e.get("name") != "phase.unmask"
+            ),
+            default=unmask_end,
+        )
+    return max(0.0, (unmask_end - idle_end) / 1e6)
+
+
+def slo_check(
+    events: list[dict], report: dict | None, config_path: str
+) -> list[str]:
+    """Offline SLO cross-check (§20): the trace-recomputed round wall must
+    match the report's in-process ``round_wall`` fold to within the span
+    clock's quantization, and a wall over the ``[slo]`` target is flagged
+    as a breach."""
+    from xaynet_tpu.server.settings import Settings
+
+    problems: list[str] = []
+    settings = Settings.load(config_path)
+    wall = trace_round_wall(events)
+    if wall is None:
+        return ["slo: trace has no phase.unmask span — no round wall to check"]
+    tenant = (report or {}).get("tenant") or "default"
+    section = (report or {}).get("round_wall")
+    if section is not None:
+        folded = float(section.get("wall_s", -1.0))
+        if abs(wall - folded) > _SLO_WALL_TOL_S:
+            problems.append(
+                f"slo: trace round wall {wall:.6f}s disagrees with the "
+                f"report's timeline fold {folded:.6f}s (beyond the span "
+                f"clock's {_SLO_WALL_TOL_S * 1e6:.0f} us tolerance)"
+            )
+    elif report is not None:
+        problems.append(
+            "slo: round report carries no round_wall section (timeline fold "
+            "missing or tracing off)"
+        )
+    target = settings.slo.tenant_targets().get(tenant, settings.slo.round_wall_s)
+    if settings.slo.enabled and wall > target:
+        problems.append(
+            f"slo: BREACH — round wall {wall:.3f}s exceeds tenant "
+            f"{tenant!r} target {target:.3f}s"
+        )
+    else:
+        print(
+            f"slo: round wall {wall:.6f}s within tenant {tenant!r} "
+            f"target {target:.3f}s",
+            file=sys.stderr,
+        )
+    return problems
+
+
 def _children(events: list[dict]) -> dict[str | None, list[dict]]:
     kids: dict[str | None, list[dict]] = {}
     for e in events:
@@ -252,11 +339,20 @@ def main(argv: list[str] | None = None) -> int:
         help="cross-check phase walls against this round-report JSONL "
         "(matched on round_id when present, else the last line)",
     )
+    ap.add_argument(
+        "--slo",
+        default=None,
+        metavar="CONFIG",
+        help="offline SLO check against this config's [slo] section: trace "
+        "round wall vs the report's timeline fold (needs --round-report "
+        "for the fold comparison) + target-breach flagging",
+    )
     ap.add_argument("--limit", type=int, default=200, help="timeline rows")
     args = ap.parse_args(argv)
 
     events = load_events(args.trace)
     problems: list[str] = []
+    report = None
     if args.validate:
         problems.extend(validate(events))
     if args.round_report:
@@ -265,7 +361,6 @@ def main(argv: list[str] | None = None) -> int:
             for e in events
             if e.get("name") == "round"
         }
-        report = None
         matched = False
         with open(args.round_report) as f:
             for line in f:
@@ -280,6 +375,8 @@ def main(argv: list[str] | None = None) -> int:
             problems.append("round report file has no reports")
         else:
             problems.extend(cross_check(events, report))
+    if args.slo:
+        problems.extend(slo_check(events, report, args.slo))
 
     if not args.validate:
         print(timeline(events, args.limit))

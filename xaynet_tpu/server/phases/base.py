@@ -252,7 +252,11 @@ class PhaseState:
             ctx=idle_ctx,
             round_id=self.shared.round_id,
             tenant=self.shared.tenant,
-        ):
+        ) as phase_span:
+            # the window outcome lands on the phase span too
+            # (_record_window_outcome), so the timeline fold can tell a
+            # degraded round from the span buffer alone
+            self._phase_span = phase_span
             try:
                 await self.process()
                 await self.purge_outdated_requests()
@@ -354,6 +358,9 @@ class PhaseState:
 
     def _record_window_outcome(self, counter: _Counter, outcome: str, t0: float) -> None:
         PHASE_OUTCOMES.labels(phase=self.NAME.value, outcome=outcome).inc()
+        phase_span = getattr(self, "_phase_span", None)
+        if phase_span is not None:
+            phase_span.set(outcome=outcome)
         if outcome in ("degraded", "timeout"):
             # forensic bundle: the span ring holds what led up to the
             # degraded close / below-quorum timeout (recent request, ingest

@@ -10,6 +10,8 @@ rust/xaynet-server/src/rest.rs:40-315):
 - ``GET /model``    — latest global model bytes (204 while absent)
 - ``GET /metrics``  — telemetry registry, Prometheus text exposition
 - ``GET /healthz``  — liveness JSON (status, phase, round id, uptime)
+- ``GET /statusz``  — live operator console, self-contained HTML (§20)
+- ``GET /alerts``   — SLO engine state: active alerts + transition ring
 
 Responses are JSON (parameters, dictionaries) or raw bytes (model) — a
 readable stand-in for the reference's bincode bodies; both ends of the wire
@@ -67,13 +69,14 @@ SPAN_REQUEST = trace.declare_span("rest.request")
 # POST /message and the /edge/* hops.
 _UNTRACED_PATHS = {
     "/metrics", "/health", "/healthz", "/params", "/sums", "/seeds", "/model",
+    "/statusz", "/alerts",
 }
 
 # known routes/methods keep the http counter's labels closed-cardinality —
 # both tokens are attacker-controlled, and every distinct label value is a
 # permanent registry child
 _KNOWN_PATHS = {"/message", "/params", "/sums", "/seeds", "/model",
-                "/health", "/healthz", "/metrics",
+                "/health", "/healthz", "/metrics", "/statusz", "/alerts",
                 "/edge/round", "/edge/envelope"}
 _KNOWN_METHODS = {"GET", "POST", "HEAD", "PUT", "DELETE", "OPTIONS", "PATCH"}
 
@@ -275,6 +278,24 @@ class RestServer:
                     200,
                     self.registry.render().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
+                )
+            if method == "GET" and path == "/statusz":
+                # live operator console (§20): rendered from registry /
+                # timeline / SLO state only — no jax import on this path
+                from .console import render_statusz
+
+                return (
+                    200,
+                    render_statusz(self).encode(),
+                    "text/html; charset=utf-8",
+                )
+            if method == "GET" and path == "/alerts":
+                from ..telemetry.slo import get_engine
+
+                return (
+                    200,
+                    json.dumps(get_engine().alerts_payload()).encode(),
+                    "application/json",
                 )
             if method == "GET" and path == "/healthz":
                 # liveness + the coarse round position, cheap enough to poll

@@ -162,6 +162,11 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         trace_dir=settings.metrics.trace_dir or None,
     )
     flight_recorder.get_recorder().configure(settings.metrics.flight_dir or None)
+    # per-tenant SLO targets + burn-rate alerting over the always-on
+    # round-wall timeline (docs/DESIGN.md §20)
+    from ..telemetry import slo as slo_engine
+
+    slo_engine.configure(settings.slo)
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()
 
@@ -284,6 +289,11 @@ async def serve_tenants(settings: Settings) -> None:
         trace_dir=settings.metrics.trace_dir or None,
     )
     flight_recorder.get_recorder().configure(settings.metrics.flight_dir or None)
+    # the SLO engine is process-wide (per-tenant state inside): configured
+    # once from the base settings' [slo] section, tenant targets included
+    from ..telemetry import slo as slo_engine
+
+    slo_engine.configure(settings.slo)
 
     registry = TenantRegistry()
     routes: dict[str, TenantRoutes] = {}

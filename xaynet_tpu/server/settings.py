@@ -494,6 +494,70 @@ class TenancySettings:
 
 
 @dataclass
+class SloSettings:
+    """``[slo]`` — per-tenant SLO targets and burn-rate alerting
+    (``telemetry.slo``, docs/DESIGN.md §20).
+
+    ``round_wall_s`` is the round-wall target every tenant inherits;
+    ``tenant_round_wall_s`` overrides it per tenant as a comma-separated
+    ``tenant=seconds`` string (strings keep the section env-overridable
+    and mini-TOML-parseable, like ``tenancy.tenants``). The three budgets
+    are the allowed BAD fractions (slow rounds / degraded rounds / shed
+    ingress); burn rate 1.0 means spending exactly that budget. An alert
+    needs BOTH the fast and the slow window burning — ``warn`` at
+    ``warn_burn``, ``page`` at ``page_burn`` (a page also drops a flight
+    bundle, trigger ``slo-page``).
+    """
+
+    enabled: bool = True
+    round_wall_s: float = 600.0  # default per-round wall target
+    tenant_round_wall_s: str = ""  # "tenant=seconds,..." overrides
+    round_wall_budget: float = 0.05  # allowed fraction of slow rounds
+    degraded_budget: float = 0.1  # allowed fraction of degraded rounds
+    shed_budget: float = 0.05  # allowed shed fraction of admissions
+    fast_window_s: float = 300.0  # prompt-detection window
+    slow_window_s: float = 3600.0  # spike-suppression window
+    warn_burn: float = 6.0  # burn rate tripping warn
+    page_burn: float = 14.4  # burn rate tripping page (+ flight dump)
+
+    def tenant_targets(self) -> dict:
+        """The parsed per-tenant overrides: ``{tenant: seconds}``."""
+        out: dict[str, float] = {}
+        for pair in self.tenant_round_wall_s.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            tenant, _, seconds = pair.partition("=")
+            out[tenant.strip()] = float(seconds)
+        return out
+
+    def validate(self) -> None:
+        if self.round_wall_s <= 0:
+            raise SettingsError("slo.round_wall_s must be > 0")
+        try:
+            targets = self.tenant_targets()
+        except ValueError as e:
+            raise SettingsError(
+                "slo.tenant_round_wall_s must be 'tenant=seconds,...'"
+            ) from e
+        for tenant, seconds in targets.items():
+            if not tenant or seconds <= 0:
+                raise SettingsError(
+                    "slo.tenant_round_wall_s entries need a tenant id and a "
+                    "positive target"
+                )
+        for name in ("round_wall_budget", "degraded_budget", "shed_budget"):
+            if not (0.0 < getattr(self, name) <= 1.0):
+                raise SettingsError(f"slo.{name} must be in (0, 1]")
+        if self.fast_window_s <= 0 or self.slow_window_s <= 0:
+            raise SettingsError("slo windows must be > 0")
+        if self.fast_window_s > self.slow_window_s:
+            raise SettingsError("slo.fast_window_s must be <= slow_window_s")
+        if self.warn_burn <= 0 or self.page_burn < self.warn_burn:
+            raise SettingsError("slo burn thresholds need 0 < warn_burn <= page_burn")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -509,11 +573,13 @@ class Settings:
     liveness: LivenessSettings = field(default_factory=LivenessSettings)
     edge: EdgeSettings = field(default_factory=EdgeSettings)
     tenancy: TenancySettings = field(default_factory=TenancySettings)
+    slo: SloSettings = field(default_factory=SloSettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
         self.tenancy.validate()
+        self.slo.validate()
         try:
             self.mask.to_config()  # quant level vs data/bound-type ceiling
         except ValueError as e:
@@ -631,6 +697,8 @@ class Settings:
         edge_base = base.edge
         ten_raw = raw.get("tenancy", {})
         ten_base = base.tenancy
+        slo_raw = raw.get("slo", {})
+        slo_base = base.slo
 
         return cls(
             pet=PetSettings(
@@ -802,6 +870,28 @@ class Settings:
                     ten_raw.get("ingest_capacity", ten_base.ingest_capacity)
                 ),
                 max_share=float(ten_raw.get("max_share", ten_base.max_share)),
+            ),
+            slo=SloSettings(
+                enabled=bool(slo_raw.get("enabled", slo_base.enabled)),
+                round_wall_s=float(slo_raw.get("round_wall_s", slo_base.round_wall_s)),
+                tenant_round_wall_s=str(
+                    slo_raw.get("tenant_round_wall_s", slo_base.tenant_round_wall_s)
+                ),
+                round_wall_budget=float(
+                    slo_raw.get("round_wall_budget", slo_base.round_wall_budget)
+                ),
+                degraded_budget=float(
+                    slo_raw.get("degraded_budget", slo_base.degraded_budget)
+                ),
+                shed_budget=float(slo_raw.get("shed_budget", slo_base.shed_budget)),
+                fast_window_s=float(
+                    slo_raw.get("fast_window_s", slo_base.fast_window_s)
+                ),
+                slow_window_s=float(
+                    slo_raw.get("slow_window_s", slo_base.slow_window_s)
+                ),
+                warn_burn=float(slo_raw.get("warn_burn", slo_base.warn_burn)),
+                page_burn=float(slo_raw.get("page_burn", slo_base.page_burn)),
             ),
         )
 

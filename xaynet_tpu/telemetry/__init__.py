@@ -9,6 +9,13 @@
   of the registry, forwarding to the legacy Jsonl/Influx sinks;
 - ``tracing``   — the distributed round-tracing span layer (trace ids,
   bounded buffers, Chrome-trace export — docs/DESIGN.md §16);
+- ``timeline``  — the always-on round-wall profiler: a streaming fold
+  over each flushed round's span buffer into the
+  ``xaynet_round_wall_seconds{tenant}`` histogram and a per-phase
+  self-time/overlap decomposition (docs/DESIGN.md §20);
+- ``slo``       — per-tenant SLO engine: multi-window burn-rate alerts
+  over registry deltas, ``GET /alerts`` payloads, flight-recorder pages
+  (docs/DESIGN.md §20);
 - ``recorder``  — the flight recorder dumping span ring + registry deltas
   on failure triggers;
 - ``redact``    — runtime secret redaction: ``redact()`` (the sanctioned
@@ -27,6 +34,8 @@ from .registry import (
     get_registry as get_registry,
 )
 from .report import RoundReporter as RoundReporter
+from .slo import SloEngine as SloEngine, get_engine as get_slo_engine
+from .timeline import RoundTimeline as RoundTimeline, get_timeline as get_timeline
 from .tracing import (
     TraceContext as TraceContext,
     Tracer as Tracer,

@@ -10,7 +10,10 @@ trigger fires:
 - ``phase-timeout``     — a window closed below quorum (PhaseTimeout);
 - ``breaker-open``      — a resilience circuit breaker opened;
 - ``edge-ship-drop``    — an edge dropped a sealed envelope (retries
-  exhausted / upstream unreachable).
+  exhausted / upstream unreachable);
+- ``slo-page``          — a page-severity SLO burn-rate alert fired
+  (``telemetry.slo``): the bundle is the forensics of the rounds that
+  spent the error budget.
 
 Dumps are rate-limited (at most one per trigger per
 ``_MIN_INTERVAL_S``, ``_MAX_DUMPS`` per process) so a crash-looping
@@ -43,7 +46,8 @@ logger = logging.getLogger("xaynet.telemetry")
 FLIGHT_DUMPS = get_registry().counter(
     "xaynet_flight_dumps_total",
     "Flight-recorder dumps written, by trigger (pipeline-poison | "
-    "degraded-close | phase-timeout | breaker-open | edge-ship-drop).",
+    "degraded-close | phase-timeout | breaker-open | edge-ship-drop | "
+    "slo-page).",
     ("trigger",),
 )
 
@@ -91,15 +95,21 @@ class FlightRecorder:
         snap: dict[str, float] = {}
         reg = get_registry()
         # private-ish iteration kept inside telemetry (this module and the
-        # registry are one subsystem): counters + gauges only, histograms
-        # would bloat the bundle for no forensic value
+        # registry are one subsystem). Histograms contribute their _sum and
+        # _count series (latency evidence — "update handling took 40s this
+        # round" is exactly what a forensic bundle is for); the per-bucket
+        # vectors stay out, they would bloat the bundle without adding a
+        # story the sum/count pair doesn't tell
         with reg._lock:
             families = list(reg._families.values())
         for family in families:
-            if family.kind == "histogram":
-                continue
             for labelvalues, child in family.children():
                 label = ",".join(labelvalues)
+                if family.kind == "histogram":
+                    suffix = f"{{{label}}}" if label else ""
+                    snap[f"{family.name}_sum{suffix}"] = child.sum
+                    snap[f"{family.name}_count{suffix}"] = float(child.count)
+                    continue
                 key = f"{family.name}{{{label}}}" if label else family.name
                 snap[key] = child.value
         return snap

@@ -33,7 +33,8 @@ REDACTIONS = get_registry().counter(
     "xaynet_redactions_total",
     "Values redacted from telemetry surfaces before leaving the process, "
     "by site (redact = explicit redact() call | flight = flight-recorder "
-    "dump filter | trace = Chrome-trace export filter).",
+    "dump filter | trace = Chrome-trace export filter | alerts = SLO "
+    "alert-payload filter).",
     ("site",),
 )
 

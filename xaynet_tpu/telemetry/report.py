@@ -99,6 +99,24 @@ def _bytes_counters() -> dict[str, dict[str, float]]:
     return out
 
 
+def _timeline_snapshot(tenant: str, round_id: Optional[int]) -> Optional[dict]:
+    """The round-wall decomposition for the flushing round from the
+    always-on timeline fold (docs/DESIGN.md §20); ``None`` when tracing is
+    off or the round left no foldable bracket. The report carries tenant
+    and round id already, so both are stripped from the section."""
+    if round_id is None:
+        return None
+    from .timeline import get_timeline
+
+    decomp = get_timeline().fold_for_report(tenant, round_id)
+    if decomp is None:
+        return None
+    out = dict(decomp)
+    out.pop("round_id", None)
+    out.pop("tenant", None)
+    return out
+
+
 def _fairness_snapshot() -> Optional[dict]:
     """Per-tenant fold-batch grants since the previous round flush, read
     from the tenant scheduler (lazy import: telemetry must not pull the
@@ -192,6 +210,14 @@ class RoundReporter:
             "kernels": profiling.drain_round_stats(),
             "events": self._events,
         }
+        timeline_section = _timeline_snapshot(self.tenant, self._round_id)
+        if timeline_section is not None:
+            # the round-wall decomposition from the always-on timeline
+            # fold (docs/DESIGN.md §20): end-to-end wall, per-phase
+            # wall/self time, cross-phase overlap + gap (the identity
+            # sum(phase walls) - overlap + gap == wall holds), top-k
+            # slowest spans and the degraded flag
+            report["round_wall"] = timeline_section
         fairness = _fairness_snapshot()
         if fairness is not None:
             # the tenant scheduler's fold-batch split since the last round

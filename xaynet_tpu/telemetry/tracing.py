@@ -327,6 +327,10 @@ class Tracer:
         # round-boundary listeners (the flight recorder snapshots registry
         # counters here); fail-soft by contract
         self._round_hooks: list = []
+        # round-flush listeners: called with (round_id, spans) when a round
+        # window closes (the timeline fold consumes the span buffer here);
+        # fail-soft by contract
+        self._flush_hooks: list = []
 
     # -- configuration -----------------------------------------------------
 
@@ -346,6 +350,13 @@ class Tracer:
     def add_round_hook(self, hook) -> None:
         if hook not in self._round_hooks:
             self._round_hooks.append(hook)
+
+    def add_flush_hook(self, hook) -> None:
+        """Register ``hook(round_id, spans)``, called every time a round
+        window flushes (``end_round``) with the round's span buffer —
+        parents already resolved, ready for in-process analysis."""
+        if hook not in self._flush_hooks:
+            self._flush_hooks.append(hook)
 
     # -- recording ---------------------------------------------------------
 
@@ -482,6 +493,11 @@ class Tracer:
             if s.parent_id and s.parent_id not in ids:
                 s.attrs.setdefault("link", s.parent_id)
                 s.parent_id = None
+        for hook in self._flush_hooks:
+            try:
+                hook(round_id, spans)
+            except Exception:  # a telemetry consumer must never fail a round
+                logger.exception("trace flush hook failed")
         if self.trace_dir and self.mode == "on":
             self._export(round_id, spans)
         return spans
@@ -490,6 +506,16 @@ class Tracer:
         """Snapshot of the flight-recorder ring, oldest first."""
         with self._lock:
             return list(self._ring)
+
+    def round_spans_snapshot(self) -> tuple[Optional[int], list[Span]]:
+        """The open round window's id and a copy of its buffered spans —
+        for in-process consumers that need the buffer BEFORE the window
+        flushes (the round report's timeline section fires one phase
+        earlier than ``end_round``). ``(None, [])`` outside a window."""
+        with self._lock:
+            if self._round_id is None:
+                return None, []
+            return self._round_id, list(self._round_spans)
 
     # -- export ------------------------------------------------------------
 
