@@ -1,0 +1,142 @@
+"""Ingress observability + binary seed fan-out codec unit tests.
+
+The /healthz + /statusz ``ingress`` section (accepted/shed rates, shard
+occupancy, accepted wire-format mix) is fed by ``IngestPipeline`` hooks
+and ``RateWindow`` buckets; the batched seed fan-out rides the
+``pack_seed_entries``/``unpack_seed_entries`` frame. Both must be exact:
+operators alert on these numbers and the seed frame carries key material.
+"""
+
+from types import SimpleNamespace
+
+from xaynet_tpu.core.crypto.encrypt import EncryptKeyPair
+from xaynet_tpu.core.mask.seed import (
+    SEED_ENTRY_LENGTH,
+    MaskSeed,
+    pack_seed_entries,
+    unpack_seed_entries,
+)
+from xaynet_tpu.ingest.pipeline import IngestPipeline, RateWindow
+from xaynet_tpu.server.settings import IngestSettings
+from xaynet_tpu.server.events import PhaseName
+
+import pytest
+
+
+# --- RateWindow ---------------------------------------------------------------
+
+
+def test_rate_window_averages_over_window():
+    w = RateWindow(window_s=10)
+    for t in range(5):
+        w.add(20, now=t)
+    assert w.rate(now=4) == pytest.approx(10.0)  # 100 events / 10 s window
+
+
+def test_rate_window_decays_to_zero():
+    w = RateWindow(window_s=5)
+    w.add(50, now=100)
+    assert w.rate(now=100) == pytest.approx(10.0)
+    assert w.rate(now=104) == pytest.approx(10.0)
+    assert w.rate(now=106) == 0.0  # bucket aged out of the window
+
+
+def test_rate_window_same_second_coalesces():
+    w = RateWindow(window_s=10)
+    for _ in range(7):
+        w.add(now=42)
+    assert len(w._buckets) == 1
+    assert w.rate(now=42) == pytest.approx(0.7)
+
+
+def test_rate_window_validates_window():
+    with pytest.raises(ValueError):
+        RateWindow(window_s=0)
+
+
+# --- ingress_stats / health wiring -------------------------------------------
+
+
+def _pipeline() -> IngestPipeline:
+    latest = SimpleNamespace(event=PhaseName.UPDATE)
+    events = SimpleNamespace(phase=SimpleNamespace(get_latest=lambda: latest))
+    return IngestPipeline(
+        handler=None,
+        request_tx=None,
+        events=events,
+        settings=IngestSettings(enabled=True, shards=2, queue_bound=4),
+    )
+
+
+def test_ingress_stats_counts_wire_mix():
+    pipe = _pipeline()
+    update_packed = SimpleNamespace(payload=SimpleNamespace(wire_planar=True))
+    update_legacy = SimpleNamespace(payload=SimpleNamespace(wire_planar=False))
+    sum_msg = SimpleNamespace(payload=SimpleNamespace())  # no wire_planar attr
+    for _ in range(3):
+        pipe._count_accepted(update_packed)
+    pipe._count_accepted(update_legacy)
+    pipe._count_accepted(sum_msg)
+
+    stats = pipe.ingress_stats()
+    assert stats["accepted_total"] == 5
+    assert stats["wire"] == {"packed": 3, "legacy": 1}
+    assert stats["accepted_per_s"] > 0
+    assert stats["shed_total"] == 0
+    assert len(stats["shard_occupancy"]) == 2
+
+
+def test_health_carries_ingress_section():
+    pipe = _pipeline()
+    pipe._count_accepted(SimpleNamespace(payload=SimpleNamespace(wire_planar=True)))
+    health = pipe.health()
+    assert health["ingress"]["accepted_total"] == 1
+    assert health["ingress"]["wire"]["packed"] == 1
+    # saturation snapshot keys the SLO console reads stay present
+    for key in ("saturated", "occupancy", "capacity", "shards"):
+        assert key in health
+
+
+# --- binary seed fan-out frame ------------------------------------------------
+
+
+def _seed_dict(n: int):
+    out = {}
+    for i in range(n):
+        pk = bytes([i]) * 32
+        out[pk] = MaskSeed.generate().encrypt(EncryptKeyPair.generate().public)
+    return out
+
+
+def test_seed_entries_round_trip_and_determinism():
+    d = _seed_dict(5)
+    body = pack_seed_entries(d)
+    assert len(body) == 4 + 5 * SEED_ENTRY_LENGTH
+    # deterministic: insertion order must not leak into the frame
+    shuffled = dict(reversed(list(d.items())))
+    assert pack_seed_entries(shuffled) == body
+    back = unpack_seed_entries(body)
+    assert back.keys() == d.keys()
+    for pk in d:
+        assert back[pk].as_bytes() == d[pk].as_bytes()
+
+
+def test_seed_entries_zero_copy_view_accepted():
+    body = pack_seed_entries(_seed_dict(2))
+    assert unpack_seed_entries(memoryview(body)).keys() == unpack_seed_entries(body).keys()
+
+
+def test_seed_entries_reject_malformed_frames():
+    body = pack_seed_entries(_seed_dict(3))
+    with pytest.raises(ValueError):
+        unpack_seed_entries(body[:-1])  # truncated entry
+    with pytest.raises(ValueError):
+        unpack_seed_entries(body + b"\x00")  # trailing garbage
+    with pytest.raises(ValueError):
+        unpack_seed_entries(b"\x00\x00")  # shorter than the count frame
+    # count lies about the body length
+    lied = (99).to_bytes(4, "big") + body[4:]
+    with pytest.raises(ValueError):
+        unpack_seed_entries(lied)
+    with pytest.raises(ValueError):
+        pack_seed_entries({b"\x01" * 31: next(iter(_seed_dict(1).values()))})

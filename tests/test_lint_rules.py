@@ -429,3 +429,74 @@ def test_width_unrelated_floordivs_pass(tmp_path, monkeypatch):
     )
     problems = _check(tmp_path, monkeypatch, "xaynet_tpu/core/baz.py", source)
     assert not any("hand-computed wire/pack width" in p for p in problems)
+
+
+# --- the ingress zero-copy (wirecopy) rule -----------------------------------
+
+
+def test_wirecopy_bytes_materialization_rejected_in_ingest(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/ingest/foo.py",
+        "def intake(view):\n    return bytes(view)\n",
+    )
+    assert any("whole-body copy on the ingress path" in p for p in problems)
+
+
+def test_wirecopy_tobytes_rejected_in_rest(tmp_path, monkeypatch):
+    problems = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/rest.py",
+        "def handler(arr):\n    return arr.tobytes()\n",
+    )
+    assert any(".tobytes() export" in p for p in problems)
+
+
+def test_wirecopy_payload_slice_rejected(tmp_path, monkeypatch):
+    source = (
+        "def parse(body, header_len):\n"
+        "    head = body[:header_len]\n"
+        "    return head\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/ingest/foo.py", source)
+    assert any("slice-copy of payload buffer 'body'" in p for p in problems)
+
+
+def test_wirecopy_non_payload_slice_and_index_pass(tmp_path, monkeypatch):
+    source = (
+        "def parse(result, body):\n"
+        "    status = result[:3]\n"  # tuple destructure, not a payload
+        "    first = body[0]\n"  # single-byte index, not a slice-copy
+        "    return status, first\n"
+    )
+    problems = _check(tmp_path, monkeypatch, "xaynet_tpu/ingest/foo.py", source)
+    assert not any("whole-body copy" in p for p in problems)
+
+
+def test_wirecopy_allowlist_and_scope(tmp_path, monkeypatch):
+    allow = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/ingest/foo.py",
+        "def seal(view):\n    return bytes(view)  # lint: wirecopy-ok\n",
+    )
+    assert not any("whole-body copy" in p for p in allow)
+    # the rule stops at the ingress path: SDK/client code copies freely
+    elsewhere = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/sdk/foo.py",
+        "def pack(view):\n    return bytes(view) + view.tobytes()\n",
+    )
+    assert not any("whole-body copy" in p for p in elsewhere)
+    # server tree outside rest.py is out of scope too (state machine code
+    # owns decrypted plaintext, not wire bodies)
+    server_other = _check(
+        tmp_path,
+        monkeypatch,
+        "xaynet_tpu/server/coordinator.py",
+        "def snapshot(buf):\n    return bytes(buf)\n",
+    )
+    assert not any("whole-body copy" in p for p in server_other)

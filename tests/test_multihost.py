@@ -49,6 +49,13 @@ def _run_sharded_ingest(n_procs: int, devs_per_proc: int, timeout: float = 240):
         for p in procs:
             p.kill()
         pytest.fail("multi-host workers timed out:\n" + "\n---\n".join(outs))
+    # jax CPU backends (<= 0.4.x) cannot run multiprocess collectives at
+    # all — the workers die with this exact capability error before any
+    # assertion of OURS can run. Skip (not fail): the test is about the
+    # sharded-ingest protocol, which needs a backend that has the feature.
+    unsupported = "Multiprocess computations aren't implemented on the CPU backend"
+    if any(p.returncode != 0 and unsupported in out for p, out in zip(procs, outs)):
+        pytest.skip(f"jax backend capability missing: {unsupported}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out[-3000:]}"
         assert f"WORKER {i} OK" in out, out[-3000:]

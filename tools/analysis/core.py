@@ -101,6 +101,13 @@ rule(
     "wire_width_for / draw_width_for / n_limbs_for_bytes)",
 )
 rule(
+    "wirecopy",
+    "whole-body copy of a request payload on the ingress path (bytes()/"
+    "bytearray() materialization, .tobytes() export, or a slice-copy of a "
+    "payload buffer in ingest/ + server/rest.py — bodies must stay "
+    "zero-copy memoryview views end to end, docs/DESIGN.md §21)",
+)
+rule(
     "span",
     "tracing span() not used as a context manager, span name declared "
     "twice / undeclared, or code <-> DESIGN.md §16 span-table drift",

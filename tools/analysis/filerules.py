@@ -284,6 +284,41 @@ def _is_device_put(node: ast.Call) -> bool:
     return isinstance(func, ast.Name) and func.id == "device_put"
 
 
+_WIRECOPY_PAYLOAD_NAMES = frozenset(
+    {"body", "payload", "raw", "blob", "buf", "wire", "msg", "message"}
+)
+
+
+def _wire_copy_kind(node: ast.AST) -> str | None:
+    """Classify whole-body copy idioms on the ingress path: ``bytes()`` /
+    ``bytearray()`` materializations, ``.tobytes()`` exports, and
+    slice-copies of payload-named buffers (slicing ``bytes`` copies; the
+    zero-copy spelling slices a ``memoryview``, which doesn't)."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("bytes", "bytearray")
+            and node.args
+        ):
+            return f"{func.id}() materialization"
+        if isinstance(func, ast.Attribute) and func.attr == "tobytes":
+            return ".tobytes() export"
+    if isinstance(node, ast.Subscript) and isinstance(node.slice, ast.Slice):
+        target = node.value
+        name = (
+            target.id
+            if isinstance(target, ast.Name)
+            else target.attr if isinstance(target, ast.Attribute) else ""
+        )
+        low = name.lower()
+        if low in _WIRECOPY_PAYLOAD_NAMES or any(
+            low.endswith("_" + n) for n in _WIRECOPY_PAYLOAD_NAMES
+        ):
+            return f"slice-copy of payload buffer '{name}'"
+    return None
+
+
 def check_file_info(info: FileInfo) -> list[Finding]:
     """Run every per-file rule over one parsed file."""
     problems: list[Finding] = list(info.problems)
@@ -356,6 +391,12 @@ def check_file_info(info: FileInfo) -> list[Finding]:
     # plane and its unpack disagree by one byte
     width_tree = (
         rel.startswith("xaynet_tpu/") and rel != "xaynet_tpu/ops/limbs.py"
+    )
+    # ingress path: request bodies must stay zero-copy memoryview views
+    # from socket read to staging — a stray bytes()/tobytes()/slice copy
+    # doubles the per-update byte traffic the packed wire exists to cut
+    wirecopy_tree = (
+        rel.startswith("xaynet_tpu/ingest/") or rel == "xaynet_tpu/server/rest.py"
     )
 
     line_of = info.line
@@ -469,6 +510,17 @@ def check_file_info(info: FileInfo) -> list[Finding]:
                     "n_limbs_for_bytes — the codec module is the single "
                     "source of truth — or annotate a non-wire byte-length "
                     "computation with '# lint: width-ok')",
+                )
+        if wirecopy_tree:
+            kind = _wire_copy_kind(node)
+            if kind is not None and not suppressed("wirecopy", line_of(node.lineno)):
+                add(
+                    "wirecopy",
+                    node.lineno,
+                    f"whole-body copy on the ingress path ({kind}) — "
+                    "request payloads must stay zero-copy memoryview views "
+                    "end to end; annotate a deliberate boundary "
+                    "materialization with '# lint: wirecopy-ok'",
                 )
         if bounded_tree and isinstance(node, ast.Call) and _is_device_put(node):
             if not suppressed("device-put", line_of(node.lineno)):

@@ -49,6 +49,8 @@ NB_MODELS_SITES: dict[tuple[str, str], str] = {
     # paired with the in-flight decrement (counted_models() atomicity)
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.fold_planar_rows_now"):
         "caller-thread fold credit",
+    ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.fold_packed_rows_now"):
+        "caller-thread fold credit (pre-packed byte-planar rows, §21 wire ingest)",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator.fold_planar_stack_now"):
         "caller-thread fold credit (stacked device batch, fused mask pipeline)",
     ("xaynet_tpu/parallel/streaming.py", "StreamingAggregator._fold_pinned_stack"):

@@ -74,6 +74,13 @@ LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT, ROUND_WALL_UNIT})
 # in 25M-equivalent updates/s (tenant B's updates scaled by its length
 # fraction); the record also carries the scheduler's fairness split
 TENANT_PREFIX = "multi-tenant interleaved fold"
+# coordinator-ingress family (tools/loadgen_soak.py, DESIGN §21): accepted
+# updates/s at the REST boundary for a loadgen-driven round — the
+# million-participant ingress headline. Its sibling series, "ingress
+# staging bytes per accepted update", is recorded alongside for the
+# packed-vs-legacy comparison but not gated (bytes/update depends on the
+# negotiated wire mix, which the soak varies deliberately).
+INGRESS_PREFIX = "ingress accepted updates"
 # families gated independently when no explicit --metric-prefix is given
 DEFAULT_FAMILIES = (
     (HEADLINE_PREFIX, HEADLINE_UNIT),
@@ -83,6 +90,7 @@ DEFAULT_FAMILIES = (
     (BYTES_PREFIX, BYTES_UNIT),
     (TENANT_PREFIX, HEADLINE_UNIT),
     (ROUND_WALL_PREFIX, ROUND_WALL_UNIT),
+    (INGRESS_PREFIX, HEADLINE_UNIT),
 )
 
 
@@ -111,6 +119,13 @@ def extract(record: dict) -> tuple[str, float, str, str] | None:
                 "mesh",
                 "participants",
                 "block",
+                # loadgen_soak ingress records: the driver-tier shape and
+                # negotiated wire format are the experiment (absent from
+                # every older writer's records, so existing series keep
+                # their fingerprints)
+                "drivers",
+                "tenants",
+                "wire",
             ):
                 if node.get(field) is not None:
                     parts.append(f"{field}={node[field]}")

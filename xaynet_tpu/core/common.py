@@ -52,6 +52,11 @@ class RoundParameters:
     seed: RoundSeed
     mask_config: MaskConfigPair
     model_length: int
+    # negotiated upload wire format: 1 = legacy interleaved element blocks,
+    # 2 = packed byte-planar (serialization.WIRE_PLANAR_FLAG). Advertised to
+    # participants via /params; the server parse auto-detects per message,
+    # so a v1 client against a v2 round (and vice versa) stays valid.
+    wire_format: int = 1
 
     def to_dict(self) -> dict:
         c = self.mask_config.vect
@@ -66,6 +71,7 @@ class RoundParameters:
                 "unit": list(u.to_bytes()),
             },
             "model_length": self.model_length,
+            "wire_format": self.wire_format,
         }
 
     @classmethod
@@ -82,6 +88,7 @@ class RoundParameters:
                 unit=MaskConfig.from_bytes(bytes(d["mask_config"]["unit"])),
             ),
             model_length=int(d["model_length"]),
+            wire_format=int(d.get("wire_format", 1)),
         )
 
     def __eq__(self, other) -> bool:
@@ -93,4 +100,5 @@ class RoundParameters:
             and self.seed == other.seed
             and self.mask_config == other.mask_config
             and self.model_length == other.model_length
+            and self.wire_format == other.wire_format
         )

@@ -84,10 +84,15 @@ class LazyWireMaskVect(MaskVect):
     first host materialization — same update rejected, one stage later.
     """
 
-    def __init__(self, config: MaskConfig, wire_block: np.ndarray, count: int):
+    def __init__(
+        self, config: MaskConfig, wire_block: np.ndarray, count: int, planar: bool = False
+    ):
         self.config = config
         self.wire_block = wire_block  # uint8[count * bytes_per_number]
         self._count = count
+        # wire format v2: the block is byte-planar (bpn planes of count
+        # bytes) instead of interleaved — already the packed staging layout
+        self.planar = planar
         self._data: np.ndarray | None = None
         # device planar cached by StagedAggregator.validate_aggregation so
         # stage() never re-uploads; _wire_invalid is the cached REJECTED
@@ -100,11 +105,29 @@ class LazyWireMaskVect(MaskVect):
     def materialized(self) -> bool:
         return self._data is not None
 
+    @property
+    def planar_block(self) -> np.ndarray:
+        """Zero-copy ``uint8[bpn, count]`` view of a v2 planar element block
+        (the shape the packed staging rings and the device planar-unpack
+        consume directly)."""
+        if not self.planar:
+            raise ValueError("planar_block on an interleaved (v1) wire vect")
+        return np.asarray(self.wire_block).reshape(
+            self.config.bytes_per_number, self._count
+        )
+
     @property  # type: ignore[override]
     def data(self) -> np.ndarray:
         if self._data is None:
+            block = np.asarray(self.wire_block)
+            if self.planar:
+                from .serialization import planar_to_interleaved
+
+                block = planar_to_interleaved(
+                    block, self._count, self.config.bytes_per_number
+                )
             self._data = limb_ops.bytes_le_to_limbs(
-                np.asarray(self.wire_block), self._count, self.config.bytes_per_number
+                block, self._count, self.config.bytes_per_number
             )
         return self._data
 

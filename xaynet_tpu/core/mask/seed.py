@@ -64,3 +64,50 @@ class EncryptedMaskSeed:
         if len(plain) != MASK_SEED_LENGTH:
             raise DecryptError("decrypted mask seed has invalid length")
         return MaskSeed(plain)
+
+
+# --- batched seed fan-out wire format (GET /seeds?fmt=bin, §21) -----------
+#
+# count(u32 BE) ‖ count x [ participant pk(32) ‖ encrypted seed(80) ]
+#
+# Fixed 112-byte entries: a sum participant fetching a 100k-update seed
+# slice downloads ~11 MB of raw entries instead of ~22 MB of JSON hex, and
+# both ends slice instead of parsing. The JSON shape stays the default —
+# the binary body is opt-in per request and byte-equivalent in content.
+
+SEED_ENTRY_PK_LENGTH = 32
+SEED_ENTRY_LENGTH = SEED_ENTRY_PK_LENGTH + ENCRYPTED_MASK_SEED_LENGTH  # 112
+
+
+def pack_seed_entries(seed_dict: dict) -> bytes:
+    """Serialize an UpdateSeedDict slice ``{pk: EncryptedMaskSeed}`` into
+    the batched binary fan-out body (deterministic: entries sorted by pk,
+    so identical dicts serialize identically)."""
+    parts = [len(seed_dict).to_bytes(4, "big")]
+    for pk in sorted(seed_dict):
+        if len(pk) != SEED_ENTRY_PK_LENGTH:
+            raise ValueError("seed-dict pk must be 32 bytes")
+        parts.append(pk)
+        parts.append(seed_dict[pk].as_bytes())
+    return b"".join(parts)
+
+
+def unpack_seed_entries(data) -> dict:
+    """Parse a batched binary fan-out body back into
+    ``{pk: EncryptedMaskSeed}``. Accepts any buffer; slices views, never
+    copies the body. Raises ``ValueError`` on a malformed frame."""
+    view = memoryview(data)
+    if len(view) < 4:
+        raise ValueError("seed fan-out body too short")
+    count = int.from_bytes(view[:4], "big")
+    if len(view) != 4 + count * SEED_ENTRY_LENGTH:
+        raise ValueError("seed fan-out length does not match the framed count")
+    out = {}
+    for i in range(count):
+        start = 4 + i * SEED_ENTRY_LENGTH
+        pk = bytes(view[start : start + SEED_ENTRY_PK_LENGTH])
+        seed = bytes(
+            view[start + SEED_ENTRY_PK_LENGTH : start + SEED_ENTRY_LENGTH]
+        )
+        out[pk] = EncryptedMaskSeed(seed)
+    return out

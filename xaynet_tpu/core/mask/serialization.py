@@ -10,6 +10,16 @@ Layouts (reference: rust/xaynet-core/src/mask/object/serialization/):
 The element block converts directly between wire bytes and the uint32 limb
 tensors (a vectorized numpy pad/view — no per-element loop), which is what
 makes parsing a 25M-element update a memcpy-class operation.
+
+Wire format v2 (packed planar, docs/DESIGN.md §21): the top bit of the
+count word (``WIRE_PLANAR_FLAG``) marks the element block as BYTE-PLANAR —
+``bytes_per_number`` contiguous planes of ``count`` bytes each, plane ``b``
+holding byte ``b`` of every element — instead of the v1 interleaved
+per-element layout. Same byte budget, but the planar block is already the
+PR-13 packed staging layout, so a device-ingest coordinator uploads it
+without the byte-gather relayout and never materializes uint32 limbs.
+Element counts are bounded far below 2^31 (``MAX_BODY`` caps the message),
+so the flag bit can never collide with a real count.
 """
 
 from __future__ import annotations
@@ -29,6 +39,23 @@ class DecodeError(ValueError):
 
 # config(4) + count(u32 BE): everything before the element block
 VECT_HEADER_LENGTH = MASK_CONFIG_LENGTH + 4
+
+# top bit of the count word: element block is byte-planar (wire format v2)
+WIRE_PLANAR_FLAG = 0x8000_0000
+
+
+def _split_count_word(word: int) -> tuple[int, bool]:
+    """(element count, planar?) from the wire count word."""
+    return word & ~WIRE_PLANAR_FLAG, bool(word & WIRE_PLANAR_FLAG)
+
+
+def planar_to_interleaved(block: np.ndarray, count: int, bpn: int) -> np.ndarray:
+    """Byte-planar element block ``uint8[bpn * count]`` -> the v1 interleaved
+    layout (one materializing transpose — the lazy path's host FALLBACK; the
+    device path consumes the planar block directly)."""
+    return np.ascontiguousarray(
+        np.asarray(block).reshape(bpn, count).T
+    ).reshape(-1)
 
 
 def serialized_vect_length(config: MaskConfig, count: int) -> int:
@@ -50,14 +77,33 @@ def vect_element_block(wire: bytes) -> np.ndarray:
         config = MaskConfig.from_bytes(wire[:MASK_CONFIG_LENGTH])
     except ValueError as e:
         raise DecodeError(f"invalid mask config: {e}") from e
-    (count,) = struct.unpack_from(">I", wire, MASK_CONFIG_LENGTH)
+    (word,) = struct.unpack_from(">I", wire, MASK_CONFIG_LENGTH)
+    count, planar = _split_count_word(word)
+    if planar:
+        raise DecodeError("planar (v2) element block where interleaved expected")
     if len(wire) != VECT_HEADER_LENGTH + count * config.bytes_per_number:
         raise DecodeError("wire length does not match the framed element count")
     return np.frombuffer(wire, dtype=np.uint8)[VECT_HEADER_LENGTH:]
 
 
-def serialize_mask_vect(vect: MaskVect) -> bytes:
+def serialize_mask_vect(vect: MaskVect, planar: bool = False) -> bytes:
     bpn = vect.config.bytes_per_number
+    if planar:
+        from .object import LazyWireMaskVect
+
+        if isinstance(vect, LazyWireMaskVect) and vect.planar and not vect.materialized:
+            # parsed-from-planar-wire and never touched: re-emit the block
+            block = np.asarray(vect.wire_block).tobytes()
+        else:
+            interleaved = limb_ops.limbs_to_bytes_le(vect.data, bpn)
+            block = np.ascontiguousarray(
+                np.frombuffer(interleaved, dtype=np.uint8).reshape(len(vect), bpn).T
+            ).tobytes()
+        return (
+            vect.config.to_bytes()
+            + struct.pack(">I", len(vect) | WIRE_PLANAR_FLAG)
+            + block
+        )
     return (
         vect.config.to_bytes()
         + struct.pack(">I", len(vect))
@@ -81,7 +127,8 @@ def parse_mask_vect(data: bytes, offset: int = 0, lazy: bool = False) -> tuple[M
         config = MaskConfig.from_bytes(data[offset : offset + MASK_CONFIG_LENGTH])
     except ValueError as e:
         raise DecodeError(f"invalid mask config: {e}") from e
-    (count,) = struct.unpack_from(">I", data, offset + MASK_CONFIG_LENGTH)
+    (word,) = struct.unpack_from(">I", data, offset + MASK_CONFIG_LENGTH)
+    count, planar = _split_count_word(word)
     bpn = config.bytes_per_number
     start = offset + MASK_CONFIG_LENGTH + 4
     end = start + count * bpn
@@ -91,7 +138,9 @@ def parse_mask_vect(data: bytes, offset: int = 0, lazy: bool = False) -> tuple[M
     if lazy:
         from .object import LazyWireMaskVect
 
-        return LazyWireMaskVect(config, raw, count), end - offset
+        return LazyWireMaskVect(config, raw, count, planar=planar), end - offset
+    if planar:
+        raw = planar_to_interleaved(raw, count, bpn)
     limbs = limb_ops.bytes_le_to_limbs(raw, count, bpn)
     vect = MaskVect(config, limbs)
     if not vect.is_valid():
@@ -143,17 +192,29 @@ def parse_mask_vect_stream(reader, lazy: bool = False) -> MaskVect:
         config = MaskConfig.from_bytes(head[:MASK_CONFIG_LENGTH])
     except ValueError as e:
         raise DecodeError(f"invalid mask config: {e}") from e
-    (count,) = struct.unpack_from(">I", head, MASK_CONFIG_LENGTH)
+    (word,) = struct.unpack_from(">I", head, MASK_CONFIG_LENGTH)
+    count, planar = _split_count_word(word)
     bpn = config.bytes_per_number
     nbytes = count * bpn
     if nbytes > reader.remaining:
         raise DecodeError("mask vector data truncated")
-    if lazy:
-        from .object import LazyWireMaskVect
-
+    if lazy or planar:
+        # planar blocks gather as one byte copy either way: the segmented
+        # interleaved convert below walks element-major segments, which a
+        # plane-major block cannot feed without a full-block staging anyway
         raw = np.empty(nbytes, dtype=np.uint8)
         reader.read_into(raw)
-        return LazyWireMaskVect(config, raw, count)
+        if lazy:
+            from .object import LazyWireMaskVect
+
+            return LazyWireMaskVect(config, raw, count, planar=planar)
+        limbs = limb_ops.bytes_le_to_limbs(
+            planar_to_interleaved(raw, count, bpn), count, bpn
+        )
+        vect = MaskVect(config, limbs)
+        if not vect.is_valid():
+            raise DecodeError("mask vector element >= group order")
+        return vect
     # segmented convert: fixed-size wire segments go straight into the limb
     # tensor, so the transient staging is bounded (never O(payload))
     n_limb = limb_ops.n_limbs_for_bytes(bpn)
@@ -188,8 +249,10 @@ def parse_mask_unit_stream(reader) -> MaskUnit:
     return unit
 
 
-def serialize_mask_object(obj: MaskObject) -> bytes:
-    return serialize_mask_vect(obj.vect) + serialize_mask_unit(obj.unit)
+def serialize_mask_object(obj: MaskObject, planar_vect: bool = False) -> bytes:
+    """``planar_vect`` emits the VECTOR part in the v2 byte-planar layout
+    (the unit part is one element — planes would be a no-op relabel)."""
+    return serialize_mask_vect(obj.vect, planar=planar_vect) + serialize_mask_unit(obj.unit)
 
 
 def parse_mask_object(

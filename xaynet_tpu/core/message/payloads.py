@@ -125,6 +125,10 @@ class Update:
     update_signature: bytes
     masked_model: MaskObject
     local_seed_dict: dict
+    # serialize the masked model's vector part in the v2 byte-planar wire
+    # layout (negotiated via RoundParameters.wire_format; the parse side
+    # auto-detects from the count-word flag, so this only shapes to_bytes)
+    wire_planar: bool = False
 
     def serialized_length(self) -> int:
         from ..mask.serialization import serialized_object_length
@@ -140,7 +144,7 @@ class Update:
         return (
             self.sum_signature
             + self.update_signature
-            + serialize_mask_object(self.masked_model)
+            + serialize_mask_object(self.masked_model, planar_vect=self.wire_planar)
             + serialize_local_seed_dict(self.local_seed_dict)
         )
 
@@ -155,6 +159,7 @@ class Update:
             update_signature=data[SIGNATURE_LENGTH : 2 * SIGNATURE_LENGTH],
             masked_model=masked,
             local_seed_dict=seed_dict,
+            wire_planar=bool(getattr(masked.vect, "planar", False)),
         )
 
     @classmethod
@@ -168,6 +173,7 @@ class Update:
             update_signature=sigs[SIGNATURE_LENGTH:],
             masked_model=MaskObject(vect, unit),
             local_seed_dict=seed_dict,
+            wire_planar=bool(getattr(vect, "planar", False)),
         )
 
 

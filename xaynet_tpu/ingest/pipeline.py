@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 
 from ..core.crypto.encrypt import SEALBYTES
 from ..core.message.message import HEADER_LENGTH
@@ -51,10 +52,60 @@ WORKER_RESTARTS = get_registry().counter(
     ("shard", "tenant"),
 )
 
+INGRESS_ACCEPTED = get_registry().counter(
+    "xaynet_ingress_accepted_total",
+    "Messages ACCEPTED at the ingress boundary — decrypted, verified and "
+    "task-validated, then forwarded toward the state machine — by tenant. "
+    "Admission ('admitted') only means a queue slot; this counts survivors "
+    "of the whole intake pipeline, the coordinator-ingress headline.",
+    ("tenant",),
+)
+INGRESS_WIRE = get_registry().counter(
+    "xaynet_ingress_wire_total",
+    "Accepted Update payloads by wire element layout: packed = v2 "
+    "byte-planar (WIRE_PLANAR_FLAG), legacy = v1 interleaved. The mix "
+    "shows how much of the fleet honors the round's negotiated format.",
+    ("format",),
+)
+
 # backoff between restarts of a crash-looping worker: capped doubling, so a
 # deterministic crash (bad build) cannot busy-spin the event loop
 _RESTART_BACKOFF_BASE_S = 0.05
 _RESTART_BACKOFF_MAX_S = 5.0
+
+
+class RateWindow:
+    """Per-second event buckets over a short sliding window: the
+    accepted/shed *rates* for the /healthz + /statusz ingress section,
+    without scraping a metrics backend. All calls run on the event loop
+    (submit and the decrypt workers are both loop tasks), so no lock."""
+
+    def __init__(self, window_s: int = 10):
+        if window_s < 1:
+            raise ValueError("window must be >= 1s")
+        self.window_s = window_s
+        self._buckets: deque[tuple[int, int]] = deque()
+
+    def add(self, n: int = 1, now: float | None = None) -> None:
+        t = int(time.monotonic() if now is None else now)
+        if self._buckets and self._buckets[-1][0] == t:
+            self._buckets[-1] = (t, self._buckets[-1][1] + n)
+        else:
+            self._buckets.append((t, n))
+        self._trim(t)
+
+    def rate(self, now: float | None = None) -> float:
+        """Events/s averaged over the window (the current partial second
+        included — a steady source reads steady, a stopped one decays to
+        zero within ``window_s``)."""
+        t = int(time.monotonic() if now is None else now)
+        self._trim(t)
+        return sum(c for _, c in self._buckets) / float(self.window_s)
+
+    def _trim(self, t: int) -> None:
+        cutoff = t - self.window_s
+        while self._buckets and self._buckets[0][0] <= cutoff:
+            self._buckets.popleft()
 
 # phases whose tag can appear in a valid ciphertext; anything else is shed
 # before we even pay for the sealed-box open
@@ -104,6 +155,17 @@ class IngestPipeline:
             else None
         )
         self._workers: list[asyncio.Task] = []  # guarded-by: event-loop
+        # ingress accounting (guarded-by: event-loop — submit and the
+        # decrypt workers are all loop tasks): totals + short-window rates
+        # + the accepted wire-format mix, surfaced as the "ingress" section
+        # of /healthz and /statusz
+        self._accepted = 0
+        self._shed = 0
+        self._rejected = 0
+        self._wire_mix = {"packed": 0, "legacy": 0}
+        self._accepted_rate = RateWindow()
+        self._shed_rate = RateWindow()
+        self._ingress_accepted = INGRESS_ACCEPTED.labels(tenant=tenant)
 
     # --- lifecycle --------------------------------------------------------
 
@@ -172,6 +234,7 @@ class IngestPipeline:
                 # controller — this tenant is over its share even if the
                 # process as a whole has headroom
                 span.set(verdict="shed-budget")
+                self._count_shed()
                 return Admission(
                     Verdict.SHED,
                     retry_after=self.admission.retry_after(self.intake.occupancy),
@@ -181,6 +244,7 @@ class IngestPipeline:
                 if self.budget is not None:
                     self.budget.discharge(self.tenant)
                 span.set(verdict="shed")
+                self._count_shed()
                 return verdict
             try:
                 self.intake.put_nowait((request_id, time.monotonic(), encrypted))
@@ -188,6 +252,7 @@ class IngestPipeline:
                 if self.budget is not None:
                     self.budget.discharge(self.tenant)
                 span.set(verdict="shed-shard-full")
+                self._count_shed()
                 return self.admission.shed_shard_full(self.intake.occupancy)
             self.admission.count_admitted()
             span.set(verdict="admitted")
@@ -268,6 +333,7 @@ class IngestPipeline:
                     continue  # multipart chunk absorbed
                 if isinstance(res, ServiceError):
                     self.admission.count_rejection(res.stage)
+                    self._rejected += 1
                     rejected += 1
                     logger.debug(
                         "[%s] ingest worker %d: message dropped at %s: %s",
@@ -277,6 +343,7 @@ class IngestPipeline:
                         res,
                     )
                     continue
+                self._count_accepted(res)
                 req = request_from_message(res)
                 if coalescing and isinstance(req, UpdateRequest):
                     with tracing.use_request_id(request_id):
@@ -302,6 +369,39 @@ class IngestPipeline:
         except RequestError:
             self.admission.count_rejection("state-machine")
 
+    # --- ingress accounting ----------------------------------------------
+
+    def _count_shed(self) -> None:
+        self._shed += 1
+        self._shed_rate.add()
+
+    def _count_accepted(self, message) -> None:
+        """One message survived the whole intake pipeline. Update payloads
+        also book their wire element layout (the packed-vs-legacy mix)."""
+        self._accepted += 1
+        self._accepted_rate.add()
+        self._ingress_accepted.inc()
+        payload = getattr(message, "payload", None)
+        wire_planar = getattr(payload, "wire_planar", None)
+        if wire_planar is not None:
+            fmt = "packed" if wire_planar else "legacy"
+            self._wire_mix[fmt] += 1
+            INGRESS_WIRE.labels(format=fmt).inc()
+
+    def ingress_stats(self) -> dict:
+        """The ``ingress`` section of /healthz and /statusz: end-to-end
+        acceptance (not mere admission), shed pressure, per-shard intake
+        occupancy, and the accepted wire-format mix."""
+        return {
+            "accepted_total": self._accepted,
+            "accepted_per_s": round(self._accepted_rate.rate(), 2),
+            "shed_total": self._shed,
+            "shed_per_s": round(self._shed_rate.rate(), 2),
+            "rejected_total": self._rejected,
+            "shard_occupancy": [s.occupancy for s in self.intake.shards],
+            "wire": dict(self._wire_mix),
+        }
+
     # --- health -----------------------------------------------------------
 
     def health(self) -> dict:
@@ -317,6 +417,7 @@ class IngestPipeline:
             # updates buffered toward the next coalesced envelope (operators
             # watching an edge's backlog need the pre-seal depth too)
             "coalescer_pending": self.coalescer.pending if self.coalescer else 0,
+            "ingress": self.ingress_stats(),
         }
         if self.budget is not None:
             out["tenant"] = self.tenant

@@ -1,0 +1,33 @@
+"""Sim-fed load generation: million-participant ingress traffic (§21).
+
+The PR-8 in-graph simulation proved a whole PET round is a pure function
+of (config, seeds, models). This package turns that program into a
+TRAFFIC SOURCE: the population engine derives masks for thousands of
+participants per jitted call (``population``), the forge wraps each row in
+the byte-exact production message encoding — fixed-point encode, wire
+v1/v2 element layout, seed-dict sealed boxes, Ed25519 signatures, sealed
+envelope (``build``) — and the event-driven replay driver plays the
+resulting uploads against a real coordinator's REST boundary under
+churn/dropout/straggle schedules (``schedule``, ``driver``), optionally
+spread over multiple tenants and/or an edge fan-in tier, and process-
+sharded for scale (``runner``).
+
+Everything is deterministic per seed: a loadgen round and a
+participant-state-machine control round produce byte-identical global
+models (asserted by ``tools/loadgen_soak.py``).
+"""
+
+from .build import UpdateForge, forge_population
+from .driver import DriverStats, ReplayDriver
+from .population import PopulationEngine
+from .schedule import ChurnSpec, ReplaySchedule
+
+__all__ = [
+    "ChurnSpec",
+    "DriverStats",
+    "PopulationEngine",
+    "ReplayDriver",
+    "ReplaySchedule",
+    "UpdateForge",
+    "forge_population",
+]

@@ -1,0 +1,131 @@
+"""The population engine: blocks of valid masked updates from ONE jitted call.
+
+A production update participant computes ``masked = encode(model) +
+derive_mask(seed)`` in the group. The engine runs exactly that — the PR-8
+in-graph ChaCha mask derivation (``ops.masking_jax.derive_mask_ingraph``,
+byte-identical to the host ``MaskSeed.derive_mask``) plus the production
+fixed-point encode (``encode_models_batch``) — vmapped over a block of
+participants, so one compiled program emits thousands of *valid* masked
+updates per call instead of one ``Masker.mask`` per participant on the
+host. The output rows are ordinary ``uint32`` limb tensors; the forge
+(``loadgen.build``) runs them through the production serialization, so
+the wire bytes are what a real SDK would have sent for the same
+(seed, model, scalar) — byte-correct traffic, not fuzz.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.mask.config import MaskConfigPair
+from ..ops import limbs as host_limbs, limbs_jax
+from ..ops.masking_jax import (
+    derive_chunk_budgets,
+    derive_mask_ingraph,
+    encode_models_batch,
+    seed_words,
+)
+from ..telemetry import profiling
+
+
+class PopulationEngine:
+    """One compiled masked-update generator for a fixed (config, length).
+
+    ``emit(seeds, weights, scalar)`` returns the whole population's masked
+    vect/unit limbs; internally the population is processed in
+    ``block_size`` lanes per program call (device memory ~ block_size x
+    keystream chunk, the same provisioning rule as the sim), and every
+    call after the first reuses the compiled program.
+    """
+
+    def __init__(self, config: MaskConfigPair, model_length: int, block_size: int = 512):
+        if model_length < 1:
+            raise ValueError("model_length must be >= 1")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.config = config
+        self.model_length = model_length
+        self.block_size = block_size
+        self._ol_v = np.asarray(host_limbs.order_limbs_for(config.vect.order), np.uint32)
+        self._ol_u = np.asarray(host_limbs.order_limbs_for(config.unit.order), np.uint32)
+        unit_chunk, vect_chunk = derive_chunk_budgets(model_length, config, block_size)
+        n = model_length
+
+        def _one(kw):
+            return derive_mask_ingraph(kw, n, config, unit_chunk, vect_chunk)
+
+        ol_v, ol_u = self._ol_v, self._ol_u
+
+        def _block(kw, enc, unit_enc):
+            """One participant block: derive + mask. ``kw`` uint32[B, 8]
+            seed words, ``enc`` uint32[B, n, L] encoded models,
+            ``unit_enc`` uint32[L1] (shared — homogeneous scalar)."""
+            units, vects = jax.vmap(_one)(kw)
+            masked = limbs_jax.mod_add(enc, vects, ol_v)
+            unit_b = jnp.broadcast_to(unit_enc, units.shape)
+            masked_units = limbs_jax.mod_add(unit_b, units, ol_u)
+            return masked, masked_units
+
+        self._program = jax.jit(_block)
+        self.program_calls = 0  # one per BLOCK, never per participant
+
+    def emit(
+        self,
+        seeds: list[bytes] | np.ndarray,
+        weights: np.ndarray,
+        scalar: Fraction = Fraction(1),
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Masked updates for the whole population.
+
+        ``seeds`` are 32-byte mask seeds (or ``uint32[P, 8]`` key words),
+        ``weights`` the ``[P, model_length]`` local models; every
+        participant shares ``scalar`` (the homogeneous-population shape a
+        soak uses: ``1/P``). Returns ``(masked_vects uint32[P, n, L],
+        masked_units uint32[P, L1])`` — the exact limbs ``Masker.mask``
+        would produce per participant.
+        """
+        if isinstance(seeds, np.ndarray):
+            kw = np.asarray(seeds, dtype=np.uint32)
+        else:
+            kw = seed_words(list(seeds))
+        if kw.ndim != 2 or kw.shape[1] != 8:
+            raise ValueError("seeds must be 32-byte strings or uint32[P, 8] key words")
+        p = kw.shape[0]
+        if p < 1:
+            raise ValueError("need at least one participant")
+        weights = np.asarray(weights)
+        if weights.shape != (p, self.model_length):
+            raise ValueError(
+                f"weights must be [{p}, {self.model_length}], got {weights.shape}"
+            )
+        unit_enc, enc = encode_models_batch(weights, scalar, self.config)
+        out_v = np.empty_like(enc)
+        out_u = np.empty((p, unit_enc.shape[-1]), dtype=np.uint32)
+        block = self.block_size
+        for start in range(0, p, block):
+            kw_b = kw[start : start + block]
+            enc_b = enc[start : start + block]
+            pad = block - kw_b.shape[0]
+            if pad:
+                # the compiled program has one static block shape; the tail
+                # block pads with zero lanes and slices them off below
+                kw_b = np.concatenate([kw_b, np.zeros((pad, 8), np.uint32)])
+                enc_b = np.concatenate(
+                    [enc_b, np.zeros((pad, *enc_b.shape[1:]), np.uint32)]
+                )
+            masked, masked_units = profiling.timed_kernel(
+                "loadgen_emit",
+                kw_b.shape[0] * self.model_length,
+                lambda kw_b=kw_b, enc_b=enc_b: self._program(
+                    jnp.asarray(kw_b), jnp.asarray(enc_b), jnp.asarray(unit_enc)
+                ),
+            )
+            self.program_calls += 1
+            stop = min(start + block, p)
+            out_v[start:stop] = np.asarray(masked)[: stop - start]
+            out_u[start:stop] = np.asarray(masked_units)[: stop - start]
+        return out_v, out_u

@@ -51,6 +51,20 @@ BYTES_REDUCED = get_registry().counter(
     ("path",),
 )
 
+# same family streaming.py registers for its ring staging (the registry
+# dedupes by name): the wire-ingest staging uploads are accounted here so
+# the ingress bench can read bytes-moved-per-accepted-update straight off
+# /metrics — "wire" = v1 interleaved element blocks, "wire-planar" = v2
+# byte-planar blocks that stay packed through the fold (docs/DESIGN.md §21)
+BYTES_STAGED = get_registry().counter(
+    "xaynet_bytes_staged_total",
+    "Bytes copied into host staging rings (and later across host->device), "
+    "by layout: packed = byte-planar wire-width planes, unpacked = full "
+    "uint32 limb planes, wire = raw serialized element blocks, "
+    "wire-planar = v2 byte-planar element blocks staged packed.",
+    ("layout",),
+)
+
 _unmask_kernel = jax.jit(p_mod_sub, static_argnames=("order",))
 
 
@@ -103,6 +117,29 @@ def _build_wire_unpack(bpn: int, order: int, multi_device: bool):
         return planar, ok
 
     return unpack_mask
+
+
+def _build_planar_ok(n_limbs: int, order: int, multi_device: bool):
+    """Wire-v2 twin of ``_build_wire_unpack``, validity only: the input is
+    already the byte-planar ``uint8[K, bpn, n]`` packed layout
+    (serialization.py ``WIRE_PLANAR_FLAG``), so limb assembly reads
+    contiguous planes (``limbs_jax.packed_planar_to_limbs``) — and only
+    *transiently*, inside this jit. The caller keeps the packed bytes as
+    the staged representation; no resident uint32 planar exists on the v2
+    path until the fused packed fold. Same per-update validity + psum
+    exclusion semantics as v1.
+    """
+    from ..ops import limbs_jax
+
+    def check(raw):
+        planar = limbs_jax.packed_planar_to_limbs(raw, n_limbs)
+        ok = limbs_jax.planar_all_lt_const(planar, order)  # per update
+        if multi_device:
+            bad = jax.lax.psum((~ok).astype(jnp.uint32), MODEL_AXIS)
+            ok = bad == jnp.uint32(0)
+        return ok
+
+    return check
 
 
 def _sharded_native_fan_out(
@@ -366,6 +403,7 @@ class ShardedAggregator:
             raise ValueError("batch too large for lazy-carry fold")
         if self.padded_length != self.model_length:
             raw = np.pad(raw, ((0, 0), (0, (self.padded_length - self.model_length) * bpn)))
+        BYTES_STAGED.labels(layout="wire").inc(raw.nbytes)
         return jax.device_put(raw, self._batch_bytes_sharding)
 
     def add_wire_batch(self, raw: np.ndarray) -> np.ndarray:
@@ -433,6 +471,60 @@ class ShardedAggregator:
         )
         ok_host = np.asarray(ok)
         return [planar[i] if ok_host[i] else None for i in range(k)]
+
+    def validate_planar_update(self, raw: np.ndarray):
+        """Wire-v2: validity-check ONE byte-planar update
+        (``uint8[bpn, model_len]``, the serialized planar element block
+        viewed 2-D) on device. Same contract as ``validate_wire_update``,
+        except the accepted row stays PACKED (``uint8[bpn, padded_len]``) —
+        the uint32 limb expansion only ever happens transiently inside the
+        validity/fold jits, never as a resident buffer."""
+        raw = np.asarray(raw)
+        if raw.ndim != 2:
+            raise ValueError("expected uint8[bytes_per_number, model_len]")
+        return self.validate_planar_updates([raw])[0]
+
+    def validate_planar_updates(self, raws) -> list:
+        """Wire-v2 twin of ``validate_wire_updates``: one staged upload +
+        validity dispatch + acceptance fetch for a group of byte-planar
+        element blocks. The upload IS the packed staging layout — no byte
+        gather on either side of the transfer — and the returned rows are
+        the staged PACKED device slices (``uint8[bpn, padded_len]``), so an
+        accepted v2 update occupies ``bpn`` bytes/element until the packed
+        fold consumes it, where the v1 path parks a ``4L``-byte planar.
+        ``None`` marks members with an element >= the group order.
+        """
+        if not raws:
+            return []
+        bpn = self.config.bytes_per_number
+        block = np.stack([np.asarray(r) for r in raws])
+        if block.dtype != np.uint8 or block.ndim != 3 or block.shape[1:] != (
+            bpn,
+            self.model_length,
+        ):
+            raise ValueError("expected uint8[K, bytes_per_number, model_len]")
+        if self.padded_length != self.model_length:
+            block = np.pad(
+                block, ((0, 0), (0, 0), (0, self.padded_length - self.model_length))
+            )
+        # same power-of-two bucketing as the v1 path (ragged coalescer
+        # groups must not recompile the unpack mid-round); zero planes
+        # decode to zero elements, valid and sliced off below
+        k = len(raws)
+        bucket = min(1 << max(0, k - 1).bit_length(), MAX_LAZY_BATCH)
+        if bucket > k:
+            block = np.concatenate(
+                [block, np.zeros((bucket - k, *block.shape[1:]), dtype=block.dtype)]
+            )
+        BYTES_STAGED.labels(layout="wire-planar").inc(block.nbytes)
+        staged = jax.device_put(block, self._batch_packed_sharding)
+        ok = profiling.timed_kernel(
+            "wire_unpack",
+            staged.shape[0] * self.padded_length,
+            lambda: self._make_planar_ok_fn()(staged),
+        )
+        ok_host = np.asarray(ok)
+        return [staged[i] if ok_host[i] else None for i in range(k)]
 
     def dispatch_staged_bytes(self, staged):
         """Unpack + validity + fold a staged raw-byte batch WITHOUT syncing
@@ -745,6 +837,30 @@ class ShardedAggregator:
             )
         else:
             fn = jax.jit(unpack_mask)
+        _FOLD_FN_CACHE[key] = fn
+        return fn
+
+    def _make_planar_ok_fn(self):
+        """Device planar (wire-v2) validity callable, memoized process-wide
+        (same identity-caching rationale as ``_make_unpack_fn``). Output is
+        only ``ok[K]`` — the staged packed bytes themselves are the result."""
+        key = ("planar-ok", _mesh_key(self.mesh), self.n_limbs, self.order)
+        fn = _FOLD_FN_CACHE.get(key)
+        if fn is not None:
+            return fn
+        multi = self.mesh.devices.size > 1
+        check = _build_planar_ok(self.n_limbs, self.order, multi)
+        if multi:
+            fn = jax.jit(
+                _shard_map(
+                    check,
+                    mesh=self.mesh,
+                    in_specs=(P(None, None, MODEL_AXIS),),
+                    out_specs=P(),
+                )
+            )
+        else:
+            fn = jax.jit(check)
         _FOLD_FN_CACHE[key] = fn
         return fn
 

@@ -84,7 +84,10 @@ from ..telemetry.recorder import flight_dump
 from ..telemetry.registry import get_registry
 from ..tenancy.pool import get_pool
 from ..tenancy.scheduler import get_scheduler
-from .aggregator import ShardedAggregator
+# BYTES_STAGED: one module owns the xaynet_bytes_staged_total family —
+# aggregator.py registers it (wire-ingest staging accounts there too) and
+# the streaming rings account through the shared symbol
+from .aggregator import BYTES_STAGED, ShardedAggregator
 
 logger = logging.getLogger(__name__)
 
@@ -140,14 +143,6 @@ SHARD_OVERLAP = _registry.gauge(
     "that ran concurrently with the other leg during the last drain window.",
     ("shard",),
 )
-BYTES_STAGED = _registry.counter(
-    "xaynet_bytes_staged_total",
-    "Bytes copied into host staging rings (and later across host->device), "
-    "by layout: packed = byte-planar wire-width planes, unpacked = full "
-    "uint32 limb planes, wire = raw serialized element blocks.",
-    ("layout",),
-)
-
 _SHUTDOWN = object()
 
 
@@ -679,6 +674,54 @@ class StreamingAggregator:
             self._slot_acquire()
             try:
                 agg.acc = agg._fold(agg.acc, staged)
+            finally:
+                self._slot_release()
+            with self._lock:
+                agg.nb_models += n_piece
+
+    def fold_packed_rows_now(self, rows: list) -> None:
+        """Fold already device-resident, validity-checked PACKED byte-planar
+        ``uint8[bpn, padded_len]`` updates on the CALLER's thread — the
+        wire-v2 ingest path (``validate_planar_update(s)`` keeps accepted
+        rows in their staged packed layout, ``bpn`` bytes/element instead
+        of the ``4L`` a resident uint32 planar would pin). Same
+        no-queueing rationale and accounting as
+        :meth:`fold_planar_rows_now`; the fold itself is the fused packed
+        kernel (``agg._fold_packed``), so the uint32 expansion only ever
+        exists transiently inside the jit. In shard-parallel mode the rows
+        are unpacked on device (still no host materialization) and folded
+        through the per-shard planar fan-out."""
+        if not rows:
+            return
+        if self._sharded:
+            from ..ops.limbs_jax import packed_planar_to_limbs_jit
+
+            n_limbs = self.agg.n_limbs
+            return self._fold_planar_rows_now_sharded(
+                [packed_planar_to_limbs_jit(r, n_limbs) for r in rows]
+            )
+        self._queue.join()
+        err = self._poisoned()
+        if err is not None:
+            raise self._poison_error() from err
+        if self._closed:
+            raise StreamingError("pipeline is closed")
+        import jax
+        import jax.numpy as jnp
+
+        agg = self.agg
+        rows = list(rows)
+        while rows:
+            piece, rows = rows[:8], rows[8:]
+            staged = jax.device_put(jnp.stack(piece), agg._batch_packed_sharding)
+            n_piece = len(piece)
+            del piece
+            # the packed fold never drives kernel auto-calibration (see
+            # agg._fold_packed) — resolve on the cheap path first
+            agg._resolve_kernel_cheap(n_piece)
+            self._slot_acquire()
+            try:
+                agg.acc = agg._fold_packed(agg.acc, staged)
             finally:
                 self._slot_release()
             with self._lock:

@@ -371,12 +371,20 @@ class HttpClient(XaynetClient):
         return {bytes.fromhex(k): bytes.fromhex(v) for k, v in raw.items()}
 
     async def get_seeds(self, pk: bytes) -> Optional[UpdateSeedDict]:
-        from ..core.mask.seed import EncryptedMaskSeed
+        from ..core.mask.seed import EncryptedMaskSeed, unpack_seed_entries
 
-        status, headers, body = await self._request("GET", f"/seeds?pk={pk.hex()}")
+        # request the batched binary fan-out (§21: 112 B/entry fixed
+        # frames); a pre-v2 coordinator ignores the fmt param and answers
+        # JSON — dispatch on the response content type, so either end can
+        # be upgraded first
+        status, headers, body = await self._request(
+            "GET", f"/seeds?pk={pk.hex()}&fmt=bin"
+        )
         if status == 204:
             return None
         self._raise_for_status(status, headers, "GET /seeds")
+        if headers.get("content-type", "").startswith("application/octet-stream"):
+            return unpack_seed_entries(body)
         raw = json.loads(body.decode())
         return {bytes.fromhex(k): EncryptedMaskSeed(bytes.fromhex(v)) for k, v in raw.items()}
 

@@ -62,15 +62,20 @@ def build_update_message(
     sum_dict: dict,
     model,
     scalar: Fraction = Fraction(1),
+    wire_planar: Optional[bool] = None,
 ) -> bytes:
     """One fully valid, sealed update upload for an update-task participant.
 
     The exact client-side pipeline (mask -> seed-dict encrypt -> sign ->
     sealed box) without the participant state machine around it — what a
-    load generator needs.
+    load generator needs. ``wire_planar=None`` follows the round's
+    negotiated wire format (``params.wire_format``); an explicit bool
+    forces the v2 planar / v1 interleaved element layout.
     """
     masker = Masker(params.mask_config)
     seed, masked_model = masker.mask(Scalar.from_fraction(scalar), np.asarray(model))
+    if wire_planar is None:
+        wire_planar = params.wire_format >= 2
     payload = Update(
         sum_signature=keys.sign(params.seed.as_bytes() + b"sum").as_bytes(),
         update_signature=keys.sign(params.seed.as_bytes() + b"update").as_bytes(),
@@ -79,6 +84,7 @@ def build_update_message(
             sum_pk: seed.encrypt(PublicEncryptKey(ephm_pk))
             for sum_pk, ephm_pk in sum_dict.items()
         },
+        wire_planar=wire_planar,
     )
     message = Message(participant_pk=keys.public, coordinator_pk=params.pk, payload=payload)
     return PublicEncryptKey(params.pk).encrypt(message.to_bytes(keys.secret))

@@ -351,6 +351,8 @@ class StateMachine:
             update_signature=self.update_signature,
             masked_model=masked_model,
             local_seed_dict=local_seed_dict,
+            # honor the round's negotiated upload format (wire v2 planar)
+            wire_planar=self.round_params.wire_format >= 2,
         )
         return await self._send(payload, PhaseKind.AWAITING)
 

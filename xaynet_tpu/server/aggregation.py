@@ -235,7 +235,12 @@ class StagedAggregator:
             # only un-prevalidated updates pay the per-update sync here.
             planar = vect._staged_planar
             if planar is None and not vect._wire_invalid:
-                planar = self._device.validate_wire_update(np.asarray(vect.wire_block))
+                if vect.planar:
+                    # wire v2: the body is already the packed byte-planar
+                    # layout — uploaded as-is, no byte gather either side
+                    planar = self._device.validate_planar_update(vect.planar_block)
+                else:
+                    planar = self._device.validate_wire_update(np.asarray(vect.wire_block))
             if planar is None or not obj.unit.is_valid():
                 raise AggregationError("InvalidObject")
             vect._staged_planar = planar
@@ -270,16 +275,26 @@ class StagedAggregator:
             and obj.vect.config == self.config.vect
             and np.asarray(obj.vect.wire_block).size == want_bytes
         ]
-        for start in range(0, len(lazies), self.batch_size):
-            chunk = lazies[start : start + self.batch_size]
-            planars = self._device.validate_wire_updates(
-                [np.asarray(v.wire_block) for v in chunk]
-            )
-            for vect, planar in zip(chunk, planars):
-                if planar is None:
-                    vect._wire_invalid = True
+        # v1 (interleaved) and v2 (planar) members batch separately — the
+        # two unpack programs take different layouts — but a mixed group
+        # still validates in at most two device round-trips
+        for planar_wire in (False, True):
+            group = [v for v in lazies if v.planar is planar_wire]
+            for start in range(0, len(group), self.batch_size):
+                chunk = group[start : start + self.batch_size]
+                if planar_wire:
+                    planars = self._device.validate_planar_updates(
+                        [v.planar_block for v in chunk]
+                    )
                 else:
-                    vect._staged_planar = planar
+                    planars = self._device.validate_wire_updates(
+                        [np.asarray(v.wire_block) for v in chunk]
+                    )
+                for vect, planar in zip(chunk, planars):
+                    if planar is None:
+                        vect._wire_invalid = True
+                    else:
+                        vect._staged_planar = planar
 
     def validate_partial(self, obj: MaskObject, members: int) -> None:
         """Protocol validation for an edge PARTIAL aggregate of ``members``
@@ -394,7 +409,22 @@ class StagedAggregator:
 
             parts = [p.result() if hasattr(p, "result") else p for p in self._staged_vect]
             self._staged_vect.clear()  # consume destructively: free as we fold
-            if all(isinstance(p, jax.Array) for p in parts):
+            # wire-v2 members stay PACKED uint8[bpn, padded] through staging
+            # (bpn bytes/element vs the 4L a uint32 planar pins) and fold
+            # through the fused packed kernel; a mixed round therefore
+            # splits one flush by staged layout
+            packed_rows = [
+                p for p in parts if isinstance(p, jax.Array) and p.dtype == "uint8"
+            ]
+            parts = [
+                p for p in parts if not (isinstance(p, jax.Array) and p.dtype == "uint8")
+            ]
+            if packed_rows:
+                self._stream.fold_packed_rows_now(packed_rows)
+                packed_rows.clear()
+            if not parts:
+                pass
+            elif all(isinstance(p, jax.Array) for p in parts):
                 # wire ingest: every planar is already device-resident and
                 # validity-checked — folded INLINE (not queued: parking
                 # device-resident batches behind dispatch_ahead would pin

@@ -230,6 +230,46 @@ def _streaming_section(server) -> str:
     return out
 
 
+def _ingress_section(server) -> str:
+    """Coordinator-ingress state (§21): per-tenant accepted/shed rates,
+    intake shard occupancy and the accepted wire-format mix, read straight
+    off each tenant's ingest pipeline; empty when no pipeline is wired
+    (direct-handler deployments)."""
+    routes_by_tenant = {"default": server._default_routes, **server.tenants}
+    rows = []
+    for tenant in sorted(routes_by_tenant):
+        pipeline = getattr(routes_by_tenant[tenant], "pipeline", None)
+        if pipeline is None:
+            continue
+        stats = pipeline.ingress_stats()
+        wire = stats["wire"]
+        occupancy = stats["shard_occupancy"]
+        rows.append(
+            "<tr><td>{t}</td><td>{aps:.1f}/s</td><td>{at}</td>"
+            "<td>{sps:.1f}/s</td><td>{st}</td><td>{rt}</td>"
+            "<td>{occ}</td><td>{pk} / {lg}</td></tr>".format(
+                t=_esc(tenant),
+                aps=stats["accepted_per_s"],
+                at=_esc(stats["accepted_total"]),
+                sps=stats["shed_per_s"],
+                st=_esc(stats["shed_total"]),
+                rt=_esc(stats["rejected_total"]),
+                occ=_esc(" ".join(str(o) for o in occupancy)),
+                pk=_esc(wire.get("packed", 0)),
+                lg=_esc(wire.get("legacy", 0)),
+            )
+        )
+    if not rows:
+        return ""
+    return (
+        "<h2>ingress</h2>"
+        "<table><tr><th>tenant</th><th>accepted/s</th><th>accepted</th>"
+        "<th>shed/s</th><th>shed</th><th>rejected</th>"
+        "<th>shard occupancy</th><th>wire packed/legacy</th></tr>"
+        "{rows}</table>".format(rows="".join(rows))
+    )
+
+
 def _alerts_section() -> str:
     """Active alerts banner + the recent-transition ring, newest first."""
     engine = get_engine()
@@ -302,6 +342,7 @@ def render_statusz(server) -> str:
     ]
     for tenant in tenant_labels:
         sections.append(_decomposition_section(tenant))
+    sections.append(_ingress_section(server))
     sections.append(_pool_section(server))
     sections.append(_streaming_section(server))
     return (

@@ -35,6 +35,7 @@ class CoordinatorState:
                 seed=RoundSeed.zeroed(),
                 mask_config=mask_config,
                 model_length=settings.model.length,
+                wire_format=2 if settings.ingest.wire_format == "packed" else 1,
             ),
         )
 

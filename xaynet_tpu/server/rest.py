@@ -268,6 +268,13 @@ class RestServer:
                 seeds = routes.fetcher.seeds_for(bytes.fromhex(pk_hex))
                 if seeds is None:
                     return 204, b"", "text/plain"
+                if (qs.get("fmt") or [""])[0] == "bin":
+                    # batched binary fan-out (§21): 112 B/entry fixed
+                    # frames, ~half the bytes of the hex-JSON shape — the
+                    # response the loadgen fleet and new SDKs request
+                    from ..core.mask.seed import pack_seed_entries
+
+                    return 200, pack_seed_entries(seeds), "application/octet-stream"
                 return (
                     200,
                     json.dumps({k.hex(): v.as_bytes().hex() for k, v in seeds.items()}).encode(),
@@ -304,6 +311,9 @@ class RestServer:
                 payload["uptime_seconds"] = round(time.monotonic() - self._started_at, 3)
                 if routes.pipeline is not None:
                     ingest = routes.pipeline.health()
+                    # the ingress boundary gets its own top-level section
+                    # (§21): acceptance rates, shard occupancy, wire mix
+                    payload["ingress"] = ingest.pop("ingress", None)
                     payload["ingest"] = ingest
                     if ingest["saturated"]:
                         payload["status"] = "saturated"
@@ -325,7 +335,9 @@ class RestServer:
                 model = routes.fetcher.model()
                 if model is None:
                     return 204, b"", "text/plain"
-                return 200, np.asarray(model, dtype=np.float64).tobytes(), "application/octet-stream"
+                # model DOWNLOAD response, not a request body
+                body = np.asarray(model, np.float64).tobytes()  # lint: wirecopy-ok
+                return 200, body, "application/octet-stream"
             return 404, b"not found", "text/plain"
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)

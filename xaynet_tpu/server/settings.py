@@ -263,8 +263,16 @@ class IngestSettings:
     coalesce_linger_ms: float = 2.0
     # Retry-After floor handed to shed clients (seconds)
     retry_after_seconds: float = 1.0
+    # upload wire format advertised in the round params: "legacy" keeps the
+    # v1 interleaved element blocks, "packed" advertises the v2 byte-planar
+    # layout (core.mask.serialization.WIRE_PLANAR_FLAG). The server parse
+    # auto-detects per message, so either setting ACCEPTS both formats —
+    # this only steers what well-behaved participants send.
+    wire_format: str = "legacy"
 
     def validate(self) -> None:
+        if self.wire_format not in ("legacy", "packed"):
+            raise SettingsError("ingest.wire_format must be legacy | packed")
         if self.shards < 1:
             raise SettingsError("ingest.shards must be >= 1")
         if self.queue_bound < 1:
@@ -279,6 +287,46 @@ class IngestSettings:
             raise SettingsError("ingest linger must be >= 0")
         if self.retry_after_seconds <= 0:
             raise SettingsError("ingest.retry_after_seconds must be > 0")
+
+
+@dataclass
+class LoadgenSettings:
+    """Sim-fed load generation (``xaynet_tpu.loadgen``, docs/DESIGN.md §21).
+
+    Consumed by the loadgen runner / bench harness, not the coordinator —
+    it lives in the same TOML so one config file describes a whole soak
+    (coordinator + traffic source), like ``[edge]`` does for the edge tier.
+    """
+
+    participants: int = 2000  # simulated update participants per round
+    drivers: int = 1  # process-sharded replay drivers (participant ranges)
+    block_size: int = 512  # participants per jitted population block
+    tenants: str = ""  # csv tenant ids to spread across ("" = root routes)
+    wire: str = "auto"  # auto (follow round params) | packed | legacy
+    sum_participants: int = 1  # seed-dict width (sum-task population)
+    dropout_rate: float = 0.0  # fraction that never uploads
+    stragglers: int = 0  # participants delayed by straggle_delay_ms
+    straggle_delay_ms: float = 0.0
+    concurrency: int = 64  # in-flight uploads per driver
+    seed: int = 1  # churn/arrival schedule seed
+
+    def validate(self) -> None:
+        if self.participants < 1:
+            raise SettingsError("loadgen.participants must be >= 1")
+        if self.drivers < 1:
+            raise SettingsError("loadgen.drivers must be >= 1")
+        if self.block_size < 1:
+            raise SettingsError("loadgen.block_size must be >= 1")
+        if self.wire not in ("auto", "packed", "legacy"):
+            raise SettingsError("loadgen.wire must be auto | packed | legacy")
+        if self.sum_participants < 1:
+            raise SettingsError("loadgen.sum_participants must be >= 1")
+        if not (0.0 <= self.dropout_rate < 1.0):
+            raise SettingsError("loadgen.dropout_rate must be in [0, 1)")
+        if self.stragglers < 0 or self.straggle_delay_ms < 0:
+            raise SettingsError("loadgen straggler settings must be >= 0")
+        if self.concurrency < 1:
+            raise SettingsError("loadgen.concurrency must be >= 1")
 
 
 @dataclass
@@ -574,6 +622,7 @@ class Settings:
     edge: EdgeSettings = field(default_factory=EdgeSettings)
     tenancy: TenancySettings = field(default_factory=TenancySettings)
     slo: SloSettings = field(default_factory=SloSettings)
+    loadgen: LoadgenSettings = field(default_factory=LoadgenSettings)
 
     def validate(self) -> None:
         self.pet.validate()
@@ -585,6 +634,7 @@ class Settings:
         except ValueError as e:
             raise SettingsError(f"mask.quant: {e}") from e
         self.ingest.validate()
+        self.loadgen.validate()
         self.resilience.validate()
         self.liveness.validate()
         self.edge.validate()
@@ -699,6 +749,8 @@ class Settings:
         ten_base = base.tenancy
         slo_raw = raw.get("slo", {})
         slo_base = base.slo
+        lg_raw = raw.get("loadgen", {})
+        lg_base = base.loadgen
 
         return cls(
             pet=PetSettings(
@@ -790,6 +842,9 @@ class Settings:
                 ),
                 retry_after_seconds=float(
                     ingest_raw.get("retry_after_seconds", base.ingest.retry_after_seconds)
+                ),
+                wire_format=str(
+                    ingest_raw.get("wire_format", base.ingest.wire_format)
                 ),
             ),
             resilience=ResilienceSettings(
@@ -892,6 +947,23 @@ class Settings:
                 ),
                 warn_burn=float(slo_raw.get("warn_burn", slo_base.warn_burn)),
                 page_burn=float(slo_raw.get("page_burn", slo_base.page_burn)),
+            ),
+            loadgen=LoadgenSettings(
+                participants=int(lg_raw.get("participants", lg_base.participants)),
+                drivers=int(lg_raw.get("drivers", lg_base.drivers)),
+                block_size=int(lg_raw.get("block_size", lg_base.block_size)),
+                tenants=str(lg_raw.get("tenants", lg_base.tenants)),
+                wire=str(lg_raw.get("wire", lg_base.wire)),
+                sum_participants=int(
+                    lg_raw.get("sum_participants", lg_base.sum_participants)
+                ),
+                dropout_rate=float(lg_raw.get("dropout_rate", lg_base.dropout_rate)),
+                stragglers=int(lg_raw.get("stragglers", lg_base.stragglers)),
+                straggle_delay_ms=float(
+                    lg_raw.get("straggle_delay_ms", lg_base.straggle_delay_ms)
+                ),
+                concurrency=int(lg_raw.get("concurrency", lg_base.concurrency)),
+                seed=int(lg_raw.get("seed", lg_base.seed)),
             ),
         )
 
