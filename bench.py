@@ -648,6 +648,8 @@ def main() -> None:
                                 "(in-graph federated round)"
                             ),
                             "platform": platform,
+                            # rate series split on host core count (gate)
+                            "cpus": os.cpu_count(),
                             **leg,
                         },
                     }
@@ -792,6 +794,7 @@ def main() -> None:
                     "model_len": model_len,
                     "native_threads": native_threads,
                     "shard_threads": shard_threads,
+                    "cpus": os.cpu_count(),
                     "tenants": multi_tenant_out["tenants"],
                     "model_lens": multi_tenant_out["model_lens"],
                     "fairness": multi_tenant_out["fairness"],
@@ -824,6 +827,7 @@ def main() -> None:
                     "model_len": model_len,
                     "native_threads": native_threads,
                     "shard_threads": shard_threads,
+                    "cpus": os.cpu_count(),
                     "spread": mesh8_out["spread"],
                 },
             }

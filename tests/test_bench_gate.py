@@ -320,3 +320,73 @@ def test_bytes_family_unit_inferred_and_gated_by_default(tmp_path):
         ],
     )
     assert _run(path) == 1
+
+
+# --- host core count in the series fingerprint (PR 18) ----------------------
+
+WALL_METRIC = "round wall @25000000 params"
+
+
+def test_gate_cpu_count_change_starts_new_rate_series(tmp_path, capsys):
+    """A 1-cpu container re-measuring a 4-cpu record is the BENCH_r05
+    thread-shift incident in hardware form: the rate series must split on
+    the recorded core count instead of flagging a regression."""
+    path = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 45.0, kernel="host", cpus=4),
+            _cfg_rec(2, 44.0, kernel="host", cpus=4),
+            _cfg_rec(3, 23.0, kernel="host", cpus=1),
+        ],
+    )
+    assert _run(path) == 0
+    assert "NEW series" in capsys.readouterr().err
+
+
+def test_gate_still_fails_within_one_cpu_series(tmp_path, capsys):
+    path = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 45.0, kernel="host", cpus=4),
+            _cfg_rec(2, 23.0, kernel="host", cpus=4),
+        ],
+    )
+    assert _run(path) == 1
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "cpus=4" in verdict["config"]
+
+
+def test_gate_legacy_records_without_cpus_keep_their_series(tmp_path):
+    # older writers never recorded cpus: their series fingerprints (and
+    # regressions) must be unaffected by the new field
+    path = _write(
+        tmp_path,
+        [_cfg_rec(1, 45.0, kernel="host"), _cfg_rec(2, 23.0, kernel="host")],
+    )
+    assert _run(path) == 1
+
+
+def test_gate_round_wall_splits_on_cpu_count_too(tmp_path, capsys):
+    """Walls scale with cores exactly like rates: a wall measured on a
+    different core count starts a NEW s/round series (soft pass), while a
+    regression within one core count still fails with the inverted floor."""
+    moved = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 60.0, metric=WALL_METRIC, unit="s/round", kernel="host", cpus=4),
+            _cfg_rec(2, 90.0, metric=WALL_METRIC, unit="s/round", kernel="host", cpus=1),
+        ],
+    )
+    assert _run(moved, "--metric-prefix", "round wall") == 0
+    assert "NEW series" in capsys.readouterr().err
+    same_box = _write(
+        tmp_path,
+        [
+            _cfg_rec(1, 60.0, metric=WALL_METRIC, unit="s/round", kernel="host", cpus=1),
+            _cfg_rec(2, 90.0, metric=WALL_METRIC, unit="s/round", kernel="host", cpus=1),
+        ],
+    )
+    assert _run(same_box, "--metric-prefix", "round wall") == 1
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["direction"] == "lower-is-better"
+    assert verdict["best_prior"] == 60.0

@@ -283,6 +283,14 @@ def test_unmask_phase_uses_inplace_view_without_double_timing(monkeypatch):
         pass
 
     phase.shared = _Shared()
+    # next() consults [overlap]: pin the serial path — this test asserts
+    # the drain-time in-place view contract, not the §22 eager engine
+    from xaynet_tpu.server.settings import OverlapSettings
+
+    class _SettingsStub:
+        overlap = OverlapSettings(enabled=False)
+
+    phase.shared.settings = _SettingsStub()
 
     async def drive():
         from xaynet_tpu.server.aggregation import DeviceAggregation

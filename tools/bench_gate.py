@@ -126,6 +126,14 @@ def extract(record: dict) -> tuple[str, float, str, str] | None:
                 "drivers",
                 "tenants",
                 "wire",
+                # host core count: a 1-cpu container re-measuring a 4-cpu
+                # record is the BENCH_r05 thread-shift incident in hardware
+                # form — walls and rates alike scale with the cores the
+                # kernels thread across, so a cpus change is a different
+                # experiment, not a regression. Absent from every older
+                # writer's records, so existing series keep their
+                # fingerprints (the drivers/tenants/wire precedent).
+                "cpus",
             ):
                 if node.get(field) is not None:
                     parts.append(f"{field}={node[field]}")

@@ -560,6 +560,7 @@ def append_history(result: dict, args) -> None:
         "value": head["accepted_per_s"],
         "unit": "updates/s",
         "platform": "cpu",
+        "cpus": os.cpu_count(),
         "participants": head["participants"],
         "drivers": head["drivers"],
         "tenants": head["tenants"],

@@ -241,6 +241,125 @@ def slo_check(
     return problems
 
 
+def _merge(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """Sorted union of half-open intervals (the timeline fold's idiom)."""
+    merged: list[tuple[float, float]] = []
+    for lo, hi in sorted(intervals):
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def _length(intervals: list[tuple[float, float]]) -> float:
+    return sum(hi - lo for lo, hi in intervals)
+
+
+# the identity re-derivation below re-does the in-process fold's float
+# arithmetic from 0.1 us-quantized trace timestamps; a few microseconds
+# per contributing span of drift is quantization, anything more is a bug
+_IDENTITY_TOL_S = 1e-3
+
+_WORK_PHASES = ("sum", "update", "sum2", "unmask")
+
+
+def overlap_report(events: list[dict]) -> tuple[str, list[str]]:
+    """Cross-phase concurrency lanes + the timeline identity assertion
+    (docs/DESIGN.md §22). Each ``overlap.*`` span carries a ``phase``
+    attribute naming its HOME phase (whose work it is); merging it into
+    that phase's interval set makes phases genuinely intersect, and the
+    identity ``sum(phase walls) − overlap + gap == wall`` must still
+    balance — with the overlap engines on, wall < sum of phase walls
+    (negative slack) is the measured win, not an accounting error."""
+    problems: list[str] = []
+    lines: list[str] = []
+    phase_iv: dict[str, list[tuple[float, float]]] = {}
+    for e in events:
+        name = str(e.get("name", ""))
+        if name.startswith("phase."):
+            p = name[len("phase."):]
+            if p in _WORK_PHASES:
+                phase_iv.setdefault(p, []).append(
+                    (e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6)
+                )
+    ov_spans = [e for e in events if str(e.get("name", "")).startswith("overlap.")]
+    plain_walls = {p: _length(_merge(iv)) for p, iv in phase_iv.items()}
+    lines.append("cross-phase concurrency lanes:")
+    if not ov_spans:
+        lines.append("  (no overlap.* spans — overlap engines off or idle)")
+    for e in sorted(ov_spans, key=lambda e: e["ts"]):
+        home = str((e.get("args") or {}).get("phase") or "")
+        lo, hi = e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6
+        if home not in _WORK_PHASES:
+            problems.append(
+                f"{e['name']}: overlap span without a work-phase 'phase' "
+                f"attribute (got {home!r})"
+            )
+            continue
+        if e["dur"] > 0:
+            phase_iv.setdefault(home, []).append((lo, hi))
+        # the lane: which OTHER phases' walls this span actually ran under
+        hidden_under = [
+            p
+            for p, iv in phase_iv.items()
+            if p != home
+            and any(lo < phi and plo < hi for plo, phi in iv)
+        ]
+        lines.append(
+            "  {name:<22} {dur:9.4f}s  {home}-work under {under}".format(
+                name=e["name"],
+                dur=e["dur"] / 1e6,
+                home=home,
+                under=", ".join(sorted(hidden_under)) or "its own phase",
+            )
+        )
+    merged = {p: _merge(iv) for p, iv in phase_iv.items()}
+    walls = {p: _length(iv) for p, iv in merged.items()}
+    union = _merge([t for iv in merged.values() for t in iv])
+    union_len = _length(union)
+    overlap = sum(walls.values()) - union_len
+    wall = trace_round_wall(events)
+    if wall is None:
+        problems.append("overlap: trace has no phase.unmask span — no round wall")
+        return "\n".join(lines), problems
+    gap = max(0.0, wall - union_len)
+    residual = sum(walls.values()) - overlap + gap - wall
+    slack = wall - sum(walls.values())
+    lines.append(
+        "\ntimeline identity: sum(walls) {s:.4f}s − overlap {o:.4f}s + "
+        "gap {g:.4f}s == wall {w:.4f}s (residual {r:+.6f}s)".format(
+            s=sum(walls.values()), o=overlap, g=gap, w=wall, r=residual
+        )
+    )
+    lines.append(
+        "negative slack: {sl:+.4f}s ({verdict})".format(
+            sl=slack,
+            verdict=(
+                "wall beat the serial sum of phase walls"
+                if slack < 0
+                else "no measured cross-phase overlap win"
+            ),
+        )
+    )
+    for p in _WORK_PHASES:
+        if p in walls and walls[p] - plain_walls.get(p, 0.0) > 1e-9:
+            lines.append(
+                "  phase {p}: wall {w:.4f}s (+{d:.4f}s of its work ran under "
+                "other phases)".format(
+                    p=p, w=walls[p], d=walls[p] - plain_walls.get(p, 0.0)
+                )
+            )
+    if abs(residual) > _IDENTITY_TOL_S:
+        problems.append(
+            f"overlap: timeline identity does not balance (residual "
+            f"{residual:+.6f}s beyond {_IDENTITY_TOL_S}s)"
+        )
+    if gap > 0 and overlap > 0 and gap < 1e-9:
+        pass  # both sides active: nothing further to assert
+    return "\n".join(lines), problems
+
+
 def _children(events: list[dict]) -> dict[str | None, list[dict]]:
     kids: dict[str | None, list[dict]] = {}
     for e in events:
@@ -347,6 +466,12 @@ def main(argv: list[str] | None = None) -> int:
         "round wall vs the report's timeline fold (needs --round-report "
         "for the fold comparison) + target-breach flagging",
     )
+    ap.add_argument(
+        "--overlap",
+        action="store_true",
+        help="cross-phase concurrency lanes for overlap.* spans + assert the "
+        "timeline identity sum(walls) − overlap + gap == wall still balances",
+    )
     ap.add_argument("--limit", type=int, default=200, help="timeline rows")
     args = ap.parse_args(argv)
 
@@ -377,6 +502,11 @@ def main(argv: list[str] | None = None) -> int:
             problems.extend(cross_check(events, report))
     if args.slo:
         problems.extend(slo_check(events, report, args.slo))
+    if args.overlap:
+        lanes, ov_problems = overlap_report(events)
+        print(lanes)
+        print()
+        problems.extend(ov_problems)
 
     if not args.validate:
         print(timeline(events, args.limit))

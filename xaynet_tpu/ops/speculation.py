@@ -1,0 +1,278 @@
+"""Speculative sum2 mask derivation (docs/DESIGN.md §22).
+
+The sum2 mask aggregate depends only on the mask seeds — and a seed is
+known long before the sum2 phase opens: the sum dictionary seals at the
+sum→update transition, and each accepted update's mask seed arrives
+during the update window. A :class:`SpeculativeMaskSession` exploits
+that: offered seeds are derived and folded by a background worker while
+the update-phase folds still run, so by the time sum2 needs the mask
+aggregate most (often all) of the derive work has already been hidden
+under the update wall and ``settle()`` reduces to reconciliation.
+
+Byte-identity is unconditional because mask aggregation is a modular
+sum over a finite group — order-independent, and exactly invertible:
+
+- a **hit** (speculated seed that did arrive) is already folded;
+- a **miss** (seed never offered, or the worker didn't reach it) derives
+  on demand at settle time, exactly the serial path;
+- a **discard** (mis-speculation: an offered seed whose participant
+  dropped before sum2 — PR-5 churn) re-derives that seed's mask and
+  subtracts it back out (``mod_sub`` is the group inverse), leaving the
+  accumulator bit-identical to never having folded it.
+
+The worker takes *idle* scheduler slots (``TenantScheduler.
+try_acquire_idle``) so speculation never delays a real fold batch, and
+every derive group is recorded as an ``overlap.spec_derive`` span
+(home phase ``update``) so the timeline fold (telemetry/timeline.py)
+measures the hidden seconds as negative slack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..core.mask.config import MaskConfigPair
+from ..telemetry import tracing as trace
+from ..telemetry.timeline import record_overlap, record_spec_outcomes
+from . import limbs as host_limbs
+
+SPAN_SPEC_DERIVE = trace.declare_span("overlap.spec_derive")
+
+
+class SpeculativeMaskSession:
+    """Background derive+fold of sum2 masks for seeds offered early.
+
+    One session per (tenant, round). ``offer()`` enqueues seeds the
+    moment they are known; ``settle(actual_seeds)`` stops the worker,
+    reconciles hits/misses/discards and returns ``(unit limbs, vector
+    wire limbs)`` byte-identical to ``masking_jax.sum_masks(actual_seeds,
+    ...)``. ``close()`` abandons the session (all work discarded).
+    """
+
+    def __init__(
+        self,
+        length: int,
+        config: MaskConfigPair,
+        kernel: str | None = None,
+        mesh=None,
+        group: int = 8,
+        tenant: str = "default",
+        scheduler=None,
+        seed_batch: int = 8,
+    ):
+        self.length = length
+        self.config = config
+        self.kernel = kernel
+        self.mesh = mesh
+        self.group = max(1, group)
+        self.tenant = tenant
+        self.seed_batch = seed_batch
+        self._sched = scheduler
+        self._owner = scheduler.new_owner() if scheduler is not None else None
+        self._ol_v = host_limbs.order_limbs_for(config.vect.order)
+        self._ol_u = host_limbs.order_limbs_for(config.unit.order)
+        n_limb_v = host_limbs.n_limbs_for_order(config.vect.order)
+        n_limb_u = host_limbs.n_limbs_for_order(config.unit.order)
+        self._vect_acc = np.zeros((length, n_limb_v), dtype=np.uint32)
+        self._unit_acc = np.zeros(n_limb_u, dtype=np.uint32)
+        self._lock = threading.Lock()
+        self._queue: list[bytes] = []  # guarded-by: _lock
+        self._queued: set[bytes] = set()  # guarded-by: _lock
+        self._folded: set[bytes] = set()  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._wake = threading.Event()
+        self._derive_seconds = 0.0  # guarded-by: _lock
+        self._worker: threading.Thread | None = None
+
+    # -- producer side -----------------------------------------------------
+
+    def offer(self, seeds) -> None:
+        """Enqueue seeds for speculative derivation (idempotent per seed)."""
+        start_worker = False
+        with self._lock:
+            if self._closed:
+                return
+            for seed in seeds:
+                if seed not in self._queued:
+                    self._queued.add(seed)
+                    self._queue.append(seed)
+            start_worker = self._worker is None and bool(self._queue)
+            if start_worker:
+                self._worker = threading.Thread(
+                    target=self._run, name="xn-spec-derive", daemon=True
+                )
+        self._wake.set()
+        if start_worker:
+            self._worker.start()
+
+    def speculated(self) -> int:
+        """Seeds folded into the speculative accumulator so far."""
+        with self._lock:
+            return len(self._folded)
+
+    # -- worker ------------------------------------------------------------
+
+    def _take_group(self) -> list[bytes] | None:
+        with self._lock:
+            if self._closed:
+                return None
+            if not self._queue:
+                return []
+            group, self._queue = self._queue[: self.group], self._queue[self.group :]
+            return group
+
+    def _run(self) -> None:
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            if not group:
+                # idle: wait for more offers (settle/close wakes us too)
+                self._wake.clear()
+                self._wake.wait(timeout=0.05)
+                with self._lock:
+                    if self._closed and not self._queue:
+                        return
+                continue
+            granted = True
+            if self._sched is not None:
+                # an IDLE slot only: never delay a real fold batch. Denied
+                # slots requeue the group — the seeds become misses at
+                # settle if the window stays busy, which is the serial
+                # path, not an error.
+                granted = self._sched.try_acquire_idle(self.tenant, self._owner)
+            if not granted:
+                with self._lock:
+                    closing = self._closed
+                    if not closing:
+                        self._queue = group + self._queue
+                if closing:
+                    return
+                self._wake.clear()
+                self._wake.wait(timeout=0.02)
+                continue
+            try:
+                self._derive_group(group)
+            except BaseException:
+                # fail-soft: un-derived seeds fall back to the on-demand
+                # path at settle; speculation must never fail a round
+                with self._lock:
+                    self._queued.difference_update(group)
+            finally:
+                if self._sched is not None:
+                    self._sched.release(self._owner)
+
+    def _derive_group(self, group: list[bytes]) -> None:
+        from . import masking_jax
+
+        t0 = time.monotonic()
+        unit, vect = masking_jax.sum_masks(
+            group,
+            self.length,
+            self.config,
+            seed_batch=self.seed_batch,
+            kernel=self.kernel,
+            mesh=self.mesh,
+        )
+        vect = np.asarray(vect)
+        dt = time.monotonic() - t0
+        with self._lock:
+            if self._closed:
+                return
+            self._vect_acc = host_limbs.mod_add(self._vect_acc, vect, self._ol_v)
+            self._unit_acc = host_limbs.mod_add(
+                self._unit_acc[None, :], np.asarray(unit)[None, :], self._ol_u
+            )[0]
+            self._folded.update(group)
+            self._derive_seconds += dt
+        trace.get_tracer().record_span(
+            SPAN_SPEC_DERIVE,
+            start=t0,
+            duration=dt,
+            phase="update",
+            tenant=self.tenant,
+            seeds=len(group),
+        )
+
+    # -- consumer side -----------------------------------------------------
+
+    def _stop_worker(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._wake.set()
+        worker = self._worker
+        if worker is not None:
+            worker.join()
+
+    def close(self) -> None:
+        """Abandon the session; all speculative work is discarded."""
+        self._stop_worker()
+        if self._sched is not None:
+            self._sched.release_owner(self._owner)
+
+    def settle(self, seeds: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+        """Reconcile against the ACTUAL sum2 seed set and return the mask
+        aggregate ``(unit limbs, vector wire limbs)`` — byte-identical to
+        the non-speculative ``sum_masks(seeds, ...)``."""
+        from . import masking_jax
+
+        if not seeds:
+            raise ValueError("no seeds to aggregate")
+        self._stop_worker()
+        try:
+            wanted = set(seeds)
+            if len(wanted) != len(seeds):
+                # duplicate seeds (never produced by the protocol's seed
+                # dict, but sum_masks accepts them): speculation folded
+                # each seed once, so fall back to the serial path outright
+                record_spec_outcomes(misses=len(seeds))
+                return masking_jax.sum_masks(
+                    seeds,
+                    self.length,
+                    self.config,
+                    seed_batch=self.seed_batch,
+                    kernel=self.kernel,
+                    mesh=self.mesh,
+                )
+            with self._lock:
+                folded = set(self._folded)
+                vect_acc, unit_acc = self._vect_acc, self._unit_acc
+                spec_seconds = self._derive_seconds
+            hits = wanted & folded
+            discards = sorted(folded - wanted)
+            misses = [s for s in seeds if s not in folded]  # keep offer order
+            for group, sub in ((discards, True), (misses, False)):
+                if not group:
+                    continue
+                unit, vect = masking_jax.sum_masks(
+                    group,
+                    self.length,
+                    self.config,
+                    seed_batch=self.seed_batch,
+                    kernel=self.kernel,
+                    mesh=self.mesh,
+                )
+                op = host_limbs.mod_sub if sub else host_limbs.mod_add
+                vect_acc = op(vect_acc, np.asarray(vect), self._ol_v)
+                unit_acc = op(
+                    unit_acc[None, :], np.asarray(unit)[None, :], self._ol_u
+                )[0]
+            record_spec_outcomes(
+                hits=len(hits), misses=len(misses), discards=len(discards)
+            )
+            if spec_seconds > 0:
+                record_overlap(
+                    "spec_derive",
+                    spec_seconds,
+                    tenant=self.tenant,
+                    hits=len(hits),
+                    misses=len(misses),
+                    discards=len(discards),
+                )
+            return unit_acc, vect_acc
+        finally:
+            if self._sched is not None:
+                self._sched.release_owner(self._owner)

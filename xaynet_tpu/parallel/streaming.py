@@ -87,7 +87,7 @@ from ..tenancy.scheduler import get_scheduler
 # BYTES_STAGED: one module owns the xaynet_bytes_staged_total family —
 # aggregator.py registers it (wire-ingest staging accounts there too) and
 # the streaming rings account through the shared symbol
-from .aggregator import BYTES_STAGED, ShardedAggregator
+from .aggregator import BYTES_REDUCED, BYTES_STAGED, ShardedAggregator
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +95,7 @@ SPAN_STAGE = trace.declare_span("stream.stage")
 SPAN_FOLD = trace.declare_span("stream.fold")
 SPAN_COMMIT = trace.declare_span("stream.commit")
 SPAN_DRAIN = trace.declare_span("stream.drain")
+SPAN_EAGER_UNMASK = trace.declare_span("overlap.eager_unmask")
 
 _registry = get_registry()
 STAGING_DEPTH = _registry.gauge(
@@ -208,6 +209,26 @@ class _BatchJob:
         # through the counter, not mutual exclusion
         self.staged = None  # wire: the mesh-staged byte array (transfer barrier)
         self.global_release = None  # wire: (ring, buf) released at commit
+
+
+class _UnmaskJob:
+    """One eager per-shard unmask pass riding the shard queues
+    (docs/DESIGN.md §22): each shard worker subtracts ITS mask slice
+    against its own accumulator buffer as soon as the shard's last queued
+    fold commits (queue FIFO is the ordering guarantee — the unmask item
+    sits behind every fold item of the round). Workers write disjoint row
+    ranges of ``out``; ``error`` is first-failure sticky and the caller
+    falls back to the drain-time unmask pass (the subtract is functional —
+    a failed shard leaves its accumulator untouched)."""
+
+    __slots__ = ("mask_planar", "out", "remaining", "error", "done")
+
+    def __init__(self, mask_planar, out, n_shards: int):
+        self.mask_planar = mask_planar
+        self.out = out
+        self.remaining = n_shards  # guarded-by: _lock (the owning pipeline's)
+        self.error = None  # guarded-by: _lock
+        self.done = threading.Event()
 
 
 def _release_ring_leases(pool, leases: list) -> None:
@@ -405,7 +426,7 @@ class StreamingAggregator:
         except StreamingError:
             logger.warning("closing poisoned streaming pipeline")
         self._closed = True
-        if self._degraded:
+        if self._degraded:  # lint: guarded-ok: post-drain, workers joined below
             DEGRADED.set(0)
         if self._worker is not None and self._worker.is_alive():
             self._queue.put(_SHUTDOWN)
@@ -416,13 +437,13 @@ class StreamingAggregator:
             for w in self._shard_workers:
                 if w is not None and w.is_alive():
                     w.join(timeout=60.0)
-        if self._plan is not None:
+        if self._plan is not None:  # lint: guarded-ok: post-drain, workers joined above
             # shut the plan's fold pool; the per-shard buffers stay ADOPTED
             # by the aggregator (reduce-scatter) so finalize/unmask/snapshot
             # after close still read the accumulator — on a poisoned
             # pipeline they surface the error through drain() first
-            self._plan.close()
-            self._plan = None
+            self._plan.close()  # lint: guarded-ok: post-drain, workers joined above
+            self._plan = None  # lint: guarded-ok: post-drain, workers joined above
         # staging pages go back to the pool (nothing is in flight past the
         # drain/joins above); the shard plan's accumulator pages stay
         # leased — unmask still reads them — and release through
@@ -961,6 +982,8 @@ class StreamingAggregator:
         """Worker-side fold with the degradation ladder: streaming fold ->
         one synchronous retry (switching the pipeline to sync mode) ->
         sticky poison naming the batch and the original exception."""
+        if isinstance(item[0], _UnmaskJob):  # eager unmask tail item
+            return self._process_unmask(item)
         if isinstance(item[0], _BatchJob):  # shard-parallel item
             return self._process_shard(item)
         buf, payload, kind, k, ticket, seq = item
@@ -1741,3 +1764,99 @@ class StreamingAggregator:
         # accumulator copies per drain on native plans) is gone.
         self._publish_overlap()
         return accepted
+
+    # -- eager per-shard unmask (docs/DESIGN.md §22) ------------------------
+
+    def stage_unmask(self, mask_planar: np.ndarray) -> "_UnmaskJob | None":
+        """Enqueue the round's unmask as per-shard tail jobs: each shard
+        subtracts its mask slice as soon as ITS last queued fold commits,
+        instead of after the global drain barrier plus a separate serial
+        unmask pass. Returns ``None`` when the pipeline cannot run the
+        eager path (not sharded, no live plan, degraded, or poisoned) —
+        the caller falls back to the drain-time unmask. The returned job
+        settles in :meth:`finish_unmask`."""
+        with self._lock:
+            plan = self._plan
+            eligible = (
+                self._sharded
+                and plan is not None
+                and not self._degraded
+                and self._error is None
+                and not self._closed
+            )
+        if not eligible:
+            return None
+        agg = self.agg
+        out = np.empty((agg.model_length, agg.n_limbs), dtype=np.uint32)
+        job = _UnmaskJob(mask_planar, out, self._n_shards)
+        self._ensure_shard_workers()
+        for d, q in enumerate(self._shard_queues):
+            q.put((job, d))
+        return job
+
+    def _process_unmask(self, item: tuple) -> None:
+        """One shard worker's eager unmask leg: runs after the shard's
+        last fold (queue FIFO), subtracts that shard's mask slice, and
+        records the hidden seconds as an ``overlap.eager_unmask`` span
+        (home phase ``unmask``) so the timeline fold measures them as
+        negative slack."""
+        job, d = item
+        t0 = time.monotonic()
+        try:
+            with self._lock:
+                plan = self._plan
+                poisoned = self._error is not None
+            if not poisoned and plan is not None:
+                self.agg.unmask_shard(plan, d, job.mask_planar, job.out)
+                trace.get_tracer().record_span(
+                    SPAN_EAGER_UNMASK,
+                    start=t0,
+                    duration=time.monotonic() - t0,
+                    phase="unmask",
+                    shard=d,
+                    tenant=self.tenant,
+                )
+            elif job.error is None:
+                with self._lock:
+                    if job.error is None:
+                        job.error = self._error or StreamingError(
+                            "eager unmask skipped: plan gone"
+                        )
+        except BaseException as e:
+            # the subtract is functional — the shard accumulator is
+            # untouched on failure, so the caller's fallback to the
+            # drain-time unmask pass stays byte-correct
+            with self._lock:
+                if job.error is None:
+                    job.error = e
+        finally:
+            with self._lock:
+                job.remaining -= 1
+                last = job.remaining == 0
+            if last:
+                job.done.set()
+
+    def finish_unmask(self, job: "_UnmaskJob") -> np.ndarray | None:
+        """Settle an eager unmask: wait for every shard's tail job (most
+        of the work has already run, hidden behind the fold/drain wall),
+        then hand back the assembled host wire result — or ``None`` if any
+        shard failed (caller falls back to the drain-time pass). Records
+        the same ``unmask`` kernel op and gather accounting as the
+        drain-time pass — what shrinks is the measured wall, which is
+        exactly the point."""
+
+        def settle():
+            job.done.wait()
+            with self._lock:
+                err = job.error
+            if err is not None:
+                logger.warning(
+                    "eager unmask fell back to the drain-time pass: %s: %s",
+                    type(err).__name__,
+                    err,
+                )
+                return None
+            BYTES_REDUCED.labels(path="gather").inc(job.out.nbytes)
+            return np.ascontiguousarray(job.out)
+
+        return profiling.timed_kernel("unmask", self.agg.padded_length, settle)

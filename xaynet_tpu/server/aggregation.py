@@ -47,14 +47,28 @@ class DeviceAggregation(Aggregation):
     checkpoint/test paths that genuinely want the gathered aggregate.
     """
 
-    def __init__(self, config: MaskConfigPair, object_size: int, device, unit_acc):
+    def __init__(self, config: MaskConfigPair, object_size: int, device, unit_acc, stream=None):
         # deliberately NOT calling super().__init__: it would allocate an
         # empty host MaskObject of the full model size just to carry configs
-        self.nb_models = device.nb_models
+        self._nb_models = device.nb_models
         self.object_size = object_size
         self._config = config
         self._device = device
         self._unit_acc = np.asarray(unit_acc)
+        # deferred-drain handoff (docs/DESIGN.md §22): when the streaming
+        # pipeline rides into Unmask still open, the eager per-shard
+        # unmask subtracts each shard the moment ITS last fold commits
+        self._stream = stream
+
+    @property
+    def nb_models(self) -> int:
+        if self._stream is not None:
+            # deferred drain: folds may still be in flight — read the
+            # count atomically with the worker handoff, exactly as the
+            # update phase's capacity checks did (it is exact once the
+            # eager unmask's drain has settled the pipeline)
+            return self._stream.counted_models()
+        return self._nb_models
 
     @property
     def config(self) -> MaskConfigPair:
@@ -64,6 +78,8 @@ class DeviceAggregation(Aggregation):
     def object(self) -> MaskObject:
         """Gathered host aggregate (checkpoints/tests only — the unmask
         path never calls this)."""
+        if self._stream is not None:
+            self._stream.drain()
         return MaskObject(
             MaskVect(self._config.vect, self._device.snapshot()),
             MaskUnit(self._config.unit, self._unit_acc),
@@ -83,11 +99,44 @@ class DeviceAggregation(Aggregation):
         if not mask.is_valid():
             raise UnmaskingError("InvalidMask")
 
+    def _settle_stream(self) -> None:
+        """Close a deferred-drain pipeline and pin the final model count
+        (everything has settled by now: drain ran, close re-drains)."""
+        stream, self._stream = self._stream, None
+        if stream is not None:
+            stream.close()
+            self._nb_models = self._device.nb_models
+
+    def _eager_unmask(self, mask_obj: MaskObject) -> np.ndarray | None:
+        """Eager per-shard unmask (docs/DESIGN.md §22): the mask subtract
+        is staged as per-shard tail jobs BEHIND the round's last fold
+        batches, so each shard unmasks the moment its own last fold
+        commits — instead of global drain barrier, then a separate unmask
+        pass. Returns ``None`` when the pipeline couldn't run it (caller
+        falls back to the drain-time subtract, byte-identical either way:
+        a failed shard's accumulator is untouched)."""
+        stream = self._stream
+        planar = self._device.mask_planar(mask_obj.vect.data)
+        job = stream.stage_unmask(planar)
+        try:
+            # the deferred acceptance sync + completion barrier; fold
+            # errors surface here exactly as they would have at the
+            # sum2 finalize in the serial flow
+            stream.drain()
+        except Exception:
+            self._settle_stream()
+            raise
+        out = stream.finish_unmask(job) if job is not None else None
+        self._settle_stream()
+        return out
+
     def _unmasked_limbs(self, mask_obj: MaskObject) -> tuple[np.ndarray, int]:
         # per-shard in-place subtract: the mask planes upload with the
         # accumulator's sharding and each device subtracts its own slice;
         # the gather happens AFTER the subtraction, on the unmasked result
-        n_vect = self._device.unmask_limbs(mask_obj.vect.data)
+        n_vect = self._eager_unmask(mask_obj) if self._stream is not None else None
+        if n_vect is None:
+            n_vect = self._device.unmask_limbs(mask_obj.vect.data)
         ol_u = limb_ops.order_limbs_for(self._config.unit.order)
         n_unit = limb_ops.mod_sub(
             self._unit_acc[None, :], np.asarray(mask_obj.unit.data)[None, :], ol_u
@@ -522,7 +571,7 @@ class StagedAggregator:
         if self._device is not None:
             self._device.release_plan_pages()
 
-    def finalize_inplace(self) -> Aggregation:
+    def finalize_inplace(self, defer_drain: bool = False) -> Aggregation:
         """The Unmask handoff WITHOUT gathering the accumulator.
 
         Host mode is unchanged (the accumulator is host-resident — its
@@ -532,7 +581,20 @@ class StagedAggregator:
         and only the unmasked result crosses to the host for decode —
         ``finalize()`` (kept for snapshot/test callers) gathers first and
         subtracts after, a full extra accumulator round-trip at 25M params.
+
+        With ``defer_drain`` (``[overlap] eager_unmask``, docs/DESIGN.md
+        §22) the device pipeline rides into Unmask still OPEN: the staged
+        remainder is submitted but the drain barrier moves into the eager
+        unmask, where each shard subtracts its mask slice the moment its
+        own last fold commits instead of after a global drain plus a
+        separate unmask pass.
         """
+        if defer_drain and self._device is not None:
+            self.flush()
+            return DeviceAggregation(
+                self.config, self.object_size, self._device, self._unit_acc,
+                stream=self._stream,
+            )
         self.drain()
         if self._device is None:
             return self._host

@@ -97,6 +97,19 @@ def _tenant_rows(server) -> str:
             if last and last.get("degraded")
             else '<span class="ok">full</span>' if last else "-"
         )
+        # cross-phase overlap of the last folded round (docs/DESIGN.md
+        # §22): negative slack — the round wall came in under the serial
+        # sum of phase walls — is the overlap engine's visible win
+        if last:
+            ov = last.get("overlap_s", 0.0)
+            slack = last.get("wall_s", 0.0) - sum(
+                p.get("wall_s", 0.0) for p in last.get("phases", {}).values()
+            )
+            overlap_cell = "{:.3f}s{}".format(
+                ov, " <span class='ok'>(−slack)</span>" if slack < 0 else ""
+            )
+        else:
+            overlap_cell = "-"
         burns = engine.burn_snapshot(tenant)
         burn_cells = "".join(
             "<td>{}</td>".format(
@@ -110,12 +123,13 @@ def _tenant_rows(server) -> str:
         )
         rows.append(
             "<tr><td>{t}</td><td>{p}</td><td>{r}</td><td>{w}</td>"
-            '<td class="spark">{s}</td><td>{d}</td>{b}</tr>'.format(
+            '<td class="spark">{s}</td><td>{o}</td><td>{d}</td>{b}</tr>'.format(
                 t=_esc(tenant),
                 p=_esc(phase),
                 r=_esc(round_id),
                 w=_esc(wall),
                 s=_sparkline(walls),
+                o=overlap_cell,
                 d=degraded,
                 b=burn_cells,
             )
@@ -336,7 +350,7 @@ def render_statusz(server) -> str:
         _alerts_section(),
         "<h2>tenants</h2>",
         "<table><tr><th>tenant</th><th>phase</th><th>round</th><th>wall</th>"
-        "<th>recent walls</th><th>windows</th>{bh}</tr>{rows}</table>".format(
+        "<th>recent walls</th><th>overlap</th><th>windows</th>{bh}</tr>{rows}</table>".format(
             bh=burn_headers, rows=_tenant_rows(server)
         ),
     ]

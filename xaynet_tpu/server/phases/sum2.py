@@ -4,14 +4,31 @@ Reference behavior (rust/xaynet-server/src/state_machine/phases/sum2.rs:33-98):
 each accepted ``Sum2Request`` increments the score of the submitted mask
 (sum membership and single submission enforced by the store); the model
 aggregation is carried forward to Unmask.
+
+Phase overlap (docs/DESIGN.md §22): with ``[overlap] sum2_drain`` the
+update phase hands its streaming pipeline over still in flight and this
+phase runs the drain barrier in a background executor thread while it
+collects sum2 masks — the fold tail that used to serialize behind the
+update wall is hidden under this phase's collection wall, recorded as an
+``overlap.drain`` span (home phase ``update``) so the round timeline
+measures the hidden seconds as negative slack. The drain future is
+awaited before the phase exits, so fold errors still fail the round
+before Unmask reads the accumulator.
 """
 
 from __future__ import annotations
 
+import asyncio
+import time
+
+from ...telemetry import tracing as trace
+from ...telemetry.timeline import record_overlap
 from ..aggregation import StagedAggregator
 from ..events import DictionaryUpdate, PhaseName
 from ..requests import RequestError, StateMachineRequest, Sum2Request
 from .base import PhaseState
+
+SPAN_OVERLAP_DRAIN = trace.declare_span("overlap.drain")
 
 
 class Sum2Phase(PhaseState):
@@ -20,9 +37,41 @@ class Sum2Phase(PhaseState):
     def __init__(self, shared, aggregator: StagedAggregator):
         super().__init__(shared)
         self.aggregator = aggregator
+        self._drain_task: asyncio.Future | None = None
+
+    def _drain_overlapped(self) -> None:
+        """The update pipeline's drain barrier, run under the sum2 wall:
+        the hidden seconds land as an ``overlap.drain`` span attributed
+        to the update phase (its work), which the timeline fold merges
+        into the update interval — the measured negative slack."""
+        t0 = time.monotonic()
+        try:
+            self.aggregator.drain()
+        finally:
+            dt = time.monotonic() - t0
+            trace.get_tracer().record_span(
+                SPAN_OVERLAP_DRAIN,
+                start=t0,
+                duration=dt,
+                phase="update",
+                tenant=self.shared.tenant,
+            )
+            record_overlap("drain", dt, tenant=self.shared.tenant)
 
     async def process(self) -> None:
-        await self.process_requests(self.shared.settings.pet.sum2)
+        if self.shared.settings.overlap.feature("sum2_drain"):
+            self._drain_task = asyncio.get_running_loop().run_in_executor(
+                None, self._drain_overlapped
+            )
+        try:
+            await self.process_requests(self.shared.settings.pet.sum2)
+        finally:
+            if self._drain_task is not None:
+                # the overlap window closes with the phase: fold errors
+                # surface HERE (failing the round exactly where the
+                # serial flow's drain would have), never past sum2
+                task, self._drain_task = self._drain_task, None
+                await task
 
     def broadcast(self) -> None:
         # the round's dictionaries are spent once the masks are in
@@ -35,8 +84,11 @@ class Sum2Phase(PhaseState):
 
         # finalize WITHOUT gathering: device rounds hand Unmask a sharded
         # view so the elected mask is subtracted per-shard in place (host
-        # rounds get the host Aggregation exactly as before)
-        return Unmask(self.shared, self.aggregator.finalize_inplace())
+        # rounds get the host Aggregation exactly as before); with
+        # [overlap] eager_unmask the pipeline stays open so each shard
+        # subtracts at its own last-fold commit (docs/DESIGN.md §22)
+        eager = self.shared.settings.overlap.feature("eager_unmask")
+        return Unmask(self.shared, self.aggregator.finalize_inplace(defer_drain=eager))
 
     async def handle_request(self, req: StateMachineRequest) -> None:
         if not isinstance(req, Sum2Request):

@@ -129,10 +129,18 @@ class UpdatePhase(PhaseState):
             if sum_dict:
                 self.shared.events.broadcast_sum_dict(DictionaryUpdate.new(sum_dict))
         await self.process_requests(params)
-        # phase transition: drain the streaming pipeline — every submitted
-        # fold completes and the deferred acceptance sync runs, off the
-        # event loop (this is the one blocking synchronization point)
-        await asyncio.get_running_loop().run_in_executor(None, self.aggregator.drain)
+        if self.shared.settings.overlap.feature("sum2_drain"):
+            # phase overlap (docs/DESIGN.md §22): SUBMIT the staged
+            # remainder but leave the drain barrier to the sum2 phase,
+            # which runs it in the background under its own collection
+            # wall — the fold tail that used to extend the update wall is
+            # hidden, and fold errors still fail the round before Unmask
+            await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
+        else:
+            # phase transition: drain the streaming pipeline — every
+            # submitted fold completes and the deferred acceptance sync
+            # runs, off the event loop (the one blocking sync point)
+            await asyncio.get_running_loop().run_in_executor(None, self.aggregator.drain)
         self._seed_dict = await self.shared.store.coordinator.seed_dict()
         if not self._seed_dict:
             raise PhaseError("NoSeedDict", "seed dictionary missing after update phase")

@@ -167,6 +167,12 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
     from ..telemetry import slo as slo_engine
 
     slo_engine.configure(settings.slo)
+    # warm kernel-calibration verdicts (docs/DESIGN.md §22): with
+    # XAYNET_CALIB_CACHE set, the fold/mask probe races a previous process
+    # ran load here instead of inside the first round's wall
+    from ..utils import calibcache
+
+    calibcache.configure_from_env()
     initializer = StateMachineInitializer(settings, store, metrics)
     machine, request_tx, events = await initializer.init()
 
@@ -294,6 +300,9 @@ async def serve_tenants(settings: Settings) -> None:
     from ..telemetry import slo as slo_engine
 
     slo_engine.configure(settings.slo)
+    from ..utils import calibcache
+
+    calibcache.configure_from_env()
 
     registry = TenantRegistry()
     routes: dict[str, TenantRoutes] = {}

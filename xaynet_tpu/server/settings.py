@@ -606,6 +606,45 @@ class SloSettings:
 
 
 @dataclass
+class OverlapSettings:
+    """``[overlap]`` — round-phase overlap & speculation (docs/DESIGN.md §22).
+
+    The PET phase chain is serial by protocol, not by data dependency:
+    the sum2 mask derivation needs only the sealed sum dict, the fold
+    drain needs only staged updates, and each shard's unmask slice needs
+    only that shard's folds. Each flag opts one overlap out independently
+    (the ``[liveness]`` idiom — mechanisms are orthogonal); ``enabled =
+    false`` forces the fully serial pre-overlap behaviour regardless of
+    the per-feature flags. Every overlap is byte-identity preserving: a
+    disabled or mis-speculated fast path falls back to the on-demand
+    serial path.
+    """
+
+    enabled: bool = True
+    # derive sum2 masks speculatively during the update phase (bench/sim
+    # rounds where the sum participant is in-process); mis-speculated
+    # seeds are discarded by an exact modular subtract
+    speculative_derive: bool = True
+    # subtract each shard's mask slice as soon as ITS last fold commits
+    # at the drain barrier (instead of global drain + a separate pass)
+    eager_unmask: bool = True
+    # let the update-phase fold drain ride into the sum2 request window
+    # instead of blocking the phase transition on it
+    sum2_drain: bool = True
+    # seeds per speculative derive group (bounds resident mask memory to
+    # one accumulator + one group of per-seed derivations)
+    spec_group: int = 8
+
+    def feature(self, name: str) -> bool:
+        """Effective per-feature switch (master ``enabled`` gates all)."""
+        return self.enabled and bool(getattr(self, name))
+
+    def validate(self) -> None:
+        if self.spec_group < 1:
+            raise SettingsError("overlap.spec_group must be >= 1")
+
+
+@dataclass
 class Settings:
     pet: PetSettings
     mask: MaskSettings = field(default_factory=MaskSettings)
@@ -623,12 +662,14 @@ class Settings:
     tenancy: TenancySettings = field(default_factory=TenancySettings)
     slo: SloSettings = field(default_factory=SloSettings)
     loadgen: LoadgenSettings = field(default_factory=LoadgenSettings)
+    overlap: OverlapSettings = field(default_factory=OverlapSettings)
 
     def validate(self) -> None:
         self.pet.validate()
         self.api.validate()
         self.tenancy.validate()
         self.slo.validate()
+        self.overlap.validate()
         try:
             self.mask.to_config()  # quant level vs data/bound-type ceiling
         except ValueError as e:
@@ -751,6 +792,8 @@ class Settings:
         slo_base = base.slo
         lg_raw = raw.get("loadgen", {})
         lg_base = base.loadgen
+        ov_raw = raw.get("overlap", {})
+        ov_base = base.overlap
 
         return cls(
             pet=PetSettings(
@@ -964,6 +1007,15 @@ class Settings:
                 ),
                 concurrency=int(lg_raw.get("concurrency", lg_base.concurrency)),
                 seed=int(lg_raw.get("seed", lg_base.seed)),
+            ),
+            overlap=OverlapSettings(
+                enabled=bool(ov_raw.get("enabled", ov_base.enabled)),
+                speculative_derive=bool(
+                    ov_raw.get("speculative_derive", ov_base.speculative_derive)
+                ),
+                eager_unmask=bool(ov_raw.get("eager_unmask", ov_base.eager_unmask)),
+                sum2_drain=bool(ov_raw.get("sum2_drain", ov_base.sum2_drain)),
+                spec_group=int(ov_raw.get("spec_group", ov_base.spec_group)),
             ),
         )
 

@@ -244,6 +244,16 @@ class RoundReporter:
             # the per-round view of the packed-reduction counters (§17)
             report["bytes"] = deltas
         self._bytes_prev = current
+        from .timeline import drain_overlap_window
+
+        overlap = drain_overlap_window()
+        if overlap:
+            # phase-overlap work that landed during this round
+            # (docs/DESIGN.md §22): hidden seconds by kind (spec_derive |
+            # drain | eager_unmask) with the speculation reconciliation
+            # counts — the round-report view of why the round wall came in
+            # under the serial sum of phase walls
+            report["overlap"] = overlap
         calibrations = drain_mask_calibrations()
         if calibrations:
             # auto-calibration verdicts that landed during this round:

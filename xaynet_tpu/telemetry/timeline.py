@@ -42,12 +42,70 @@ from typing import Optional
 from .registry import get_registry
 from .tracing import get_tracer
 
+# log ladder covering 0.05s-120s: the registry default tops out sparsely
+# above 30s, so a large-model round (61s @25M) landed in a coarse tail
+# bucket and burn-rate math saw almost no distribution. Sub-50ms rounds
+# only exist in unit tests; >120s rounds are SLO pages, +Inf is fine.
+ROUND_WALL_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0, 90.0, 120.0,
+)
+
 ROUND_WALL = get_registry().histogram(
     "xaynet_round_wall_seconds",
     "End-to-end round wall (Idle-close to Unmask-complete), by tenant — "
     "the operator headline the SLO engine budgets (docs/DESIGN.md §20).",
     ("tenant",),
+    buckets=ROUND_WALL_BUCKETS,
 )
+
+OVERLAP_SECONDS = get_registry().counter(
+    "xaynet_overlap_seconds_total",
+    "Seconds of cross-phase work hidden inside another phase's wall, by "
+    "overlap kind (spec_derive | eager_unmask | drain; docs/DESIGN.md §22).",
+    ("kind",),
+)
+SPEC_DERIVE = get_registry().counter(
+    "xaynet_spec_derive_total",
+    "Speculatively derived sum2 mask seeds by outcome: hit (speculated and "
+    "folded), miss (derived on demand at sum2), discard (mis-speculated, "
+    "subtracted back out; docs/DESIGN.md §22).",
+    ("outcome",),
+)
+
+# per-round overlap window: entries recorded by the overlap features and
+# drained into the round report's `overlap` section (the
+# `record_mask_calibration` idiom — bounded, fail-soft)
+_overlap_window_lock = threading.Lock()
+_overlap_window: list[dict] = []
+_MAX_OVERLAP_ENTRIES = 256
+
+
+def record_overlap(kind: str, seconds: float, tenant: str = "default", **extra) -> None:
+    """Credit ``seconds`` of work hidden under another phase's wall and
+    stash one entry for the round report's ``overlap`` section."""
+    OVERLAP_SECONDS.labels(kind=kind).inc(max(0.0, seconds))
+    entry = {"kind": kind, "seconds": round(seconds, 6), "tenant": tenant, **extra}
+    with _overlap_window_lock:
+        if len(_overlap_window) < _MAX_OVERLAP_ENTRIES:
+            _overlap_window.append(entry)
+
+
+def record_spec_outcomes(hits: int = 0, misses: int = 0, discards: int = 0) -> None:
+    """Count speculative-derive seed outcomes (hit | miss | discard)."""
+    if hits:
+        SPEC_DERIVE.labels(outcome="hit").inc(hits)
+    if misses:
+        SPEC_DERIVE.labels(outcome="miss").inc(misses)
+    if discards:
+        SPEC_DERIVE.labels(outcome="discard").inc(discards)
+
+
+def drain_overlap_window() -> list[dict]:
+    """Drain the per-round overlap entries (round-report flush)."""
+    global _overlap_window
+    with _overlap_window_lock:
+        out, _overlap_window = _overlap_window, []
+    return out
 
 # phases inside the round-wall bracket (idle is the bracket's left edge,
 # not part of the decomposition; failure/shutdown abort the bracket)
@@ -118,6 +176,22 @@ def fold_spans(round_id: int, spans: list) -> Optional[dict]:
                 heapq.heappush(heap, (span.duration, seq, name))
             elif span.duration > heap[0][0]:
                 heapq.heapreplace(heap, (span.duration, seq, name))
+        if name.startswith("overlap."):
+            # an overlap span is WORK BELONGING TO ITS HOME PHASE (the
+            # `phase` attr) that ran outside the phase's own span — a
+            # speculative derive inside update, update's drain riding the
+            # sum2 window, an eager per-shard unmask inside the drain.
+            # Merging it into the home phase's interval set makes the
+            # identity's overlap term measure the hidden work: phase
+            # intervals now genuinely intersect, so ``sum(phase walls) -
+            # overlap + gap == wall`` reports negative slack (wall < sum
+            # of walls) exactly when the overlap engine saved wall time.
+            home = str(span.attrs.get("phase") or "")
+            if home in _WORK_PHASES and span.duration > 0:
+                phase_iv.setdefault(home, []).append(
+                    (span.start, span.start + span.duration)
+                )
+            continue
         if not name.startswith("phase."):
             continue
         phase = name[len("phase."):]

@@ -1,0 +1,130 @@
+"""Persisted kernel auto-calibration verdicts (docs/DESIGN.md §22).
+
+The fold race (``parallel.aggregator._resolve_kernel``) and the mask race
+(``ops.masking_jax._resolve_mask_kernel``) memoize their winners
+process-wide — but a FRESH process still pays the probe race inside its
+first round's wall. This module gives those memos a disk tier: verdicts
+are keyed exactly like the in-process caches and stamped with an
+environment fingerprint (backend, jax version, core count, native-kernel
+ABI, thread pins, mesh shape is already part of each verdict key), so a
+restarted coordinator starts its first round with the winners it raced
+last time. A fingerprint mismatch — new jax, rebuilt native library,
+different machine — invalidates the whole file: stale verdicts silently
+misrouting a kernel would be worse than re-racing.
+
+Off by default. Enable by pointing ``XAYNET_CALIB_CACHE`` at a JSON file
+(the runner and the bench both honor it); ``configure(path)`` does the
+same programmatically. Writes are atomic (tempfile + rename), loads are
+fail-soft: a corrupt or unreadable cache logs and behaves like a cold
+one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+
+logger = logging.getLogger(__name__)
+
+ENV_PATH = "XAYNET_CALIB_CACHE"
+
+_lock = threading.Lock()
+_path: str | None = None
+_verdicts: dict[str, dict[str, str]] = {}  # kind -> {key repr -> winner}
+_loaded_for: str | None = None  # fingerprint the loaded verdicts belong to
+
+
+def fingerprint() -> str:
+    """The environment identity a verdict is only valid within."""
+    import jax
+
+    from . import native
+
+    lib = native.load()
+    abi = int(lib.xn_abi_version()) if lib is not None else None
+    parts = {
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "devices": jax.device_count(),
+        "cpus": os.cpu_count(),
+        "native_abi": abi,
+        "native_threads": os.environ.get("XAYNET_NATIVE_THREADS", ""),
+    }
+    return json.dumps(parts, sort_keys=True)
+
+
+def configure(path: str | None) -> None:
+    """Point the cache at ``path`` (None disables) and load it eagerly —
+    the serve-start hook, so the first round's kernel resolution finds
+    warm verdicts instead of racing inside its round wall."""
+    global _path, _verdicts, _loaded_for
+    with _lock:
+        _path = path or None
+        _verdicts = {}
+        _loaded_for = None
+        if _path is None:
+            return
+        fp = fingerprint()
+        _loaded_for = fp
+        try:
+            with open(_path, encoding="utf-8") as f:
+                raw = json.load(f)
+        except FileNotFoundError:
+            logger.info("calibration cache %s: cold start", _path)
+            return
+        except Exception as e:
+            logger.warning("calibration cache %s unreadable (%s); cold start", _path, e)
+            return
+        if raw.get("fingerprint") != fp:
+            logger.info(
+                "calibration cache %s: fingerprint changed, verdicts invalidated",
+                _path,
+            )
+            return
+        verdicts = raw.get("verdicts")
+        if isinstance(verdicts, dict):
+            _verdicts = {
+                kind: dict(v) for kind, v in verdicts.items() if isinstance(v, dict)
+            }
+            n = sum(len(v) for v in _verdicts.values())
+            logger.info("calibration cache %s: %d warm verdicts", _path, n)
+
+
+def configure_from_env() -> None:
+    configure(os.environ.get(ENV_PATH, ""))
+
+
+def get(kind: str, key: tuple) -> str | None:
+    """Warm verdict for a race the process has not run yet, or None."""
+    with _lock:
+        if _path is None:
+            return None
+        return _verdicts.get(kind, {}).get(repr(key))
+
+
+def put(kind: str, key: tuple, winner: str) -> None:
+    """Record a freshly-raced verdict and persist the file atomically."""
+    with _lock:
+        if _path is None:
+            return
+        _verdicts.setdefault(kind, {})[repr(key)] = winner
+        payload = {"fingerprint": _loaded_for or fingerprint(), "verdicts": _verdicts}
+        try:
+            d = os.path.dirname(os.path.abspath(_path))
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".calib-", suffix=".json")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, indent=1, sort_keys=True)
+                os.replace(tmp, _path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:
+            logger.warning("calibration cache %s not persisted: %s", _path, e)
