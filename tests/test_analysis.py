@@ -991,3 +991,96 @@ def test_tenant_pass_lease_site_whitelist(tmp_path):
     # pool-internal code is exempt wholesale
     graph = _graph(tmp_path, {"xaynet_tpu/tenancy/pool.py": _LEASE_ROGUE})
     assert tenantscope.run(graph) == []
+
+
+# --- tenant-scope pass: admin-path lock discipline (leg 3, §23) -------------
+
+_ADMIN_UNLOCKED = """
+class TenantLifecycle:
+    def teardown(self, tenant):
+        self.routes.pop(tenant, None)
+        self.registry.remove(tenant)
+"""
+
+_ADMIN_LOCKED = """
+class TenantLifecycle:
+    def teardown(self, tenant):
+        with self._lock:
+            self.routes.pop(tenant, None)
+            self.registry.remove(tenant)
+"""
+
+
+def test_tenant_pass_admin_mutation_outside_lock_flagged(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/lifecycle.py": _ADMIN_UNLOCKED})
+    findings = tenantscope.run(graph)
+    assert any("pop()" in f.message and "admin-path" in f.message for f in findings)
+    assert any("remove()" in f.message for f in findings)
+
+
+def test_tenant_pass_admin_mutation_under_lock_quiet(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/lifecycle.py": _ADMIN_LOCKED})
+    assert tenantscope.run(graph) == []
+
+
+def test_tenant_pass_admin_guarded_by_annotation_quiet(tmp_path):
+    annotated = _ADMIN_UNLOCKED.replace(
+        "self.registry.remove(tenant)",
+        "self.registry.remove(tenant)  # guarded-by: registry._lock",
+    ).replace(
+        "self.routes.pop(tenant, None)",
+        "self.routes.pop(tenant, None)  # guarded-by: _lock",
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/lifecycle.py": annotated})
+    assert tenantscope.run(graph) == []
+
+
+def test_tenant_pass_admin_locked_suffix_exempt(tmp_path):
+    # *_locked helpers run with the caller already holding the lock — the
+    # repo-wide convention the pool/scheduler use too
+    code = (
+        "class TenantLifecycle:\n"
+        "    def _set_state_locked(self, tenant, state):\n"
+        "        self._states.pop(tenant, None)\n"
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/lifecycle.py": code})
+    assert tenantscope.run(graph) == []
+
+
+def test_tenant_pass_admin_leg_only_covers_lifecycle(tmp_path):
+    # the same unlocked mutations in another tenancy module are that
+    # module's own discipline (locks pass), not the admin leg's
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/registry.py": _ADMIN_UNLOCKED})
+    assert tenantscope.run(graph) == []
+
+
+# --- tenant-scope pass: sanctioned migration sites (leg 4, §23) -------------
+
+_MIGRATOR_ROGUE = """
+def pin(pool, lease):
+    pool.set_migrator(lease, None)
+"""
+
+
+def test_tenant_pass_migration_site_whitelist(tmp_path):
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/rogue.py": _MIGRATOR_ROGUE})
+    findings = tenantscope.run(graph)
+    assert any("set_migrator" in f.message and "sanctioned" in f.message
+               for f in findings)
+    # a direct .migrator store is the same hole
+    store = "def pin(lease):\n    lease.migrator = None\n"
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/rogue.py": store})
+    assert any(".migrator" in f.message for f in tenantscope.run(graph))
+    # the real ring sites are whitelisted (file + qualname exact)
+    ring = (
+        "class _StagingRing:\n"
+        "    def acquire(self, timeout=None):\n"
+        "        lease = self._free.get(timeout=timeout)\n"
+        "        self._pool.set_migrator(lease, None)\n"
+        "        return lease.array\n"
+    )
+    graph = _graph(tmp_path, {"xaynet_tpu/parallel/streaming.py": ring})
+    assert tenantscope.run(graph) == []
+    # pool-internal code is exempt wholesale
+    graph = _graph(tmp_path, {"xaynet_tpu/tenancy/pool.py": _MIGRATOR_ROGUE})
+    assert tenantscope.run(graph) == []

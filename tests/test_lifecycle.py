@@ -1,0 +1,624 @@
+"""Elastic tenant lifecycle (docs/DESIGN.md §23): pool defrag, SLO-weighted
+scheduling, the quarantine/drain state machine, and the /admin/tenants REST
+surface — all against fakes and an injectable clock, so no test sleeps
+through a drain budget or a quarantine reset."""
+
+import asyncio
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from xaynet_tpu.server.rest import RestServer
+from xaynet_tpu.server.settings import TenancySettings
+from xaynet_tpu.telemetry.registry import get_registry
+from xaynet_tpu.tenancy.lifecycle import (
+    DRAINED,
+    QUARANTINED,
+    SERVING,
+    LifecycleError,
+    TenantLifecycle,
+    get_manager,
+    install_manager,
+    note_round_failed,
+)
+from xaynet_tpu.tenancy.pool import PagePool
+from xaynet_tpu.tenancy.registry import TenantContext, TenantRegistry
+from xaynet_tpu.tenancy.scheduler import TenantScheduler, get_scheduler
+
+
+def _sample(name, labels=None):
+    return get_registry().sample_value(name, labels or {}) or 0.0
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+# --------------------------------------------------------------------------
+# PagePool: fragmentation gauge + between-round compaction
+# --------------------------------------------------------------------------
+
+
+def test_pool_fragmentation_tracks_free_run_shred():
+    pool = PagePool(page_bytes=4096, slab_pages=8)
+    assert pool.fragmentation() == 0.0  # nothing leased: one 8-page run
+    a = pool.lease_host("fr", (4096,), np.uint8)
+    b = pool.lease_host("fr", (2 * 4096,), np.uint8)
+    c = pool.lease_host("fr", (4096,), np.uint8)
+    pool.release(b)  # hole of 2 between a and c; tail run of 4 behind c
+    frag = pool.fragmentation()
+    assert abs(frag - (1.0 - 4 / 6)) < 1e-9
+    pool.release(a)
+    pool.release(c)
+    assert pool.fragmentation() == 0.0  # all free runs coalesce back
+
+
+def test_pool_compact_slides_migratable_leases_and_coalesces_free_space():
+    pool = PagePool(page_bytes=4096, slab_pages=8)
+    a = pool.lease_host("cp", (4096,), np.uint8)  # page 0 (barrier, packed)
+    b = pool.lease_host("cp", (2 * 4096,), np.uint8)  # pages 1-2
+    c = pool.lease_host("cp", (4096,), np.uint8)  # page 3
+    c.array[:] = 7
+    pool.release(b)  # the hole c will slide into
+    swapped = []
+    pool.set_migrator(c, swapped.append)
+    moved = pool.compact()
+    assert moved == 1
+    assert c.offset == 1  # slid down against the barrier at page 0
+    # the holder's reference swap happened under the pool lock, bytes intact
+    assert len(swapped) == 1 and swapped[0] is c.array
+    assert (c.array == 7).all()
+    assert pool.fragmentation() == 0.0
+    # the free list is the complement of the packed runs: one lease can now
+    # take every remaining page as a single contiguous run
+    big = pool.lease_host("cp", (6 * 4096,), np.uint8)
+    assert pool.stats()["slabs"] == 1
+    for lease in (a, c, big):
+        pool.release(lease)
+    assert pool.balanced("cp")
+
+
+def test_pool_compact_never_crosses_immovable_barriers():
+    pool = PagePool(page_bytes=4096, slab_pages=8)
+    a = pool.lease_host("bar", (4096,), np.uint8)  # page 0
+    b = pool.lease_host("bar", (4096,), np.uint8)  # page 1: NO migrator
+    c = pool.lease_host("bar", (4096,), np.uint8)  # page 2
+    pool.release(a)  # free page 0, below the barrier
+    pool.set_migrator(c, lambda view: None)
+    assert pool.compact() == 0  # b blocks the slide; c is already packed
+    assert b.offset == 1 and c.offset == 2
+    pool.release(b)
+    pool.release(c)
+
+
+def test_pool_compact_trims_trailing_free_slabs():
+    pool = PagePool(page_bytes=4096, slab_pages=2)
+    a = pool.lease_host("tr", (4096,), np.uint8)  # slab 0
+    big = pool.lease_host("tr", (3 * 4096,), np.uint8)  # dedicated slab 1
+    assert pool.stats()["slabs"] == 2
+    pool.release(big)
+    pool.compact()
+    assert pool.stats()["slabs"] == 1  # the fully-free trailing slab dropped
+    pool.release(a)
+    assert pool.balanced("tr")
+
+
+def test_pool_set_migrator_is_noop_on_released_leases():
+    pool = PagePool(page_bytes=4096, slab_pages=4)
+    a = pool.lease_host("rel", (4096,), np.uint8)
+    pool.release(a)
+    pool.set_migrator(a, lambda view: None)
+    assert a.migrator is None  # a released lease never becomes migratable
+
+
+def test_pool_reclaim_counts_only_the_releases_it_won():
+    # regression: a GC finalizer releasing a straggler between reclaim's
+    # outstanding() snapshot and its force-release must not be counted by
+    # reclaim too — xaynet_pool_reclaimed_total moves only for won releases
+    pool = PagePool(page_bytes=4096, slab_pages=4)
+    a = pool.lease_host("race", (4096,), np.uint8)
+    pool.lease_host("race", (4096,), np.uint8)
+    before = _sample("xaynet_pool_reclaimed_total", {"tenant": "race"})
+    snapshot = pool.outstanding
+
+    def racing_outstanding(tenant=None):
+        leases = snapshot(tenant)
+        pool.release(a)  # the finalizer wins lease a after the snapshot
+        return leases
+
+    pool.outstanding = racing_outstanding
+    try:
+        assert pool.reclaim("race") == 1  # only the lease this call released
+    finally:
+        del pool.__dict__["outstanding"]
+    assert _sample("xaynet_pool_reclaimed_total", {"tenant": "race"}) == before + 1
+    assert pool.balanced("race")
+    assert pool.reclaim("race") == 0  # idempotent once everything returned
+
+
+# --------------------------------------------------------------------------
+# TenantScheduler: weights, tiers, demotion
+# --------------------------------------------------------------------------
+
+
+def _grant_order(sched, first, second):
+    """Start two waiters (``first`` queues before ``second``) against a
+    fully-held scheduler, free one slot, and report who got granted."""
+    order = []
+
+    def waiter(tenant, owner):
+        sched.acquire(tenant, owner)
+        order.append(tenant)
+
+    owners = {t: sched.new_owner() for t in (first, second)}
+    ta = threading.Thread(target=waiter, args=(first, owners[first]), daemon=True)
+    ta.start()
+    assert _wait_for(lambda: len(sched._waiting) == 1)
+    tb = threading.Thread(target=waiter, args=(second, owners[second]), daemon=True)
+    tb.start()
+    assert _wait_for(lambda: len(sched._waiting) == 2)
+    return order, owners
+
+
+def test_scheduler_weighted_deficit_round_robin():
+    sched = TenantScheduler(max_inflight=1)
+    holder = sched.new_owner()
+    # history: a served once, b served twice — unweighted, a is owed next
+    sched.acquire("a", holder)
+    sched.release(holder)
+    for _ in range(2):
+        sched.acquire("b", holder)
+        sched.release(holder)
+    sched.set_weight("b", 4.0)  # weighted deficits: a = 1/1, b = 2/4
+    sched.acquire("hold", holder)
+    order, owners = _grant_order(sched, "a", "b")
+    sched.release(holder)
+    # b's weighted deficit is smaller, so b beats both FIFO and raw counts
+    assert _wait_for(lambda: order == ["b"])
+    sched.release(owners["b"])
+    assert _wait_for(lambda: order == ["b", "a"])
+    for owner in owners.values():
+        sched.release_owner(owner)
+    sched.release_owner(holder)
+
+
+def test_scheduler_tier_dominates_deficit():
+    sched = TenantScheduler(max_inflight=1)
+    holder = sched.new_owner()
+    sched.acquire("hold", holder)
+    sched.set_tier("a", 1)  # lower tier number wins; b stays at default 0
+    order, owners = _grant_order(sched, "a", "b")
+    sched.release(holder)
+    assert _wait_for(lambda: order == ["b"])
+    sched.release(owners["b"])
+    assert _wait_for(lambda: order == ["b", "a"])
+    for owner in owners.values():
+        sched.release_owner(owner)
+    sched.release_owner(holder)
+
+
+def test_scheduler_demotion_yields_slots_and_counts_transitions():
+    sched = TenantScheduler(max_inflight=1)
+    before = _sample("xaynet_tenant_sched_demotions_total", {"tenant": "a"})
+    sched.set_demoted("a", True)
+    sched.set_demoted("a", True)  # idempotent: no second transition
+    assert _sample("xaynet_tenant_sched_demotions_total", {"tenant": "a"}) == before + 1
+    assert sched.demoted() == {"a"}
+    holder = sched.new_owner()
+    sched.acquire("hold", holder)
+    order, owners = _grant_order(sched, "a", "b")
+    sched.release(holder)
+    # the demoted tenant only wins a slot once no healthy tenant waits
+    assert _wait_for(lambda: order == ["b"])
+    sched.release(owners["b"])
+    assert _wait_for(lambda: order == ["b", "a"])
+    sched.set_demoted("a", False)
+    assert sched.demoted() == set()
+    sched.forget_tenant("a")
+    assert "a" not in sched.split()
+    for owner in owners.values():
+        sched.release_owner(owner)
+    sched.release_owner(holder)
+
+
+# --------------------------------------------------------------------------
+# TenantLifecycle: quarantine, drain, onboard — fake clock throughout
+# --------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, start=1000.0):
+        self.now = float(start)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def _lifecycle(builder=None, budget=None, **overrides):
+    settings = dict(
+        enabled=True,
+        admin_token="test-admin-token",
+        drain_timeout_s=5.0,
+        quarantine_failures=2,
+        quarantine_reset_s=30.0,
+    )
+    settings.update(overrides)
+    clock = _Clock()
+    registry = TenantRegistry()
+    routes = {}
+    lc = TenantLifecycle(
+        TenancySettings(**settings),
+        registry,
+        routes,
+        budget=budget,
+        builder=builder,
+        clock=clock,
+    )
+    return lc, clock, registry, routes
+
+
+def test_quarantine_trips_sheds_and_probe_readmits():
+    lc, clock, _, _ = _lifecycle()
+    lc.mark_serving("q1")
+    before = _sample("xaynet_tenant_quarantines_total", {"tenant": "q1"})
+    assert _sample("xaynet_tenant_state", {"tenant": "q1"}) == 2.0
+    lc.note_round_failed("q1")
+    assert lc.state("q1") == SERVING  # one failure is below the threshold
+    assert lc.admit("q1") == (True, None)
+    lc.note_round_failed("q1")  # threshold reached: breaker opens
+    assert lc.state("q1") == QUARANTINED
+    assert _sample("xaynet_tenant_state", {"tenant": "q1"}) == 3.0
+    assert _sample("xaynet_tenant_quarantines_total", {"tenant": "q1"}) == before + 1
+    assert "q1" in get_scheduler().demoted()
+    admitted, retry_after = lc.admit("q1")
+    assert not admitted and retry_after == 30.0
+    # outcomes while the breaker is OPEN are self-inflicted (we shed the
+    # traffic): neither failures nor degraded closes move the quarantine
+    lc.note_round_failed("q1")
+    lc.note_round_completed("q1")
+    assert lc.state("q1") == QUARANTINED
+    assert lc.admit("q1")[0] is False
+    # after the reset window the next admit IS the half-open probe
+    clock.advance(31.0)
+    assert lc.admit("q1") == (True, None)
+    lc.note_round_failed("q1")  # failed probe: re-opened, no double count
+    assert lc.admit("q1")[0] is False
+    assert _sample("xaynet_tenant_quarantines_total", {"tenant": "q1"}) == before + 1
+    clock.advance(31.0)
+    lc.note_round_completed("q1")  # completed probe lifts the quarantine
+    assert lc.state("q1") == SERVING
+    assert lc.admit("q1") == (True, None)
+    assert "q1" not in get_scheduler().demoted()
+    get_scheduler().forget_tenant("q1")
+
+
+def test_slo_transitions_drive_scheduler_demotion():
+    lc, _, _, _ = _lifecycle()
+    lc.mark_serving("s1")
+    lc.slo_transition("s1", "round_wall", "page")
+    assert "s1" in get_scheduler().demoted()
+    lc.slo_transition("s1", "ingest", "page")
+    lc.slo_transition("s1", "round_wall", "warn")  # one SLO still pages
+    assert "s1" in get_scheduler().demoted()
+    lc.slo_transition("s1", "ingest", "ok")  # both recovered
+    assert "s1" not in get_scheduler().demoted()
+    lc.slo_transition("ghost", "round_wall", "page")  # unknown tenant: no-op
+    assert "ghost" not in get_scheduler().demoted()
+    engine = types.SimpleNamespace(hook=None)
+    engine.set_transition_hook = lambda hook: setattr(engine, "hook", hook)
+    lc.install_slo_hook(engine)
+    assert engine.hook == lc.slo_transition
+    get_scheduler().forget_tenant("s1")
+
+
+def test_mark_serving_applies_configured_weights_and_tiers():
+    lc, _, _, _ = _lifecycle(weights="w1=2.5", tiers="w1=1")
+    lc.mark_serving("w1")
+    sched = get_scheduler()
+    assert sched._weights["w1"] == 2.5
+    assert sched._tiers["w1"] == 1
+    sched.forget_tenant("w1")
+
+
+def test_reconfigure_requires_a_live_tenant():
+    lc, _, _, _ = _lifecycle()
+    with pytest.raises(LifecycleError):
+        lc.reconfigure("nobody", weight=2.0)
+    lc.mark_serving("r1")
+    assert lc.reconfigure("r1", weight=2.0, tier=1) == {
+        "tenant": "r1",
+        "weight": 2.0,
+        "tier": 1,
+    }
+    with pytest.raises(ValueError):
+        lc.reconfigure("r1", weight=0.0)  # scheduler rejects it
+    get_scheduler().forget_tenant("r1")
+
+
+def test_offboard_graceful_on_round_boundary():
+    async def run():
+        lc, _, registry, routes = _lifecycle()
+
+        async def forever():
+            await asyncio.sleep(3600)
+
+        ctx = TenantContext(tenant="d1", settings=None)
+        registry.add(ctx)
+        ctx.task = asyncio.create_task(forever())
+        routes["d1"] = object()
+        lc.mark_serving("d1")
+        before = _sample("xaynet_tenant_drains_total", {"outcome": "graceful"})
+        verdicts = []
+
+        async def close_round():
+            await asyncio.sleep(0.12)
+            verdicts.append(lc.admit("d1"))  # draining: mutating traffic shed
+            lc.note_round_completed("d1")  # the in-flight round's boundary
+
+        closer = asyncio.create_task(close_round())
+        result = await lc.offboard("d1")
+        await closer
+        assert verdicts == [(False, None)]
+        assert result == {"tenant": "d1", "state": DRAINED, "outcome": "graceful"}
+        assert lc.state("d1") == DRAINED
+        assert _sample("xaynet_tenant_drains_total", {"outcome": "graceful"}) == before + 1
+        assert "d1" not in routes and registry.get("d1") is None
+        assert ctx.task.cancelled()
+        with pytest.raises(LifecycleError):
+            await lc.offboard("d1")  # already drained: not drainable
+
+    asyncio.run(run())
+
+
+def test_offboard_timeout_hard_kills_and_tears_down():
+    async def run():
+        class _Budget:
+            def __init__(self):
+                self.discharged = []
+
+            def held(self, tenant):
+                return 3
+
+            def discharge(self, tenant, amount):
+                self.discharged.append((tenant, amount))
+
+        budget = _Budget()
+        lc, clock, registry, routes = _lifecycle(budget=budget, drain_timeout_s=1.0)
+        closed = []
+
+        async def forever():
+            await asyncio.sleep(3600)
+
+        async def pipeline_stop():
+            closed.append("pipeline")
+
+        ctx = TenantContext(
+            tenant="d2",
+            settings=None,
+            request_tx=types.SimpleNamespace(close=lambda: closed.append("tx")),
+            pipeline=types.SimpleNamespace(stop=pipeline_stop),
+            metrics=types.SimpleNamespace(close=lambda: closed.append("metrics")),
+        )
+        registry.add(ctx)
+        ctx.task = asyncio.create_task(forever())
+        routes["d2"] = object()
+        lc.mark_serving("d2")
+        before = _sample("xaynet_tenant_drains_total", {"outcome": "timeout"})
+
+        async def burn_the_budget():
+            await asyncio.sleep(0.12)
+            clock.advance(10.0)  # no boundary ever arrives; budget expires
+
+        burner = asyncio.create_task(burn_the_budget())
+        result = await lc.offboard("d2")
+        await burner
+        assert result["outcome"] == "timeout"
+        assert lc.state("d2") == DRAINED
+        assert _sample("xaynet_tenant_drains_total", {"outcome": "timeout"}) == before + 1
+        # hard teardown ran in full: task, channel, pipeline, metrics, budget
+        assert ctx.task.cancelled()
+        assert set(closed) == {"tx", "pipeline", "metrics"}
+        assert budget.discharged == [("d2", 3)]
+        assert "d2" not in routes and registry.get("d2") is None
+
+    asyncio.run(run())
+
+
+def test_onboard_builds_admits_and_rolls_back_on_failure():
+    async def run():
+        cell = {}
+        admit_during_build = []
+
+        async def builder(tenant):
+            if tenant == "boom":
+                raise RuntimeError("builder exploded")
+            # while the build runs the tenant is onboarding: traffic sheds
+            admit_during_build.append(cell["lc"].admit(tenant))
+
+            async def machine_run():
+                return None
+
+            ctx = TenantContext(
+                tenant=tenant,
+                settings=None,
+                machine=types.SimpleNamespace(run=machine_run),
+            )
+            cell["registry"].add(ctx)
+            return ctx, ("routes", tenant)
+
+        lc, _, registry, routes = _lifecycle(builder=builder)
+        cell["lc"], cell["registry"] = lc, registry
+        result = await lc.onboard("n1")
+        assert admit_during_build == [(False, None)]
+        assert result["tenant"] == "n1" and result["state"] == SERVING
+        assert result["onboard_s"] >= 0.0
+        assert routes["n1"] == ("routes", "n1")
+        assert lc.state("n1") == SERVING
+        with pytest.raises(LifecycleError):
+            await lc.onboard("n1")  # already live
+        with pytest.raises(ValueError):
+            await lc.onboard("NOT A VALID ID")
+        # builder failure rolls the state back so a retry can run
+        with pytest.raises(RuntimeError):
+            await lc.onboard("boom")
+        assert lc.state("boom") == DRAINED
+        assert "boom" not in lc.states()
+        # the rolled-back id onboards cleanly on the next attempt
+        result = await lc.onboard("boom2")
+        assert result["state"] == SERVING
+        for tenant in ("n1", "boom2"):
+            await asyncio.sleep(0)  # let the (instantly-returning) machines finish
+            await lc.offboard(tenant)
+        get_scheduler().forget_tenant("n1")
+        get_scheduler().forget_tenant("boom2")
+
+        lc_nobuilder, _, _, _ = _lifecycle(builder=None)
+        with pytest.raises(LifecycleError):
+            await lc_nobuilder.onboard("n2")
+
+    asyncio.run(run())
+
+
+def test_module_forwarders_are_noops_without_a_manager():
+    previous = get_manager()
+    install_manager(None)
+    note_round_failed("nobody")  # must not raise
+    lc, _, _, _ = _lifecycle()
+    lc.mark_serving("fw")
+    install_manager(lc)
+    try:
+        assert get_manager() is lc
+        note_round_failed("fw")
+        assert lc.breaker("fw")._failures == 1
+    finally:
+        install_manager(previous)
+        get_scheduler().forget_tenant("fw")
+
+
+# --------------------------------------------------------------------------
+# /admin/tenants REST surface
+# --------------------------------------------------------------------------
+
+
+def _admin(server, method, path, body=b"", token="test-admin-token"):
+    headers = {} if token is None else {"x-admin-token": token}
+    return asyncio.run(server._admin_route(method, path, body, headers))
+
+
+def test_admin_route_disabled_without_lifecycle_or_token():
+    lc, _, _, _ = _lifecycle()
+    # no lifecycle, or no token: 404, indistinguishable from unknown routes
+    no_lc = RestServer(fetcher=None, handler=None, admin_token="x")
+    assert _admin(no_lc, "GET", "/admin/tenants")[0] == 404
+    no_token = RestServer(fetcher=None, handler=None, lifecycle=lc, admin_token="")
+    assert _admin(no_token, "GET", "/admin/tenants")[0] == 404
+
+
+def test_admin_route_auth_and_status_mapping():
+    async def run():
+        async def builder(tenant):
+            async def machine_run():
+                return None
+
+            ctx = TenantContext(
+                tenant=tenant,
+                settings=None,
+                machine=types.SimpleNamespace(run=machine_run),
+            )
+            return ctx, ("routes", tenant)
+
+        lc, _, _, routes = _lifecycle(builder=builder)
+        server = RestServer(
+            fetcher=None, handler=None, lifecycle=lc, admin_token="test-admin-token"
+        )
+        auth = {"x-admin-token": "test-admin-token"}
+        # authentication: missing and wrong tokens are both 401
+        assert (await server._admin_route("GET", "/admin/tenants", b"", {}))[0] == 401
+        wrong = {"x-admin-token": "nope"}
+        assert (await server._admin_route("GET", "/admin/tenants", b"", wrong))[0] == 401
+        # onboard + states + reconfigure + drain, through the admin surface
+        status, payload, ctype, _ = await server._admin_route(
+            "POST", "/admin/tenants", json.dumps({"tenant": "rt1"}).encode(), auth
+        )
+        assert status == 200 and json.loads(payload)["state"] == SERVING
+        assert "rt1" in routes
+        status, payload, _, _ = await server._admin_route(
+            "GET", "/admin/tenants", b"", auth
+        )
+        assert json.loads(payload)["tenants"]["rt1"] == SERVING
+        status, payload, _, _ = await server._admin_route(
+            "POST", "/admin/tenants/rt1", json.dumps({"weight": 2.0}).encode(), auth
+        )
+        assert status == 200 and json.loads(payload)["weight"] == 2.0
+        # bad inputs: 400 for malformed ids and bodies, 409 for bad states
+        assert (
+            await server._admin_route(
+                "POST", "/admin/tenants", json.dumps({"tenant": "BAD ID"}).encode(), auth
+            )
+        )[0] == 400
+        assert (
+            await server._admin_route("POST", "/admin/tenants", b"{not json", auth)
+        )[0] == 400
+        assert (
+            await server._admin_route(
+                "POST", "/admin/tenants", json.dumps({"tenant": "rt1"}).encode(), auth
+            )
+        )[0] == 409
+        assert (
+            await server._admin_route("POST", "/admin/tenants/ghost", b"{}", auth)
+        )[0] == 409
+        assert (await server._admin_route("DELETE", "/admin/tenants", b"", auth))[0] == 404
+        status, payload, _, _ = await server._admin_route(
+            "DELETE", "/admin/tenants/rt1", b"", auth
+        )
+        # the fake builder never registered a machine context, so the drain
+        # is immediately graceful
+        assert status == 200 and json.loads(payload)["outcome"] == "graceful"
+        assert "rt1" not in routes
+        get_scheduler().forget_tenant("rt1")
+
+    asyncio.run(run())
+
+
+def test_route_sheds_unadmitted_tenants_with_429():
+    async def run():
+        class _FakeLifecycle:
+            def __init__(self):
+                self.admit_calls = []
+
+            def admit(self, tenant):
+                self.admit_calls.append(tenant)
+                return False, 7.5
+
+        lifecycle = _FakeLifecycle()
+        server = RestServer(
+            fetcher=None,
+            handler=None,
+            lifecycle=lifecycle,
+            admin_token="x",
+            default_tenant="dq",
+        )
+        status, _, _, extra = await server._route("POST", "/message", b"", {})
+        assert status == 429
+        assert extra == {"Retry-After": "8"}  # ceil(7.5), at least 1
+        assert lifecycle.admit_calls == ["dq"]  # bare routes = default tenant
+        # read-only polls are never shed: a draining tenant's in-flight
+        # round still needs its participants to fetch params
+        lifecycle.admit_calls.clear()
+        status, _, _, _ = await server._route("GET", "/params", b"", {})
+        assert status != 429
+        assert lifecycle.admit_calls == []
+
+    asyncio.run(run())

@@ -429,3 +429,45 @@ def test_two_tenants_concurrent_rounds_byte_identical_to_controls():
         assert pool.balanced(tenant), (
             f"tenant {tenant} leaked pool leases: {pool.page_table(tenant)}"
         )
+
+
+# --------------------------------------------------------------------------
+# Tenant-scoped durable storage: the round checkpoint never crosses tenants
+# --------------------------------------------------------------------------
+
+
+def test_round_checkpoint_storage_is_tenant_scoped(tmp_path):
+    """Regression for the elastic-lifecycle PR: the PR-4 mid-round
+    checkpoint must live under the tenant's scoped key space (file backends
+    get a ``t-<tenant>`` subtree, redis a ``t:<tenant>:`` prefix), so a
+    tenant's kill-and-restore can never resume into ANOTHER tenant's
+    round — the resume entry point for tenant B sees no checkpoint at all
+    when only tenant A saved one."""
+    from xaynet_tpu.resilience import checkpoint as ckpt_mod
+    from xaynet_tpu.server.runner import init_store
+
+    async def run():
+        settings = _tenant_settings(32, GroupType.INTEGER)
+        settings.storage.coordinator = "file"
+        settings.storage.model_dir = str(tmp_path)
+        store_a = init_store(settings, "alpha")
+        store_b = init_store(settings, "beta")
+        blob = b"alpha mid-update aggregate"
+        await store_a.coordinator.set_round_checkpoint(blob)
+        # tenant A round-trips its own checkpoint; tenant B's restart sees
+        # nothing to resume — checkpoint.load degrades it to a round restart
+        assert await store_a.coordinator.round_checkpoint() == blob
+        assert await store_b.coordinator.round_checkpoint() is None
+        assert await ckpt_mod.load(store_b) is None
+        # on disk the blob lives only under alpha's t- subtree
+        holders = {
+            p.relative_to(tmp_path).parts[0]
+            for p in tmp_path.rglob("*")
+            if p.is_file() and p.read_bytes() == blob
+        }
+        assert holders == {"t-alpha"}
+        # deletion is scoped the same way
+        await store_a.coordinator.delete_round_checkpoint()
+        assert await store_a.coordinator.round_checkpoint() is None
+
+    asyncio.run(run())

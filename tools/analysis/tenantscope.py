@@ -30,11 +30,29 @@ Two legs:
    the whitelist below is the closed set of places pages enter
    circulation, each reviewed to give them back (unmask release, ring
    close, GC-finalizer backstop, Idle reclaim).
+
+3. **admin-path lock discipline** — the elastic lifecycle manager
+   (``tenancy/lifecycle.py``, §23) mutates the registry, the live routing
+   dict, the scheduler's weight/tier/demotion maps and the pool from the
+   admin REST path *while rounds are running*. Every such mutation must
+   be lexically inside a ``with``/``async with`` on a lock-named
+   attribute (``*_lock`` / ``*_cond``), or carry a ``# guarded-by:
+   <lock>`` annotation recording which lock the callee takes internally.
+   Functions named ``*_locked`` are exempt (the caller holds the lock —
+   the repo-wide convention).
+
+4. **sanctioned migration sites** — compaction moves a page run and
+   swaps ``lease.array`` under the pool lock, so every place *outside*
+   ``xaynet_tpu/tenancy/`` that registers or clears a lease's
+   ``migrator`` (``set_migrator`` calls, ``.migrator`` stores) must
+   appear in :data:`MIGRATION_SITES` with a rationale proving the buffer
+   is quiescent when movable and pinned before any access.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
 from .callgraph import CallGraph, iter_owned_nodes
 from .core import Finding, suppressed, suppression_pending_rationale
@@ -61,6 +79,68 @@ LEASE_SITES: dict[tuple[str, str], str] = {
 
 _PREFIXES = ("xaynet_tpu/server/", "xaynet_tpu/parallel/")
 
+# -- leg 3: admin-path lock discipline ----------------------------------------
+
+_ADMIN_FILE = "xaynet_tpu/tenancy/lifecycle.py"
+# attribute calls that mutate shared registry/routes/scheduler/pool/budget
+# state from the admin path
+_ADMIN_MUTATORS = frozenset({
+    "add", "remove", "pop", "set_weight", "set_tier", "set_demoted",
+    "forget_tenant", "reclaim", "compact", "discharge",
+})
+# accepts dotted guards ("pool._lock") unlike the locks pass — here the
+# annotation is a review record of which lock the CALLEE takes internally
+_GUARDED_ANNOT_RE = re.compile(r"#\s*guarded-by:\s*([\w.\-]+)")
+_LOCK_NAME_RE = re.compile(r"(_lock|_cond)$")
+
+# -- leg 4: sanctioned migration sites ----------------------------------------
+
+# (file, function qualname) -> rationale proving the quiescence protocol.
+MIGRATION_SITES: dict[tuple[str, str], str] = {
+    ("xaynet_tpu/parallel/streaming.py", "_StagingRing.__init__"):
+        "free ring buffers opt in at construction, before any is handed "
+        "out; acquire() pins before the first access",
+    ("xaynet_tpu/parallel/streaming.py", "_StagingRing.acquire"):
+        "clears the migrator THROUGH the pool lock before reading "
+        "lease.array — an in-flight buffer is an immovable barrier",
+    ("xaynet_tpu/parallel/streaming.py", "_StagingRing.release"):
+        "re-registers the migrator as the buffer re-enters the free "
+        "queue (quiescent again)",
+}
+
+
+def _lockish_with_held(fn_node) -> dict[int, bool]:
+    """node id -> whether the node sits lexically inside a ``with`` /
+    ``async with`` whose context expression's terminal name looks like a
+    lock (``*_lock`` / ``*_cond``)."""
+    held_at: dict[int, bool] = {}
+
+    def terminal_name(expr):
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Call):
+            return terminal_name(expr.func)
+        return None
+
+    def walk(node, held: bool):
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    name = terminal_name(item.context_expr)
+                    if name and _LOCK_NAME_RE.search(name):
+                        child_held = True
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # separate FuncInfo, analyzed on its own
+            held_at[id(child)] = child_held
+            walk(child, child_held)
+
+    held_at[id(fn_node)] = False
+    walk(fn_node, False)
+    return held_at
+
 
 def _qualname_chain(qualname: str) -> list[str]:
     parts = qualname.split(".")
@@ -86,15 +166,64 @@ def _has_tenant_key(fi) -> bool:
     return False
 
 
+def _admin_lock_findings(fi) -> list[Finding]:
+    """Leg 3: every admin-path mutation in the lifecycle manager must be
+    under a lock-named ``with`` or carry a ``# guarded-by:`` record."""
+    if fi.name == "__init__" or fi.name.endswith("_locked"):
+        return []
+    findings: list[Finding] = []
+    held_at = _lockish_with_held(fi.node)
+    for node in iter_owned_nodes(fi.node):
+        mutator = None
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ADMIN_MUTATORS
+        ):
+            mutator = f"{node.func.attr}()"
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and isinstance(node.value, ast.Attribute)
+        ):
+            mutator = f"{node.value.attr}[...]"
+        if mutator is None:
+            continue
+        if held_at.get(id(node), False):
+            continue
+        line = fi.file.line(node.lineno)
+        if _GUARDED_ANNOT_RE.search(line):
+            continue
+        if suppressed("tenant", line):
+            continue
+        msg = (
+            f"admin-path mutation ({mutator}) in '{fi.qualname}' outside "
+            "any lock-named 'with' block — the lifecycle mutates live "
+            "routing/registry/scheduler/pool state while rounds run "
+            "(DESIGN §23); hold the lock, or annotate the line "
+            "'# guarded-by: <lock>' naming the lock the callee takes, or "
+            "'# lint: tenant-ok: <rationale>'"
+        )
+        if suppression_pending_rationale("tenant", line):
+            msg += " [suppression present but missing its rationale]"
+        findings.append(Finding("tenant", fi.file.rel, node.lineno, msg))
+    return findings
+
+
 def run(graph: CallGraph) -> list[Finding]:
     findings: list[Finding] = []
     for fi in graph.symbols.functions:
         rel = fi.file.rel
         if rel.startswith("xaynet_tpu/tenancy/"):
+            if rel == _ADMIN_FILE:
+                findings.extend(_admin_lock_findings(fi))
             continue  # the pool/scheduler themselves
         in_scope_tree = rel.startswith(_PREFIXES)
         lease_allowed = any(
             (rel, q) in LEASE_SITES for q in _qualname_chain(fi.qualname)
+        )
+        migration_allowed = any(
+            (rel, q) in MIGRATION_SITES for q in _qualname_chain(fi.qualname)
         )
         tenant_keyed: bool | None = None  # computed lazily per function
         for node in iter_owned_nodes(fi.node):
@@ -115,6 +244,38 @@ def run(graph: CallGraph) -> list[Finding]:
                     "invariant (DESIGN §19); add the site to "
                     "tools/analysis/tenantscope.py LEASE_SITES with its "
                     "paired release, or annotate "
+                    "'# lint: tenant-ok: <rationale>'"
+                )
+                if suppression_pending_rationale("tenant", line):
+                    msg += " [suppression present but missing its rationale]"
+                findings.append(Finding("tenant", rel, node.lineno, msg))
+                continue
+            # -- leg 4: sanctioned migration sites (whole xaynet_tpu tree)
+            migration = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "set_migrator"
+            ):
+                migration = "set_migrator()"
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr == "migrator"
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+            ):
+                migration = ".migrator ="
+            if migration is not None and not migration_allowed:
+                line = fi.file.line(node.lineno)
+                if suppressed("tenant", line):
+                    continue
+                msg = (
+                    f"compaction migrator toggled ({migration}) outside the "
+                    f"sanctioned sites (in '{fi.qualname}') — a migrator "
+                    "marks a page run MOVABLE, so the site must prove the "
+                    "buffer is quiescent while registered and pinned before "
+                    "any access (DESIGN §23); add the site to "
+                    "tools/analysis/tenantscope.py MIGRATION_SITES with its "
+                    "quiescence rationale, or annotate "
                     "'# lint: tenant-ok: <rationale>'"
                 )
                 if suppression_pending_rationale("tenant", line):

@@ -68,7 +68,7 @@ BYTES_UNIT = "bytes/fold"
 # than the best (fastest) prior round tolerates.
 ROUND_WALL_PREFIX = "round wall"
 ROUND_WALL_UNIT = "s/round"
-LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT, ROUND_WALL_UNIT})
+LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT, ROUND_WALL_UNIT, "s/onboard"})
 # multi-tenant interleaved fold (bench.py:multi_tenant, DESIGN §19): two
 # tenants' concurrent folds through the paged pool + tenant scheduler,
 # in 25M-equivalent updates/s (tenant B's updates scaled by its length
@@ -81,6 +81,12 @@ TENANT_PREFIX = "multi-tenant interleaved fold"
 # packed-vs-legacy comparison but not gated (bytes/update depends on the
 # negotiated wire mix, which the soak varies deliberately).
 INGRESS_PREFIX = "ingress accepted updates"
+# tenant-lifecycle family (tools/bench_tenancy.py, DESIGN §23): seconds
+# from the authenticated admin onboard POST to the new tenant's first
+# completed round. LOWER is better; cold/warm/density legs are distinct
+# metric names so each gates against its own history.
+ONBOARD_PREFIX = "tenant onboard-to-first-round latency"
+ONBOARD_UNIT = "s/onboard"
 # families gated independently when no explicit --metric-prefix is given
 DEFAULT_FAMILIES = (
     (HEADLINE_PREFIX, HEADLINE_UNIT),
@@ -91,6 +97,7 @@ DEFAULT_FAMILIES = (
     (TENANT_PREFIX, HEADLINE_UNIT),
     (ROUND_WALL_PREFIX, ROUND_WALL_UNIT),
     (INGRESS_PREFIX, HEADLINE_UNIT),
+    (ONBOARD_PREFIX, ONBOARD_UNIT),
 )
 
 

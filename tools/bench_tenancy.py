@@ -1,0 +1,236 @@
+"""Tenant lifecycle bench: onboard-to-first-round latency, cold vs warm.
+
+The elastic-lifecycle headline (docs/DESIGN.md §23): how long from the
+authenticated ``POST /admin/tenants`` until the new tenant's FIRST round
+completes. Two legs against real coordinator processes:
+
+- **cold vs warm** — two successive processes share one
+  ``XAYNET_CALIB_CACHE`` file. The first onboard races the fold-kernel
+  calibration inside its first round and persists the verdict; the second
+  process loads it during the onboard warm step, so its first round
+  resolves the kernel from the cache instead of probing. The warm latency
+  must come in measurably below cold — that delta IS the PR-18 cache
+  earning its keep on the onboarding path.
+- **density** — inside the warm process, additional tenants are onboarded
+  while the earlier ones keep serving; the LAST onboard's latency is the
+  headline at density N. This is the number an operator actually waits on
+  when adding a tenant to a busy pool.
+
+``--append-history`` appends one record per leg to BENCH_HISTORY.jsonl;
+``tools/bench_gate.py`` gates the family LOWER-IS-BETTER (unit
+``s/onboard``).
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/bench_tenancy.py [--density 3]
+      [--port 18457] [--append-history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from soak import (  # noqa: E402
+    TENANT_GROUPS,
+    TENANT_MODEL_LENS,
+    _drive_tenant_rounds,
+    _http_status,
+    _tenant_config,
+)
+
+HISTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_HISTORY.jsonl"
+)
+ADMIN_TOKEN = "bench-tenancy-admin-token"
+
+
+def _wait_listening(port: int, proc) -> None:
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError("coordinator exited during startup")
+            time.sleep(0.25)
+    raise RuntimeError("coordinator did not start listening in 90s")
+
+
+def _stop(proc) -> None:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def _onboard_to_first_round(port: int, tenant: str, model_len: int) -> dict:
+    """POST the onboard, then drive the tenant's first round; the headline
+    latency is admin-POST-to-round-close, the number the operator waits on."""
+    t0 = time.perf_counter()
+    status, body = _http_status(
+        f"http://127.0.0.1:{port}/admin/tenants",
+        method="POST",
+        body=json.dumps({"tenant": tenant}).encode(),
+        headers={"x-admin-token": ADMIN_TOKEN, "content-type": "application/json"},
+        timeout=300,
+    )
+    if status != 200:
+        raise RuntimeError(f"onboard {tenant} failed: {status} {body[:200]!r}")
+    _drive_tenant_rounds(
+        f"http://127.0.0.1:{port}/t/{tenant}", 1, model_len, None, f"bench {tenant}"
+    )
+    total_s = time.perf_counter() - t0
+    return {"total_s": total_s, "onboard_s": float(json.loads(body)["onboard_s"])}
+
+
+def run(args) -> list[dict]:
+    # t2+ deliberately reuse the integer group: the bench measures the
+    # LIFECYCLE path (build + calib warm + admit + first round), and the
+    # power2 group's slow big-int unmask would drown that signal
+    spec = {"t0": (TENANT_MODEL_LENS[0], TENANT_GROUPS[0])}
+    for i in range(1, args.density + 1):
+        spec[f"t{i}"] = (600 + 120 * i, "integer")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    results: list[dict] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        env["XAYNET_CALIB_CACHE"] = os.path.join(tmp, "calib.json")
+        cfg_dir = os.path.join(tmp, "tenants")
+        os.makedirs(cfg_dir)
+        for tid, (mlen, group) in spec.items():
+            with open(os.path.join(cfg_dir, f"{tid}.toml"), "w") as f:
+                f.write(
+                    _tenant_config(
+                        args.port, mlen, group, os.path.join(tmp, f"models-{tid}")
+                    )
+                )
+        base_cfg = os.path.join(tmp, "multi.toml")
+        with open(base_cfg, "w") as f:
+            f.write(
+                _tenant_config(
+                    args.port, spec["t0"][0], spec["t0"][1],
+                    os.path.join(tmp, "models-multi"),
+                )
+                + "\n[tenancy]\nenabled = true\n"
+                + 'tenants = "t0"\n'
+                + f'config_dir = "{cfg_dir}"\n'
+                + f'admin_token = "{ADMIN_TOKEN}"\n'
+            )
+
+        def boot(log_name: str):
+            log = open(os.path.join(tmp, log_name), "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", base_cfg],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
+            _wait_listening(args.port, proc)
+            return proc, log
+
+        # --- leg 1: cold onboard (no calibration cache on disk yet) --------
+        proc, log = boot("cold.log")
+        try:
+            cold = _onboard_to_first_round(args.port, "t1", spec["t1"][0])
+        finally:
+            _stop(proc)
+            log.close()
+        results.append(
+            {
+                "metric": "tenant onboard-to-first-round latency (cold)",
+                "value": round(cold["total_s"], 4),
+                "unit": "s/onboard",
+                "onboard_s": round(cold["onboard_s"], 4),
+                "tenants": 1,
+            }
+        )
+        # --- leg 2: warm onboard (fresh process, persisted verdicts) -------
+        if not os.path.exists(env["XAYNET_CALIB_CACHE"]):
+            raise RuntimeError(
+                "cold run persisted no calibration verdicts; the warm leg "
+                "would silently re-measure cold"
+            )
+        proc, log = boot("warm.log")
+        try:
+            warm = _onboard_to_first_round(args.port, "t1", spec["t1"][0])
+            results.append(
+                {
+                    "metric": "tenant onboard-to-first-round latency (warm)",
+                    "value": round(warm["total_s"], 4),
+                    "unit": "s/onboard",
+                    "onboard_s": round(warm["onboard_s"], 4),
+                    "tenants": 1,
+                }
+            )
+            # --- leg 3: density — the Nth onboard joins a busy pool --------
+            last = None
+            for i in range(2, args.density + 1):
+                last = _onboard_to_first_round(args.port, f"t{i}", spec[f"t{i}"][0])
+            if last is not None:
+                results.append(
+                    {
+                        "metric": (
+                            "tenant onboard-to-first-round latency "
+                            f"(warm @density {args.density})"
+                        ),
+                        "value": round(last["total_s"], 4),
+                        "unit": "s/onboard",
+                        "onboard_s": round(last["onboard_s"], 4),
+                        "tenants": args.density,
+                    }
+                )
+        finally:
+            _stop(proc)
+            log.close()
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int, default=18457)
+    ap.add_argument(
+        "--density",
+        type=int,
+        default=3,
+        help="tenants serving when the last onboard is measured (default 3)",
+    )
+    ap.add_argument(
+        "--append-history",
+        action="store_true",
+        help=f"append one record per leg to {os.path.basename(HISTORY)}",
+    )
+    args = ap.parse_args()
+    if args.density < 1:
+        ap.error("--density must be >= 1")
+    results = run(args)
+    cold = results[0]["value"]
+    warm = results[1]["value"]
+    print(
+        json.dumps(
+            {
+                "legs": results,
+                "warm_speedup": round(cold / warm, 3) if warm else None,
+                "cpus": os.cpu_count(),
+            }
+        )
+    )
+    if args.append_history:
+        ts = time.time()
+        with open(HISTORY, "a") as f:
+            for rec in results:
+                f.write(json.dumps({"ts": ts, "cpus": os.cpu_count(), **rec}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,12 +173,17 @@ def _tenant_config(port: int, model_len: int, group: str, model_dir: str) -> str
 
 
 def _drive_tenant_rounds(
-    url: str, rounds: int, model_len: int, expected: bytes | None, label: str
+    url: str, rounds: int, model_len: int, expected: bytes | None, label: str,
+    round_timeout_s: float = 120.0,
 ) -> bytes:
     """Drive ``rounds`` PET rounds against ``url`` (a bare or /t/<tenant>
     base) with DETERMINISTIC participant models; every completed round's
     global model must equal ``expected`` (byte-identity vs the
-    single-tenant control) when given. Returns the last model bytes."""
+    single-tenant control) when given. Returns the last model bytes.
+
+    Each round gets ``round_timeout_s`` of wall clock — a tick-count bound
+    would burn out in seconds once every participant is awaiting, racing
+    the coordinator's first-round unmask compile."""
     from fractions import Fraction
 
     import numpy as np
@@ -216,12 +221,15 @@ def _drive_tenant_rounds(
             p = Participant(url, keys=k, scalar=Fraction(1, 3))
             p.set_model(np.full(model_len, 0.25 * (i + 1), dtype=np.float32))
             parts.append(p)
-        for _ in range(600):
+        deadline = time.time() + round_timeout_s
+        closed = False
+        while time.time() < deadline:
             for p in parts:
                 p.tick()
             if fetch_params().seed.as_bytes() != seed:
+                closed = True
                 break
-        else:
+        if not closed:
             raise RuntimeError(f"{label}: round {completed + 1} did not complete")
         model_bytes = fetch_model()
         if expected is not None and model_bytes != expected:
@@ -368,6 +376,299 @@ def run_multi_tenant_soak(args) -> None:
                 "rounds_per_tenant": args.rounds,
                 "byte_identical": True,
                 "wall_s": round(time.perf_counter() - t0, 2),
+                "rss_kb": rss,
+                "console": console,
+            }
+        )
+    )
+
+
+def _http_status(url: str, method: str = "GET", body: bytes | None = None,
+                 headers: dict | None = None, timeout: float = 60.0):
+    """One HTTP call returning (status, body bytes) — 4xx/5xx included
+    (urllib raises on those; the churn soak ASSERTS on 401/404/429)."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    req = Request(url, data=body, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except HTTPError as err:
+        return err.code, err.read()
+
+
+def _metric_value(port: int, family: str, labels: dict) -> float | None:
+    """One sample off the live /metrics endpoint (Prometheus text)."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    for line in text.splitlines():
+        if not line.startswith(family + "{") and line.split(" ")[0] != family:
+            continue
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                return None
+    return None
+
+
+def run_tenant_churn_soak(args) -> None:
+    """--tenant-churn: the elastic-lifecycle chaos soak (docs/DESIGN.md §23).
+
+    One multi-tenant coordinator boots with t0+t1; t1's storage is
+    fault-injected (``t:t1:...`` sites) so its rounds fail and trip the
+    quarantine, while t0 drives rounds CONTINUOUSLY — every one
+    byte-identical to its single-tenant control. Mid-run, t2 is onboarded
+    over the authenticated /admin/tenants API, completes a
+    control-identical round, and is drained back out; the soak then pins:
+    quarantined t1 sheds with 429 and auto-readmits via the half-open
+    probe round, admin auth rejects bad tokens, the drained tenant's
+    routes 404, and its pool pages are ZERO after teardown."""
+    import socket
+    import threading
+
+    # t2 stays on the integer group: its round is driven ONCE against a
+    # wall-clock-bounded driver mid-churn, and the power2 group's slow
+    # big-int unmask can outrun that budget on a loaded CI host
+    spec = {
+        "t0": (TENANT_MODEL_LENS[0], TENANT_GROUPS[0]),
+        "t1": (TENANT_MODEL_LENS[1], TENANT_GROUPS[1]),
+        "t2": (900, "integer"),
+    }
+    admin_token = "churn-soak-admin-token"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # fault ONE tenant's storage: t1's Idle delete_dicts eats the whole
+    # 4-attempt retry budget on rounds 1 AND 2 (max=8 faults), so exactly
+    # two rounds fail — the lifecycle quarantine threshold below. The
+    # budget is then SPENT: the half-open probe round's storage works and
+    # t1 earns its way back in. is_ready is NOT faulted (readiness checks
+    # stay truthful, and their recorded successes reset the storage
+    # breaker between rounds — the STORAGE breaker never opens; only the
+    # lifecycle quarantine does).
+    env["XAYNET_FAULT_PLAN"] = (
+        "seed=11;t:t1:storage.coordinator.delete_dicts:error,rate=1.0,max=8"
+    )
+
+    def wait_listening(port: int, proc) -> None:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    return
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError("coordinator exited during startup")
+                time.sleep(0.25)
+        raise RuntimeError("coordinator did not start listening in 90s")
+
+    def stop(proc) -> None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    t0_wall = time.perf_counter()
+    controls: dict[str, bytes] = {}
+    events: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_dir = os.path.join(tmp, "tenants")
+        os.makedirs(cfg_dir)
+        for tid, (mlen, group) in spec.items():
+            with open(os.path.join(cfg_dir, f"{tid}.toml"), "w") as f:
+                f.write(
+                    _tenant_config(
+                        args.port, mlen, group, os.path.join(tmp, f"models-{tid}")
+                    )
+                )
+        # --- single-tenant control runs (fault plan OFF) -------------------
+        control_env = {k: v for k, v in env.items() if k != "XAYNET_FAULT_PLAN"}
+        for tid, (mlen, group) in spec.items():
+            clog_path = os.path.join(tmp, f"control-{tid}.log")
+            log = open(clog_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "xaynet_tpu.server.runner",
+                 "-c", os.path.join(cfg_dir, f"{tid}.toml")],
+                env=control_env, stdout=log, stderr=subprocess.STDOUT,
+            )
+            try:
+                wait_listening(args.port, proc)
+                controls[tid] = _drive_tenant_rounds(
+                    f"http://127.0.0.1:{args.port}", 1, mlen, None, f"control {tid}"
+                )
+            except BaseException:
+                log.flush()
+                with open(clog_path) as lf:
+                    print("".join(lf.readlines()[-40:]), file=sys.stderr)
+                raise
+            finally:
+                stop(proc)
+                log.close()
+            print(f"control {tid}: model {len(controls[tid])} bytes", file=sys.stderr)
+        # --- the churn run: boot with t0 + t1, t2 arrives later ------------
+        base_cfg = os.path.join(tmp, "multi.toml")
+        with open(base_cfg, "w") as f:
+            f.write(
+                _tenant_config(
+                    args.port, spec["t0"][0], spec["t0"][1],
+                    os.path.join(tmp, "models-multi"),
+                )
+                + "\n[tenancy]\nenabled = true\n"
+                + 'tenants = "t0,t1"\n'
+                + f'config_dir = "{cfg_dir}"\n'
+                + f'admin_token = "{admin_token}"\n'
+                + "drain_timeout_s = 60.0\n"
+                + "quarantine_failures = 2\n"
+                + "quarantine_reset_s = 5.0\n"
+            )
+        log_path = os.path.join(tmp, "multi.log")
+        log = open(log_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", base_cfg],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        base = f"http://127.0.0.1:{args.port}"
+        try:
+            wait_listening(args.port, proc)
+            # -- t0: continuous control-identical rounds, the whole time ----
+            stop_t0 = threading.Event()
+            t0_rounds = [0]
+            t0_errors: list[BaseException] = []
+
+            def drive_t0() -> None:
+                try:
+                    while not stop_t0.is_set():
+                        _drive_tenant_rounds(
+                            f"{base}/t/t0", 1, spec["t0"][0], controls["t0"],
+                            "tenant t0",
+                        )
+                        t0_rounds[0] += 1
+                except BaseException as err:
+                    t0_errors.append(err)
+
+            t0_thread = threading.Thread(target=drive_t0, daemon=True)
+            t0_thread.start()
+
+            # -- t1 trips the quarantine under storage faults ---------------
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if _metric_value(args.port, "xaynet_tenant_state", {"tenant": "t1"}) == 3.0:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("t1 never reached the quarantined state")
+            events.append("t1 quarantined")
+            # quarantined ingress sheds with 429 + Retry-After
+            status, _ = _http_status(
+                f"{base}/t/t1/message", method="POST", body=b"probe", timeout=10
+            )
+            if status != 429:
+                raise RuntimeError(f"quarantined POST expected 429, got {status}")
+            events.append("t1 sheds 429")
+            if _metric_value(args.port, "xaynet_tenant_quarantines_total",
+                             {"tenant": "t1"}) != 1.0:
+                raise RuntimeError("xaynet_tenant_quarantines_total{t1} != 1")
+
+            # -- auto-readmission: the half-open probe round completes ------
+            probe_deadline = time.time() + 180
+            readmitted = False
+            while time.time() < probe_deadline:
+                try:
+                    _drive_tenant_rounds(
+                        f"{base}/t/t1", 1, spec["t1"][0], controls["t1"],
+                        "tenant t1 probe", round_timeout_s=25.0,
+                    )
+                    readmitted = True
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            if not readmitted:
+                raise RuntimeError("t1 probe round never completed (no readmission)")
+            state_deadline = time.time() + 30
+            while time.time() < state_deadline:
+                if _metric_value(args.port, "xaynet_tenant_state", {"tenant": "t1"}) == 2.0:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("t1 not back to serving after the probe round")
+            events.append("t1 readmitted (control-identical probe round)")
+
+            # -- admin auth: constant-time token, bad/missing -> 401 --------
+            for hdrs in ({}, {"x-admin-token": "wrong"}):
+                status, _ = _http_status(
+                    f"{base}/admin/tenants", headers=hdrs, timeout=10
+                )
+                if status != 401:
+                    raise RuntimeError(f"admin without valid token: got {status}")
+            events.append("admin auth rejects bad tokens")
+
+            # -- onboard t2 mid-run over the admin API ----------------------
+            status, body = _http_status(
+                f"{base}/admin/tenants", method="POST",
+                body=json.dumps({"tenant": "t2"}).encode(),
+                headers={"x-admin-token": admin_token,
+                         "content-type": "application/json"},
+                timeout=180,
+            )
+            if status != 200:
+                raise RuntimeError(f"onboard t2 failed: {status} {body[:200]!r}")
+            onboard_s = json.loads(body).get("onboard_s")
+            _drive_tenant_rounds(
+                f"{base}/t/t2", 1, spec["t2"][0], controls["t2"], "tenant t2"
+            )
+            events.append(f"t2 onboarded ({onboard_s}s) + control-identical round")
+
+            # -- drain t2 back out; zero leaked pages, routes 404 -----------
+            status, body = _http_status(
+                f"{base}/admin/tenants/t2", method="DELETE",
+                headers={"x-admin-token": admin_token}, timeout=120,
+            )
+            if status != 200:
+                raise RuntimeError(f"offboard t2 failed: {status} {body[:200]!r}")
+            outcome = json.loads(body).get("outcome")
+            pages = _metric_value(
+                args.port, "xaynet_pool_pages", {"arena": "host", "tenant": "t2"}
+            )
+            if pages not in (None, 0.0):
+                raise RuntimeError(f"t2 leaked {pages} host pool pages after drain")
+            status, _ = _http_status(f"{base}/t/t2/params", timeout=10)
+            if status != 404:
+                raise RuntimeError(f"drained t2 routes expected 404, got {status}")
+            events.append(f"t2 drained ({outcome}); zero leaked pages; routes 404")
+
+            # -- t0 survived the whole churn, byte-identical throughout -----
+            stop_t0.set()
+            t0_thread.join(timeout=300)
+            if t0_errors:
+                raise t0_errors[0]
+            if t0_rounds[0] < 1:
+                raise RuntimeError("t0 completed no rounds during the churn")
+            console = _scrape_console(args.port, require_tenants=["t0", "t1"])
+            rss = _rss_kb(proc.pid)
+        except BaseException:
+            log.flush()
+            with open(log_path) as lf:
+                tail = lf.readlines()[-60:]
+            print("".join(tail), file=sys.stderr)
+            raise
+        finally:
+            stop(proc)
+            log.close()
+    print(
+        json.dumps(
+            {
+                "churn_events": events,
+                "t0_rounds_byte_identical": t0_rounds[0],
+                "wall_s": round(time.perf_counter() - t0_wall, 2),
                 "rss_kb": rss,
                 "console": console,
             }
@@ -709,6 +1010,15 @@ def main() -> None:
         "control run (docs/DESIGN.md §19)",
     )
     ap.add_argument(
+        "--tenant-churn",
+        action="store_true",
+        help="elastic-lifecycle chaos soak: onboard/drain tenants mid-run "
+        "over the authenticated /admin/tenants API while one tenant's "
+        "storage is fault-injected into quarantine and back; surviving "
+        "tenants stay byte-identical to their single-tenant controls and "
+        "the drained tenant leaks zero pool pages (docs/DESIGN.md §23)",
+    )
+    ap.add_argument(
         "--faults",
         type=int,
         default=None,
@@ -727,6 +1037,18 @@ def main() -> None:
     args = ap.parse_args()
     if args.wire_ingest and not args.device_kernel:
         ap.error("--wire-ingest requires --device-kernel")
+    if args.tenant_churn:
+        if (
+            args.tenants is not None
+            or args.edges is not None
+            or args.dropout is not None
+            or args.stragglers is not None
+            or args.faults is not None
+        ):
+            ap.error("--tenant-churn is a separate soak (it owns its own "
+                     "tenant set and fault plan)")
+        run_tenant_churn_soak(args)
+        return
     if args.tenants is not None:
         if args.tenants < 2:
             ap.error("--tenants must be >= 2 (one tenant is the ordinary soak)")

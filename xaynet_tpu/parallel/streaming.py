@@ -237,6 +237,15 @@ def _release_ring_leases(pool, leases: list) -> None:
         pool.release(lease)
 
 
+def _ring_migrator(view) -> None:
+    """Compaction swap hook for a QUIESCENT ring lease (docs/DESIGN.md
+    §23): the pool already rewrote ``lease.array`` to the migrated view
+    under its lock, and the ring's free queue holds the lease object —
+    not the stale array — so there is no ring state left to fix up.
+    Module-level so a lease never strongly references its ring (the GC
+    finalizer backstop must still fire for abandoned pipelines)."""
+
+
 class _StagingRing:
     """Fixed pool of pre-allocated host staging buffers.
 
@@ -249,6 +258,13 @@ class _StagingRing:
     byte-planar included) page exactly like the shard accumulators, so
     concurrent tenants' rings pack into one arena. ``close()`` releases
     the leases; a GC finalizer backstops abandoned pipelines.
+
+    Free buffers opt into pool compaction (§23): while a lease sits in
+    the free queue it carries a migrator, so another tenant's
+    between-round defrag may slide it; ``acquire`` clears the migrator
+    through the pool lock BEFORE reading the array, making every
+    in-flight buffer an immovable barrier, and ``release`` re-registers
+    it on the way back in.
     """
 
     def __init__(self, size: int, shape: tuple, dtype, gauge=None,
@@ -260,8 +276,10 @@ class _StagingRing:
         self._gauge = gauge
         self._pool = pool if pool is not None else get_pool()
         self._leases = [self._pool.lease_host(tenant, shape, dtype) for _ in range(size)]
+        self._inflight: dict[int, object] = {}  # id(view) -> lease, checked-out buffers
         for lease in self._leases:
-            self._free.put(lease.array)
+            self._pool.set_migrator(lease, _ring_migrator)
+            self._free.put(lease)
         # abandoned pipelines (dropped without close()) give their pages
         # back when the ring is collected — by then nothing can alias them
         weakref.finalize(self, _release_ring_leases, self._pool, self._leases)
@@ -272,7 +290,13 @@ class _StagingRing:
         _release_ring_leases(self._pool, self._leases)
 
     def acquire(self, timeout: float | None = None) -> np.ndarray:
-        buf = self._free.get(timeout=timeout)
+        lease = self._free.get(timeout=timeout)
+        # pin first, read second: set_migrator takes the pool lock, so a
+        # compaction mid-flight either finished (lease.array is the new
+        # view) or will now skip this lease entirely
+        self._pool.set_migrator(lease, None)
+        buf = lease.array
+        self._inflight[id(buf)] = lease
         STAGING_DEPTH.inc()
         if self._gauge is not None:
             self._gauge.inc()
@@ -282,7 +306,11 @@ class _StagingRing:
         STAGING_DEPTH.dec()
         if self._gauge is not None:
             self._gauge.dec()
-        self._free.put(buf)
+        lease = self._inflight.pop(id(buf), None)
+        if lease is None:
+            return  # close() raced a late release; the lease is gone
+        self._pool.set_migrator(lease, _ring_migrator)
+        self._free.put(lease)
 
 
 def _worker_main(ref: "weakref.ref[StreamingAggregator]", q: queue_mod.Queue) -> None:

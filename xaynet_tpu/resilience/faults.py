@@ -16,7 +16,9 @@ Spec grammar (``;``-separated clauses)::
     seed=42;storage.coordinator.*:error,nth=2/5;streaming.fold:error,max=1
     ingest.worker.*:error,rate=0.1;storage.models.*:latency,delay=0.05
 
-Each clause is ``<site-glob>:<kind>`` plus ``key=value`` options:
+Each clause is ``<site-glob>:<kind>`` plus ``key=value`` options (the LAST
+colon separates glob from kind, so tenant-scoped globs like
+``t:t1:storage.*`` work unquoted):
 
 - kind ``error``   — raise (transient by default; ``perm=1`` for permanent)
 - kind ``latency`` — delay the call by ``delay`` seconds (default 0.05)
@@ -122,7 +124,11 @@ class FaultPlan:
             if clause.startswith("seed="):
                 seed = int(clause[5:])
                 continue
-            pattern, sep, rest = clause.partition(":")
+            # rpartition, not partition: tenant-scoped site globs carry
+            # colons of their own ("t:<id>:storage.*", docs/DESIGN.md §23),
+            # while kinds and options never do — the LAST colon is always
+            # the glob/kind separator
+            pattern, sep, rest = clause.rpartition(":")
             if not sep:
                 raise ValueError(f"fault clause {clause!r}: expected '<site-glob>:<kind>[,...]'")
             parts = rest.split(",")

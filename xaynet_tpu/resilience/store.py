@@ -51,11 +51,13 @@ class _ResilientProxy:
         component: str,
         policy: RetryPolicy,
         breaker: CircuitBreaker,
+        site_prefix: str = "",
     ):
         self._inner = inner
         self._component = component
         self._policy = policy
         self._breaker = breaker
+        self._site_prefix = site_prefix
         self._wrapped: dict[str, object] = {}
 
     def __getattr__(self, name: str):
@@ -68,7 +70,7 @@ class _ResilientProxy:
         return cached
 
     def _wrap(self, name: str, method):
-        site = f"storage.{self._component}.{name}"
+        site = f"{self._site_prefix}storage.{self._component}.{name}"
         probe = name in _PROBE_METHODS
 
         async def attempt(*args, **kwargs):
@@ -140,13 +142,20 @@ class ResilientStore(Store):
         breaker_threshold: int = 5,
         breaker_reset_s: float = 10.0,
         breaker_half_open_max: int = 1,
+        tenant: str = "default",
     ):
         self.inner = inner
         policy = policy if policy is not None else RetryPolicy()
+        # tenant-scoped fault/breaker sites (docs/DESIGN.md §23): a
+        # non-default tenant's storage sites are "t:<id>:storage.*", so a
+        # chaos plan can fault ONE tenant's backend while its neighbours'
+        # stores stay byte-identical; the default tenant keeps the flat
+        # site names every existing spec targets
+        prefix = "" if tenant == "default" else f"t:{tenant}:"
 
         def breaker(component: str) -> CircuitBreaker:
             return CircuitBreaker(
-                component=component,
+                component=f"{prefix}{component}",
                 failure_threshold=breaker_threshold,
                 reset_timeout_s=breaker_reset_s,
                 half_open_max=breaker_half_open_max,
@@ -154,12 +163,17 @@ class ResilientStore(Store):
 
         super().__init__(
             coordinator=_ResilientProxy(
-                inner.coordinator, "coordinator", policy, breaker("coordinator")
+                inner.coordinator, "coordinator", policy, breaker("coordinator"),
+                site_prefix=prefix,
             ),
-            models=_ResilientProxy(inner.models, "models", policy, breaker("models")),
+            models=_ResilientProxy(
+                inner.models, "models", policy, breaker("models"),
+                site_prefix=prefix,
+            ),
             trust_anchor=(
                 _ResilientProxy(
-                    inner.trust_anchor, "trust_anchor", policy, breaker("trust_anchor")
+                    inner.trust_anchor, "trust_anchor", policy,
+                    breaker("trust_anchor"), site_prefix=prefix,
                 )
                 if inner.trust_anchor is not None
                 else None
@@ -167,7 +181,7 @@ class ResilientStore(Store):
         )
 
 
-def wrap_store(store: Store, resilience) -> Store:
+def wrap_store(store: Store, resilience, tenant: str = "default") -> Store:
     """Wrap per ``ResilienceSettings`` (identity when disabled / already wrapped)."""
     if not resilience.enabled or isinstance(store, ResilientStore):
         return store
@@ -179,4 +193,5 @@ def wrap_store(store: Store, resilience) -> Store:
         breaker_threshold=resilience.breaker_threshold,
         breaker_reset_s=resilience.breaker_reset_s,
         breaker_half_open_max=resilience.breaker_half_open_max,
+        tenant=tenant,
     )

@@ -71,11 +71,26 @@ def _severity_class(severity: str) -> str:
     return severity if severity in ("warn", "page") else "ok"
 
 
+def _lifecycle_states() -> dict:
+    """Tenant lifecycle states for the table column (§23); empty when no
+    lifecycle manager is installed (single-tenant deployments)."""
+    from ..tenancy.lifecycle import get_manager  # lazy: keeps import cycle out
+
+    manager = get_manager()
+    if manager is None:
+        return {}
+    try:
+        return manager.states()
+    except Exception:
+        return {}
+
+
 def _tenant_rows(server) -> str:
-    """Per-tenant state table rows: phase, round, last wall + sparkline,
-    degraded flag and the three SLO burn rates."""
+    """Per-tenant state table rows: lifecycle, phase, round, last wall +
+    sparkline, degraded flag and the three SLO burn rates."""
     timeline = get_timeline()
     engine = get_engine()
+    lifecycle = _lifecycle_states()
     routes_by_tenant = {"default": server._default_routes, **server.tenants}
     # tenants the timeline folded but the REST layer doesn't route (edge
     # processes, tests driving the fold directly) still get a row
@@ -121,10 +136,20 @@ def _tenant_rows(server) -> str:
             )
             for slo in SLOS
         )
+        state = lifecycle.get(tenant, "")
+        state_cell = (
+            '<span class="{cls}">{st}</span>'.format(
+                cls="ok" if state == "serving" else "warn" if state == "onboarding" else "page",
+                st=_esc(state),
+            )
+            if state
+            else '<span class="muted">-</span>'
+        )
         rows.append(
-            "<tr><td>{t}</td><td>{p}</td><td>{r}</td><td>{w}</td>"
+            "<tr><td>{t}</td><td>{lc}</td><td>{p}</td><td>{r}</td><td>{w}</td>"
             '<td class="spark">{s}</td><td>{o}</td><td>{d}</td>{b}</tr>'.format(
                 t=_esc(tenant),
+                lc=state_cell,
                 p=_esc(phase),
                 r=_esc(round_id),
                 w=_esc(wall),
@@ -181,7 +206,7 @@ def _pool_section(server) -> str:
     from ..tenancy.pool import get_pool  # lazy: single-tenant paths never pay it
 
     stats = get_pool().stats()
-    leases = stats.get("leases") or {}
+    leases = stats.get("tenant_leases") or {}
     lease_rows = "".join(
         "<tr><td>{t}</td><td>{n}</td></tr>".format(t=_esc(t), n=_esc(n))
         for t, n in sorted(leases.items())
@@ -194,6 +219,7 @@ def _pool_section(server) -> str:
             "host_pages_in_use",
             "host_pages_free",
             "device_pages_in_use",
+            "fragmentation",
         )
         if k in stats
     )
@@ -349,7 +375,7 @@ def render_statusz(server) -> str:
         ),
         _alerts_section(),
         "<h2>tenants</h2>",
-        "<table><tr><th>tenant</th><th>phase</th><th>round</th><th>wall</th>"
+        "<table><tr><th>tenant</th><th>lifecycle</th><th>phase</th><th>round</th><th>wall</th>"
         "<th>recent walls</th><th>overlap</th><th>windows</th>{bh}</tr>{rows}</table>".format(
             bh=burn_headers, rows=_tenant_rows(server)
         ),

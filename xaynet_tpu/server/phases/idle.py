@@ -86,6 +86,15 @@ class Idle(PhaseState):
 
             gc.collect()
             pool.reclaim(self.shared.tenant)
+        # between-round defrag (docs/DESIGN.md §23): Idle is the only phase
+        # where this tenant holds no transient fold views, so compaction's
+        # memmove-under-lock cannot race this tenant's kernels. Other
+        # tenants' live runs are protected by the migrator protocol (only
+        # quiescent, migrator-registered leases move).
+        ten = getattr(self.shared.settings, "tenancy", None)
+        if ten is not None and ten.defrag_enabled:
+            if pool.fragmentation() > ten.defrag_threshold:
+                pool.compact()
 
     def _gen_round_keypair(self) -> None:
         keys = EncryptKeyPair.generate()

@@ -89,6 +89,11 @@ class Unmask(PhaseState):
             # the round is complete: feed the controller's hysteresis (full
             # vs degraded is derived from the per-phase window outcomes)
             self.shared.round_ctl.round_completed()
+        # tenant lifecycle (docs/DESIGN.md §23): a completed round is the
+        # breaker's probe success (quarantine lift) and a drain boundary
+        from ...tenancy import lifecycle as _lifecycle
+
+        _lifecycle.note_round_completed(self.shared.tenant)
         from .idle import Idle
 
         return Idle(self.shared)

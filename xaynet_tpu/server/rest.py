@@ -77,7 +77,7 @@ _UNTRACED_PATHS = {
 # permanent registry child
 _KNOWN_PATHS = {"/message", "/params", "/sums", "/seeds", "/model",
                 "/health", "/healthz", "/metrics", "/statusz", "/alerts",
-                "/edge/round", "/edge/envelope"}
+                "/edge/round", "/edge/envelope", "/admin/tenants"}
 _KNOWN_METHODS = {"GET", "POST", "HEAD", "PUT", "DELETE", "OPTIONS", "PATCH"}
 
 
@@ -92,6 +92,9 @@ class RestServer:
         edge_api=None,
         health_extra=None,
         tenants: Optional[dict[str, TenantRoutes]] = None,
+        lifecycle=None,
+        admin_token: str = "",
+        default_tenant: str = "",
     ):
         # `registry` selects what GET /metrics renders. Hot-path modules
         # (request queue, message pipeline, kernel profiling, dispatcher)
@@ -110,6 +113,13 @@ class RestServer:
         # `tenants` maps tenant id -> TenantRoutes for /t/<tenant>/...
         # routing; the positional args above stay the DEFAULT tenant (and
         # the bare legacy routes). None = single-tenant, as before.
+        # `lifecycle` (tenancy.TenantLifecycle) turns the tenant set
+        # elastic: mutating traffic consults its admission verdicts
+        # (draining / quarantined tenants shed with 429) and `admin_token`
+        # enables the authenticated /admin/tenants surface (constant-time
+        # compare, like the edge tier; "" keeps it fully disabled).
+        # `default_tenant` is the real id behind the bare legacy routes so
+        # lifecycle admission applies to them too.
         self.fetcher = fetcher
         self.handler = handler
         self.pipeline = pipeline
@@ -122,7 +132,15 @@ class RestServer:
             edge_api=edge_api,
             health_extra=health_extra,
         )
-        self.tenants: dict[str, TenantRoutes] = dict(tenants or {})
+        # the lifecycle manager mutates this dict at runtime (onboard
+        # registers, offboard pops) — it must stay the SAME object the
+        # manager holds, so adopt a provided dict instead of copying it
+        self.tenants: dict[str, TenantRoutes] = (
+            tenants if tenants is not None else {}
+        )
+        self.lifecycle = lifecycle
+        self.admin_token = admin_token
+        self.default_tenant = default_tenant
         self.read_timeout = read_timeout  # slow-client defense
         self.registry = registry if registry is not None else get_registry()
         self._started_at = time.monotonic()
@@ -205,6 +223,17 @@ class RestServer:
     async def _route(self, method: str, target: str, body: bytes, headers=None):
         url = urlparse(target)
         headers = headers or {}
+        if url.path == "/admin/tenants" or url.path.startswith("/admin/tenants/"):
+            status, payload, ctype, extra = await self._admin_route(
+                method, url.path, body, headers
+            )
+            self._http_requests.labels(
+                method=method if method in _KNOWN_METHODS else "other",
+                path="/admin/tenants",  # subpath ids stay out of the labels
+                status=status,
+                tenant="",
+            ).inc()
+            return status, payload, ctype, extra
         tenant, path, routes = self._resolve_tenant(url.path)
         if routes is None:
             # unknown tenant: closed-cardinality labels (the id is
@@ -216,6 +245,31 @@ class RestServer:
                 tenant="other",
             ).inc()
             return 404, b"unknown tenant", "text/plain", None
+        # elastic-lifecycle admission (docs/DESIGN.md §23): a draining or
+        # quarantined tenant's MUTATING traffic sheds at the door with 429
+        # (GET polls stay served — a draining tenant's in-flight round
+        # still needs its participants to fetch params/sums/seeds)
+        if (
+            self.lifecycle is not None
+            and method == "POST"
+            and path in ("/message", "/edge/envelope")
+        ):
+            admitted, retry_after = self.lifecycle.admit(
+                tenant or self.default_tenant
+            )
+            if not admitted:
+                extra = (
+                    {"Retry-After": str(max(1, math.ceil(retry_after)))}
+                    if retry_after
+                    else None
+                )
+                self._http_requests.labels(
+                    method=method,
+                    path=path,
+                    status=429,
+                    tenant=tenant,
+                ).inc()
+                return 429, b"tenant not accepting traffic", "text/plain", extra
         # handlers return (status, payload, ctype) or + an extra-headers dict
         if path in _UNTRACED_PATHS:
             result = await self._dispatch(method, path, url.query, body, headers, routes)
@@ -342,6 +396,61 @@ class RestServer:
         except Exception as err:
             logger.exception("request failed: %s %s", method, path)
             return 500, str(err).encode(), "text/plain"
+
+    async def _admin_route(self, method: str, path: str, body: bytes, headers: dict):
+        """The authenticated tenant-lifecycle surface (docs/DESIGN.md §23).
+
+        - ``GET    /admin/tenants``        — lifecycle states of every tenant
+        - ``POST   /admin/tenants``        — onboard: ``{"tenant": "<id>"}``
+        - ``POST   /admin/tenants/<id>``   — reconfigure: ``{"weight", "tier"}``
+        - ``DELETE /admin/tenants/<id>``   — graceful drain (+ hard-kill
+          escalation after the drain budget)
+
+        Fully disabled (404, indistinguishable from an unknown route)
+        unless BOTH a lifecycle manager and a ``[tenancy] admin_token``
+        are configured; the token check is constant-time like the edge
+        tier's. Status mapping: 400 malformed id/body, 401 bad token, 409
+        incompatible lifecycle state (already serving, not drainable).
+        """
+        import hmac
+
+        if self.lifecycle is None or not self.admin_token:
+            return 404, b"not found", "text/plain", None
+        supplied = headers.get("x-admin-token", "")
+        if not hmac.compare_digest(supplied.encode(), self.admin_token.encode()):
+            return 401, b"bad admin token", "text/plain", None
+        from ..tenancy import LifecycleError
+
+        sub = path[len("/admin/tenants"):].strip("/")
+        try:
+            if method == "GET" and not sub:
+                return (
+                    200,
+                    json.dumps({"tenants": self.lifecycle.states()}).encode(),
+                    "application/json",
+                    None,
+                )
+            if method == "POST" and not sub:
+                spec = json.loads(body or b"{}")
+                result = await self.lifecycle.onboard(str(spec.get("tenant", "")))
+                return 200, json.dumps(result).encode(), "application/json", None
+            if method in ("POST", "PATCH") and sub:
+                spec = json.loads(body or b"{}")
+                result = self.lifecycle.reconfigure(
+                    sub, weight=spec.get("weight"), tier=spec.get("tier")
+                )
+                return 200, json.dumps(result).encode(), "application/json", None
+            if method == "DELETE" and sub:
+                result = await self.lifecycle.offboard(sub)
+                return 200, json.dumps(result).encode(), "application/json", None
+            return 404, b"not found", "text/plain", None
+        except LifecycleError as err:
+            return 409, str(err).encode(), "text/plain", None
+        except (ValueError, KeyError) as err:  # bad tenant id / bad JSON body
+            return 400, str(err).encode(), "text/plain", None
+        except Exception as err:
+            logger.exception("admin request failed: %s %s", method, path)
+            return 500, str(err).encode(), "text/plain", None
 
     def _tenancy_health(self) -> dict | None:
         """The multi-tenant /healthz section: registered tenants, each

@@ -261,22 +261,100 @@ def _tenant_settings(base: Settings, tenant: str) -> Settings:
     return cfg
 
 
+async def _build_tenant_context(settings: Settings, tenant: str, budget, registry):
+    """Build ONE tenant's full round pipeline and register it: scoped
+    store, resilient wrapper, metrics bridge, phase machine, handler,
+    fetcher, ingest pipeline and edge api. Shared by the serve_tenants
+    boot loop and the lifecycle manager's runtime onboard — the runtime
+    path builds tenants with exactly the wiring boot-time ones get.
+    Returns ``(TenantContext, TenantRoutes)`` (the machine task is NOT
+    started here; the caller owns task lifetime)."""
+    from ..ingest import IngestPipeline
+    from ..resilience import wrap_store
+    from ..tenancy import TenantContext
+    from .rest import TenantRoutes
+
+    tset = _tenant_settings(settings, tenant)
+    raw_store = init_store(tset, tenant)
+    if tset.storage.backend == "s3":
+        # same startup contract as the single-tenant serve() path:
+        # the bucket must exist before the first model save
+        from ..storage.s3 import S3ModelStorage
+
+        if isinstance(raw_store.models, S3ModelStorage):
+            await raw_store.models.create_bucket()
+    store = wrap_store(raw_store, tset.resilience, tenant=tenant)
+    reporter = (
+        RoundReporter(tset.metrics.round_report_path, tenant=tenant)
+        if tset.metrics.round_report_path
+        else None
+    )
+    metrics = BridgedMetrics(sink=init_metrics(tset), reporter=reporter)
+    initializer = StateMachineInitializer(tset, store, metrics, tenant=tenant)
+    machine, request_tx, events = await initializer.init()
+    handler = PetMessageHandler(
+        events, request_tx, wire_ingest=tset.aggregation.wire_ingest
+    )
+    fetcher = Fetcher(events)
+    pipeline = None
+    if tset.ingest.enabled:
+        pipeline = IngestPipeline(
+            handler, request_tx, events, tset.ingest,
+            tenant=tenant, budget=budget,
+        )
+        await pipeline.start()
+    edge_api = None
+    if tset.edge.enabled:
+        from ..edge.api import EdgeCoordinatorApi
+
+        edge_api = EdgeCoordinatorApi(events, request_tx, token=tset.edge.token)
+    ctx = registry.add(
+        TenantContext(
+            tenant=tenant,
+            settings=tset,
+            store=store,
+            machine=machine,
+            request_tx=request_tx,
+            events=events,
+            handler=handler,
+            fetcher=fetcher,
+            pipeline=pipeline,
+            edge_api=edge_api,
+            metrics=metrics,
+        )
+    )
+    troutes = TenantRoutes(
+        fetcher=fetcher,
+        handler=handler,
+        pipeline=pipeline,
+        edge_api=edge_api,
+    )
+    logger.info(
+        "tenant %s: model_len=%d group=%s (round pipeline up)",
+        tenant,
+        tset.model.length,
+        tset.mask.group_type.name,
+    )
+    return ctx, troutes
+
+
 async def serve_tenants(settings: Settings) -> None:
-    """Multi-tenant coordinator (docs/DESIGN.md §19): one process serves
-    every ``[tenancy] tenants`` id — each a full, independent round
+    """Multi-tenant coordinator (docs/DESIGN.md §19, §23): one process
+    serves every ``[tenancy] tenants`` id — each a full, independent round
     pipeline (scoped store, request channel, ingest, phase machine) —
     over ONE mesh, ONE paged accumulator pool, ONE fold-batch scheduler
     and ONE REST listener routing ``/t/<tenant>/...`` (the first tenant
-    also serves the bare legacy routes)."""
-    from ..ingest import IngestPipeline
-    from ..resilience import wrap_store
+    also serves the bare legacy routes). With ``[tenancy] admin_token``
+    set, the tenant set is ELASTIC: ``/admin/tenants`` onboards, drains
+    and reconfigures tenants at runtime through the lifecycle manager."""
     from ..telemetry import recorder as flight_recorder, tracing as trace
     from ..tenancy import (
         TenantAdmissionBudget,
-        TenantContext,
+        TenantLifecycle,
         TenantRegistry,
         configure_pool,
         configure_scheduler,
+        install_manager,
     )
     from .rest import TenantRoutes
 
@@ -307,67 +385,24 @@ async def serve_tenants(settings: Settings) -> None:
     registry = TenantRegistry()
     routes: dict[str, TenantRoutes] = {}
     for tenant in ten.tenants:
-        tset = _tenant_settings(settings, tenant)
-        raw_store = init_store(tset, tenant)
-        if tset.storage.backend == "s3":
-            # same startup contract as the single-tenant serve() path:
-            # the bucket must exist before the first model save
-            from ..storage.s3 import S3ModelStorage
+        _, troutes = await _build_tenant_context(settings, tenant, budget, registry)
+        routes[tenant] = troutes
 
-            if isinstance(raw_store.models, S3ModelStorage):
-                await raw_store.models.create_bucket()
-        store = wrap_store(raw_store, tset.resilience)
-        reporter = (
-            RoundReporter(tset.metrics.round_report_path, tenant=tenant)
-            if tset.metrics.round_report_path
-            else None
-        )
-        metrics = BridgedMetrics(sink=init_metrics(tset), reporter=reporter)
-        initializer = StateMachineInitializer(tset, store, metrics, tenant=tenant)
-        machine, request_tx, events = await initializer.init()
-        handler = PetMessageHandler(
-            events, request_tx, wire_ingest=tset.aggregation.wire_ingest
-        )
-        fetcher = Fetcher(events)
-        pipeline = None
-        if tset.ingest.enabled:
-            pipeline = IngestPipeline(
-                handler, request_tx, events, tset.ingest,
-                tenant=tenant, budget=budget,
-            )
-            await pipeline.start()
-        edge_api = None
-        if tset.edge.enabled:
-            from ..edge.api import EdgeCoordinatorApi
-
-            edge_api = EdgeCoordinatorApi(events, request_tx, token=tset.edge.token)
-        registry.add(
-            TenantContext(
-                tenant=tenant,
-                settings=tset,
-                store=store,
-                machine=machine,
-                request_tx=request_tx,
-                events=events,
-                handler=handler,
-                fetcher=fetcher,
-                pipeline=pipeline,
-                edge_api=edge_api,
-                metrics=metrics,
-            )
-        )
-        routes[tenant] = TenantRoutes(
-            fetcher=fetcher,
-            handler=handler,
-            pipeline=pipeline,
-            edge_api=edge_api,
-        )
-        logger.info(
-            "tenant %s: model_len=%d group=%s (round pipeline up)",
-            tenant,
-            tset.model.length,
-            tset.mask.group_type.name,
-        )
+    # elastic lifecycle (docs/DESIGN.md §23): the manager owns runtime
+    # onboard/drain over the SAME builder the boot loop used, fault
+    # quarantine fed by the phase close paths, and the SLO->scheduler
+    # demotion feedback loop
+    lifecycle = TenantLifecycle(
+        ten,
+        registry,
+        routes,
+        budget=budget,
+        builder=lambda t: _build_tenant_context(settings, t, budget, registry),
+    )
+    install_manager(lifecycle)
+    lifecycle.install_slo_hook(slo_engine.get_engine())
+    for tenant in registry.ids():
+        lifecycle.mark_serving(tenant)
 
     default = registry.default
     rest = RestServer(
@@ -377,6 +412,9 @@ async def serve_tenants(settings: Settings) -> None:
         pipeline=default.pipeline,
         edge_api=default.edge_api,
         tenants=routes,
+        lifecycle=lifecycle,
+        admin_token=ten.admin_token,
+        default_tenant=default.tenant,
     )
     host, _, port = settings.api.bind_address.partition(":")
     tls = None
@@ -407,14 +445,29 @@ async def serve_tenants(settings: Settings) -> None:
         ctx.task = asyncio.create_task(
             ctx.machine.run(), name=f"machine-{ctx.tenant}"
         )
-    tasks = [ctx.task for ctx in registry.contexts()]
     try:
-        done, _ = await asyncio.wait(
-            [*tasks, stop], return_when=asyncio.FIRST_COMPLETED
-        )
+        # the task set is DYNAMIC under the elastic lifecycle: drained
+        # tenants' tasks get cancelled (that must not stop the process),
+        # onboarded tenants add new ones — so re-derive the watch set from
+        # the registry each pass and only exit when a task belonging to a
+        # still-registered tenant finishes (a machine reaching Shutdown)
+        # or the stop future fires
+        while True:
+            tasks = [c.task for c in registry.contexts() if c.task is not None]
+            done, _ = await asyncio.wait(
+                [*tasks, stop], return_when=asyncio.FIRST_COMPLETED
+            )
+            if stop in done:
+                break
+            live = {c.task for c in registry.contexts()}
+            if any(t in live for t in done):
+                break
     except asyncio.CancelledError:
         pass
     finally:
+        from ..tenancy import install_manager as _uninstall
+
+        _uninstall(None)
         for ctx in registry.contexts():
             if ctx.task is not None:
                 ctx.task.cancel()

@@ -512,6 +512,26 @@ class TenancySettings:
     max_inflight_folds: int = 8  # cross-tenant fold-batch bound
     ingest_capacity: int = 4096  # process-wide admission budget (messages)
     max_share: float = 0.6  # one tenant's ceiling of that budget
+    # -- elastic lifecycle (docs/DESIGN.md §23) -----------------------------
+    admin_token: str = ""  # "" disables /admin/tenants entirely
+    drain_timeout_s: float = 120.0  # graceful-drain budget before hard kill
+    quarantine_failures: int = 3  # consecutive round failures tripping it
+    quarantine_reset_s: float = 60.0  # open -> half-open probe delay
+    defrag_enabled: bool = True  # between-round host-arena compaction
+    defrag_threshold: float = 0.5  # fragmentation tripping a compaction
+    weights: str = ""  # "tenant=weight,..." fair-share weights
+    tiers: str = ""  # "tenant=tier,..." priority tiers (lower wins)
+
+    def tenant_weights(self) -> dict:
+        """Parsed ``weights``: ``{tenant: weight}`` (same string form as
+        ``slo.tenant_round_wall_s`` — env-overridable, mini-TOML-safe)."""
+        return {
+            t: float(v) for t, v in _parse_tenant_pairs(self.weights)
+        }
+
+    def tenant_tiers(self) -> dict:
+        """Parsed ``tiers``: ``{tenant: tier}`` (lower tier wins slots)."""
+        return {t: int(float(v)) for t, v in _parse_tenant_pairs(self.tiers)}
 
     def validate(self) -> None:
         from ..tenancy.registry import validate_tenant_id
@@ -539,6 +559,41 @@ class TenancySettings:
             raise SettingsError("tenancy.ingest_capacity must be >= 1")
         if not (0.0 < self.max_share <= 1.0):
             raise SettingsError("tenancy.max_share must be in (0, 1]")
+        if self.drain_timeout_s <= 0:
+            raise SettingsError("tenancy.drain_timeout_s must be > 0")
+        if self.quarantine_failures < 1:
+            raise SettingsError("tenancy.quarantine_failures must be >= 1")
+        if self.quarantine_reset_s <= 0:
+            raise SettingsError("tenancy.quarantine_reset_s must be > 0")
+        if not (0.0 < self.defrag_threshold <= 1.0):
+            raise SettingsError("tenancy.defrag_threshold must be in (0, 1]")
+        try:
+            weights = self.tenant_weights()
+        except ValueError as e:
+            raise SettingsError("tenancy.weights must be 'tenant=weight,...'") from e
+        for tenant, weight in weights.items():
+            if not tenant or weight <= 0:
+                raise SettingsError(
+                    "tenancy.weights entries need a tenant id and a positive weight"
+                )
+        try:
+            self.tenant_tiers()
+        except ValueError as e:
+            raise SettingsError("tenancy.tiers must be 'tenant=tier,...'") from e
+
+
+def _parse_tenant_pairs(spec: str) -> list:
+    """Split a ``tenant=value,tenant=value`` string into pairs (shared by
+    the tenancy weight/tier parsers and kept string-typed at the settings
+    layer for env-override compatibility)."""
+    out = []
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        tenant, _, value = pair.partition("=")
+        out.append((tenant.strip(), value.strip()))
+    return out
 
 
 @dataclass
@@ -968,6 +1023,24 @@ class Settings:
                     ten_raw.get("ingest_capacity", ten_base.ingest_capacity)
                 ),
                 max_share=float(ten_raw.get("max_share", ten_base.max_share)),
+                admin_token=str(ten_raw.get("admin_token", ten_base.admin_token)),
+                drain_timeout_s=float(
+                    ten_raw.get("drain_timeout_s", ten_base.drain_timeout_s)
+                ),
+                quarantine_failures=int(
+                    ten_raw.get("quarantine_failures", ten_base.quarantine_failures)
+                ),
+                quarantine_reset_s=float(
+                    ten_raw.get("quarantine_reset_s", ten_base.quarantine_reset_s)
+                ),
+                defrag_enabled=bool(
+                    ten_raw.get("defrag_enabled", ten_base.defrag_enabled)
+                ),
+                defrag_threshold=float(
+                    ten_raw.get("defrag_threshold", ten_base.defrag_threshold)
+                ),
+                weights=str(ten_raw.get("weights", ten_base.weights)),
+                tiers=str(ten_raw.get("tiers", ten_base.tiers)),
             ),
             slo=SloSettings(
                 enabled=bool(slo_raw.get("enabled", slo_base.enabled)),

@@ -145,9 +145,19 @@ class SloEngine:
         self._samples: dict[str, deque] = {}  # guarded-by: _lock
         self._active: dict[tuple[str, str], str] = {}  # guarded-by: _lock
         self._ring: deque = deque(maxlen=_RING_SIZE)  # guarded-by: _lock
+        self._transition_hook = None  # set via set_transition_hook
 
     def configure(self, config: SloConfig) -> None:
         self.config = config
+
+    def set_transition_hook(self, hook) -> None:
+        """Install a callback fired on EVERY severity transition —
+        escalations AND de-escalations back to ok — as ``hook(tenant, slo,
+        severity)`` with severity one of ``"" | "warn" | "page"``. The
+        tenancy lifecycle uses this to demote a burn-paging tenant's
+        scheduler priority and restore it when the burn recovers. Called
+        outside the engine lock; must be fail-soft and non-blocking."""
+        self._transition_hook = hook
 
     # -- shed signal: registry deltas ---------------------------------------
 
@@ -207,6 +217,7 @@ class SloEngine:
         with self._lock:
             samples = list(self._samples.get(tenant, ()))
         transitions: list[dict] = []
+        changed: list[tuple[str, str]] = []  # (slo, severity), any direction
         for slo in SLOS:
             budget = cfg.budget_for(slo)
             fast = _burn(samples, now, cfg.fast_window_s, slo, budget)
@@ -242,8 +253,20 @@ class SloEngine:
                 # process via /alerts and /statusz — scrub before they are
                 # ever stored, not at render time
                 self._ring.append(scrub_attrs(entry, "alerts"))
+            changed.append((slo, severity))
             if _SEVERITY_RANK[severity] > _SEVERITY_RANK[previous]:
                 transitions.append(entry)
+        hook = self._transition_hook
+        if hook is not None:
+            for slo, severity in changed:
+                try:
+                    hook(tenant, slo, severity)
+                except Exception:  # fail-soft: feedback must not sink a round
+                    import logging
+
+                    logging.getLogger("xaynet.telemetry").exception(
+                        "slo transition hook failed"
+                    )
         for entry in transitions:
             SLO_ALERTS.labels(slo=entry["slo"], severity=entry["severity"]).inc()
             if entry["severity"] == "page":

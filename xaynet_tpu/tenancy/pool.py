@@ -71,6 +71,20 @@ POOL_RECLAIMED = _registry.counter(
     "abandoned round leaked them past its unmask release).",
     ("tenant",),
 )
+POOL_FRAGMENTATION = _registry.gauge(
+    "xaynet_pool_fragmentation",
+    "Host-arena fragmentation: 1 - largest free run / total free pages "
+    "(0 when the free space is one contiguous run or the arena is full).",
+)
+POOL_COMPACTIONS = _registry.counter(
+    "xaynet_pool_compactions_total",
+    "Between-round host-arena compaction passes run by the Idle phase.",
+)
+POOL_PAGES_MIGRATED = _registry.counter(
+    "xaynet_pool_pages_migrated_total",
+    "Host pages moved by compaction (memmove under the lease lock, page "
+    "tables rewritten atomically).",
+)
 
 DEFAULT_PAGE_BYTES = 1 << 20  # 1 MiB: a few limb-plane columns per page
 DEFAULT_SLAB_PAGES = 64
@@ -83,7 +97,14 @@ class PoolExhausted(RuntimeError):
 @dataclass
 class PageLease:
     """One granted page run. ``array`` is the typed view for host leases
-    (None for device-ledger leases). Release is idempotent."""
+    (None for device-ledger leases). Release is idempotent.
+
+    ``migrator`` opts the lease into compaction: when set, ``compact()``
+    may move the run to a lower offset and calls ``migrator(new_view)``
+    so the holder swaps its reference. Migrators run under the pool lock
+    and must be non-blocking reference swaps — holders register one only
+    while their buffers are quiescent (between rounds). Leases without a
+    migrator are immovable barriers."""
 
     tenant: str
     arena: str  # "host" | "device"
@@ -93,6 +114,7 @@ class PageLease:
     offset: int = -1  # host: first page within the slab
     array: Optional[np.ndarray] = None
     released: bool = field(default=False, repr=False)
+    migrator: Optional[object] = field(default=None, repr=False)
 
 
 class _Slab:
@@ -235,20 +257,37 @@ class PagePool:
         POOL_LEASES.labels(arena=arena, tenant=tenant).inc()
         return lease
 
-    def release(self, lease: PageLease) -> None:
+    def release(self, lease: PageLease) -> bool:
         """Return a lease's pages (idempotent: the GC finalizer backstop
-        and the explicit unmask-path release may both run)."""
+        and the explicit unmask-path release may both run). Returns True
+        only for the call that actually released — callers that account
+        per-release (reclaim) key off this instead of assuming they won
+        the race."""
         with self._lock:
             if lease.released or lease.lease_id not in self._leases:
-                return
+                return False
             lease.released = True
             del self._leases[lease.lease_id]
             self._in_use[lease.arena] -= lease.pages
             if lease.arena == "host" and 0 <= lease.slab < len(self._slabs):
                 self._slabs[lease.slab].give(lease.offset, lease.pages)
         lease.array = None
+        lease.migrator = None
         POOL_PAGES.labels(arena=lease.arena, tenant=lease.tenant).dec(lease.pages)
         POOL_RELEASES.labels(arena=lease.arena, tenant=lease.tenant).inc()
+        return True
+
+    def set_migrator(self, lease: PageLease, migrator) -> None:
+        """Register (or clear, with ``None``) a lease's compaction
+        migrator ATOMICALLY with respect to :meth:`compact`: the toggle
+        takes the lease lock, so a holder that clears the migrator before
+        touching its buffer can never observe a half-migrated run — either
+        a concurrent compaction already finished (``lease.array`` is the
+        new view) or it will treat the lease as an immovable barrier.
+        No-op on released leases."""
+        with self._lock:
+            if not lease.released:
+                lease.migrator = migrator
 
     # -- accounting ---------------------------------------------------------
 
@@ -268,19 +307,23 @@ class PagePool:
     def reclaim(self, tenant: str) -> int:
         """Force-release every lease the tenant still holds — the
         round-boundary backstop for rounds that died before their unmask
-        release. Returns the number reclaimed (0 on the healthy path)."""
-        stale = self.outstanding(tenant)
-        for lease in stale:
-            self.release(lease)
-        if stale:
-            POOL_RECLAIMED.labels(tenant=tenant).inc(len(stale))
+        release. Returns the number reclaimed (0 on the healthy path).
+
+        Idempotent per lease id: a GC finalizer may release a straggler
+        between our ``outstanding()`` snapshot and the force-release, so
+        only leases *this* call actually released count on
+        ``xaynet_pool_reclaimed_total`` (counting the snapshot length
+        double-counted those races)."""
+        won = [lease for lease in self.outstanding(tenant) if self.release(lease)]
+        if won:
+            POOL_RECLAIMED.labels(tenant=tenant).inc(len(won))
             logger.warning(
                 "pool: reclaimed %d leaked lease(s) (%d pages) from tenant %s",
-                len(stale),
-                sum(l.pages for l in stale),
+                len(won),
+                sum(l.pages for l in won),
                 tenant,
             )
-        return len(stale)
+        return len(won)
 
     def page_table(self, tenant: str) -> dict[int, dict]:
         """The tenant's logical->physical mapping: lease id -> arena, slab,
@@ -297,8 +340,106 @@ class PagePool:
                 if l.tenant == tenant
             }
 
+    def fragmentation(self) -> float:
+        """Host-arena fragmentation in [0, 1): ``1 - largest free run /
+        total free pages``. 0 means every free page is reachable as one
+        contiguous run (or there is nothing free to fragment); values near
+        1 mean the free space is shredded into runs too small to serve a
+        large lease. Exported on ``xaynet_pool_fragmentation`` each call
+        (the Idle phase samples it to decide whether to compact)."""
+        with self._lock:
+            frag = self._fragmentation_locked()
+        POOL_FRAGMENTATION.set(frag)
+        return frag
+
+    def _fragmentation_locked(self) -> float:
+        total = sum(s.free_pages for s in self._slabs)  # lint: guarded-ok: _locked suffix — every caller holds _lock
+        if not total:
+            return 0.0
+        largest = max(
+            (length for s in self._slabs for _, length in s.free),  # lint: guarded-ok: _locked suffix
+            default=0,
+        )
+        return 1.0 - largest / total
+
+    def compact(self) -> int:
+        """Between-round host-arena compaction: slide migratable leases
+        (those carrying a ``migrator``) toward page 0 of their slab so the
+        free runs behind them coalesce, then drop fully-free trailing
+        slabs. Returns the number of pages moved.
+
+        The whole pass runs under the lease lock: bytes memmove to the new
+        run, the page table (lease.slab/offset and the slab free lists) is
+        rewritten atomically, and each holder's ``migrator(new_view)``
+        swaps its reference before the lock drops — no thread can observe
+        a half-migrated lease. Leases without a migrator (a round's live
+        fold buffers) are immovable barriers; compaction never crosses
+        them, so leases==releases accounting is untouched (no lease is
+        released or granted here)."""
+        moved_pages = 0
+        with self._lock:
+            by_slab: dict[int, list[PageLease]] = {}
+            for lease in self._leases.values():
+                if lease.arena == "host" and 0 <= lease.slab < len(self._slabs):
+                    by_slab.setdefault(lease.slab, []).append(lease)
+            for slab_idx, leases in by_slab.items():
+                slab = self._slabs[slab_idx]
+                cursor = 0
+                for lease in sorted(leases, key=lambda l: l.offset):
+                    if lease.migrator is None or lease.offset <= cursor:
+                        # immovable barrier, or already packed: skip past it
+                        cursor = max(cursor, lease.offset + lease.pages)
+                        continue
+                    src = lease.offset * self.page_bytes
+                    dst = cursor * self.page_bytes
+                    nbytes = (
+                        lease.array.nbytes
+                        if lease.array is not None
+                        else lease.pages * self.page_bytes
+                    )
+                    # copy through a temp: src and dst runs may overlap
+                    slab.buf[dst : dst + nbytes] = slab.buf[src : src + nbytes].copy()
+                    moved_pages += lease.pages
+                    lease.offset = cursor
+                    if lease.array is not None:
+                        raw = slab.buf[dst : dst + nbytes]
+                        view = raw.view(lease.array.dtype).reshape(lease.array.shape)
+                        lease.array = view
+                        lease.migrator(view)
+                    cursor += lease.pages
+                # rewrite the free list as the complement of the (now
+                # packed) occupied runs
+                occupied = sorted(
+                    (l.offset, l.pages)
+                    for l in self._leases.values()
+                    if l.arena == "host" and l.slab == slab_idx
+                )
+                free: list[tuple[int, int]] = []
+                edge = 0
+                for start, length in occupied:
+                    if start > edge:
+                        free.append((edge, start - edge))
+                    edge = start + length
+                if edge < slab.n_pages:
+                    free.append((edge, slab.n_pages - edge))
+                slab.free[:] = free
+            # trim fully-free trailing slabs (mid-list slabs stay: lease
+            # slab indices are positional)
+            while self._slabs and self._slabs[-1].free_pages == self._slabs[-1].n_pages:
+                self._slabs.pop()
+            frag = self._fragmentation_locked()
+        POOL_COMPACTIONS.inc()
+        if moved_pages:
+            POOL_PAGES_MIGRATED.inc(moved_pages)
+            logger.info("pool: compaction migrated %d page(s)", moved_pages)
+        POOL_FRAGMENTATION.set(frag)
+        return moved_pages
+
     def stats(self) -> dict:
         with self._lock:
+            tenant_leases: dict[str, int] = {}
+            for lease in self._leases.values():
+                tenant_leases[lease.tenant] = tenant_leases.get(lease.tenant, 0) + 1
             return {
                 "page_bytes": self.page_bytes,
                 "slabs": len(self._slabs),
@@ -306,6 +447,8 @@ class PagePool:
                 "host_pages_free": sum(s.free_pages for s in self._slabs),
                 "device_pages_in_use": self._in_use["device"],
                 "leases": len(self._leases),
+                "tenant_leases": tenant_leases,
+                "fragmentation": self._fragmentation_locked(),
             }
 
 

@@ -90,6 +90,15 @@ class TenantRegistry:
             self._contexts[ctx.tenant] = ctx
         return ctx
 
+    def remove(self, tenant: str) -> Optional[TenantContext]:
+        """Unregister a drained tenant (lifecycle offboard). Returns the
+        removed context, or None if it was never (or no longer)
+        registered. The first-registered tenant stays the default for the
+        life of the process — offboarding it leaves the unprefixed legacy
+        routes pointing at the next-oldest tenant."""
+        with self._lock:
+            return self._contexts.pop(tenant, None)
+
     def get(self, tenant: str) -> Optional[TenantContext]:
         with self._lock:
             return self._contexts.get(tenant)

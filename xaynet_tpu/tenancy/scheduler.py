@@ -46,6 +46,13 @@ SCHED_INFLIGHT = _registry.gauge(
     "xaynet_tenant_sched_inflight",
     "Fold-batch slots currently granted across all tenants.",
 )
+SCHED_DEMOTIONS = _registry.counter(
+    "xaynet_tenant_sched_demotions_total",
+    "Preemptive demotions applied to a tenant by the SLO feedback loop "
+    "(an over-budget tenant yields fold-batch slots until its burn "
+    "recovers).",
+    ("tenant",),
+)
 
 DEFAULT_MAX_INFLIGHT = 8
 
@@ -65,6 +72,9 @@ class TenantScheduler:
         self._waiting: list[tuple[str, int]] = []  # (tenant, seq)  # guarded-by: _cond
         self._served: dict[str, int] = {}  # cumulative grants  # guarded-by: _cond
         self._window_prev: dict[str, int] = {}  # guarded-by: _cond
+        self._weights: dict[str, float] = {}  # guarded-by: _cond
+        self._tiers: dict[str, int] = {}  # guarded-by: _cond
+        self._demoted: set[str] = set()  # guarded-by: _cond
 
     # -- ownership ----------------------------------------------------------
 
@@ -87,9 +97,71 @@ class TenantScheduler:
     # -- slots --------------------------------------------------------------
 
     def _chosen(self) -> tuple[str, int]:
-        """The waiter the next free slot belongs to: fewest slots served,
-        FIFO on ties — the deficit-round-robin interleave."""
-        return min(self._waiting, key=lambda w: (self._served.get(w[0], 0), w[1]))
+        """The waiter the next free slot belongs to, in precedence order:
+        not SLO-demoted first (a demoted tenant only wins a slot when no
+        healthy tenant is waiting — preemption at fold-batch granularity),
+        then priority tier (lower tier number wins), then the smallest
+        *weighted* deficit (served / weight: a weight-2 tenant earns slots
+        twice as fast as a weight-1 one), FIFO on ties."""
+        return min(
+            self._waiting,
+            key=lambda w: (
+                w[0] in self._demoted,
+                self._tiers.get(w[0], 0),
+                self._served.get(w[0], 0) / self._weights.get(w[0], 1.0),
+                w[1],
+            ),
+        )
+
+    # -- SLO-weighted preemption -------------------------------------------
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        """Configure the tenant's fair-share weight (>= a weight-1 tenant's
+        share per unit weight). Takes effect on the next grant decision."""
+        if weight <= 0:
+            raise ValueError("weight must be > 0")
+        with self._cond:
+            self._weights[tenant] = float(weight)
+            self._cond.notify_all()
+
+    def set_tier(self, tenant: str, tier: int) -> None:
+        """Configure the tenant's priority tier (lower wins; default 0).
+        A tier strictly dominates weights: tier-0 waiters always beat
+        tier-1 waiters regardless of deficit."""
+        with self._cond:
+            self._tiers[tenant] = int(tier)
+            self._cond.notify_all()
+
+    def set_demoted(self, tenant: str, demoted: bool) -> None:
+        """SLO feedback: an over-budget (burn-paging) tenant is demoted —
+        it only receives fold-batch slots the healthy tenants do not
+        want. Restoring is the same call with ``demoted=False``."""
+        with self._cond:
+            was = tenant in self._demoted
+            if demoted:
+                self._demoted.add(tenant)
+            else:
+                self._demoted.discard(tenant)
+            changed = was != demoted
+            if changed:
+                self._cond.notify_all()
+        if changed and demoted:
+            SCHED_DEMOTIONS.labels(tenant=tenant).inc()
+
+    def demoted(self) -> set[str]:
+        with self._cond:
+            return set(self._demoted)
+
+    def forget_tenant(self, tenant: str) -> None:
+        """Drop a drained tenant's scheduler state so a later re-onboard
+        starts with a fresh deficit instead of a stale credit."""
+        with self._cond:
+            self._served.pop(tenant, None)
+            self._window_prev.pop(tenant, None)
+            self._weights.pop(tenant, None)
+            self._tiers.pop(tenant, None)
+            self._demoted.discard(tenant)
+            self._cond.notify_all()
 
     def acquire(self, tenant: str, owner: int) -> None:
         """Block until a fold-batch slot is granted to ``tenant``."""
