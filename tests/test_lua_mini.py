@@ -224,7 +224,9 @@ def test_add_sum_participant_script():
 
 def test_add_local_seed_dict_script_error_codes():
     store = MiniStore()
-    keys = [b"sum_dict", b"update_participants"]
+    # KEYS[3]: the key-prefixed seed-dict base — the script builds every
+    # per-sum-pk hash key from it so tenant prefixes scope the writes too
+    keys = [b"sum_dict", b"update_participants", b"seed_dict:"]
     store.hashes[b"sum_dict"] = {b"s1": b"e1", b"s2": b"e2"}
 
     # -1: length mismatch (only one entry for two sum participants)
@@ -244,7 +246,7 @@ def test_add_local_seed_dict_partial_submission_detected():
     # -4: updater not in the set but already present in some seed hash
     # (the replay-hazard state after a lost reply)
     store = MiniStore()
-    keys = [b"sum_dict", b"update_participants"]
+    keys = [b"sum_dict", b"update_participants", b"seed_dict:"]
     store.hashes[b"sum_dict"] = {b"s1": b"e1"}
     store.hashes[b"seed_dict:s1"] = {b"updater-1": b"old"}
     assert run_script(ADD_LOCAL_SEED_DICT, keys, _seed_entries([b"s1"]), store) == -4

@@ -278,6 +278,8 @@ def test_unmask_phase_uses_inplace_view_without_double_timing(monkeypatch):
 
     phase = Sum2Phase.__new__(Sum2Phase)
     phase.aggregator = dev
+    phase._base = None  # no round journal: next() must skip the unmask entry
+    phase._votes = []
 
     class _Shared:
         pass

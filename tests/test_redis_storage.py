@@ -149,6 +149,19 @@ class FakeRedis:
             for member, score in ranked[lo : hi + 1]:
                 flat += [member, str(int(score)).encode()]
             return self._array(flat)
+        if cmd == b"SCAN":
+            # single-pass cursor walk (cursor 0 -> everything -> cursor 0),
+            # MATCH limited to the "prefix*" shape the tenant-scoped
+            # delete_coordinator_data issues
+            assert parts[2].upper() == b"MATCH" and parts[3].endswith(b"*")
+            prefix = parts[3][:-1]
+            keys = [
+                k
+                for space in (self.strings, self.hashes, self.sets, self.zsets)
+                for k in space
+                if k.startswith(prefix)
+            ]
+            return b"*2\r\n" + self._bulk(b"0") + self._array(keys)
         if cmd == b"EVAL":
             return self._eval(parts[1], parts)
         raise AssertionError(f"unsupported command {cmd!r}")
@@ -420,5 +433,60 @@ def test_redis_backend_full_cycle(backend_kind):
             assert await store.coordinator_state() == b"state-1"
             await store.delete_coordinator_data()
             assert await store.coordinator_state() is None
+
+    asyncio.run(run())
+
+
+def test_redis_checkpoint_and_dicts_are_tenant_prefix_scoped():
+    """Regression mirroring the file-backend tenant-scope test
+    (test_tenancy.py): with per-tenant ``t:<id>:`` key prefixes sharing
+    one redis db, the round journal, the Lua-scripted seed dicts and the
+    prefix-scoped delete must each stay inside their own tenant's
+    namespace — tenant B's restart sees no journal entry, and flushing
+    tenant A leaves tenant B's round state intact."""
+    from xaynet_tpu.core.mask.seed import EncryptedMaskSeed
+
+    async def run():
+        fake = FakeRedis()
+        port = await fake.start()
+        try:
+            store_a = RedisCoordinatorStorage(port=port, key_prefix="t:alpha:")
+            store_b = RedisCoordinatorStorage(port=port, key_prefix="t:beta:")
+            await store_a.client.command(b"FLUSHDB")
+
+            blob_a, blob_b = b"alpha journal entry", b"beta journal entry"
+            await store_a.set_round_checkpoint(blob_a)
+            await store_b.set_round_checkpoint(blob_b)
+            assert await store_a.round_checkpoint() == blob_a
+            assert await store_b.round_checkpoint() == blob_b
+
+            # the Lua seed-dict insert builds its per-sum hash keys from the
+            # PREFIXED base: each tenant's seed dict is invisible to the other
+            seed80 = bytes(range(80 // 4)) * 4
+            for store, upk in ((store_a, b"ua" * 16), (store_b, b"ub" * 16)):
+                assert await store.add_sum_participant(b"s1" * 16, b"e" * 32) is None
+                assert (
+                    await store.add_local_seed_dict(
+                        upk, {b"s1" * 16: EncryptedMaskSeed(seed80)}
+                    )
+                    is None
+                )
+            seeds_a = await store_a.seed_dict()
+            seeds_b = await store_b.seed_dict()
+            assert set(seeds_a[b"s1" * 16]) == {b"ua" * 16}
+            assert set(seeds_b[b"s1" * 16]) == {b"ub" * 16}
+
+            # prefix-scoped delete: flushing alpha keeps beta whole
+            await store_a.delete_round_checkpoint()
+            assert await store_a.round_checkpoint() is None
+            assert await store_b.round_checkpoint() == blob_b
+            await store_a.delete_coordinator_data()
+            assert await store_a.sum_dict() is None
+            assert await store_b.round_checkpoint() == blob_b
+            assert set((await store_b.seed_dict())[b"s1" * 16]) == {b"ub" * 16}
+            await store_a.client.close()
+            await store_b.client.close()
+        finally:
+            await fake.stop()
 
     asyncio.run(run())

@@ -433,9 +433,17 @@ def test_checkpoint_validation_rejects_inconsistency():
     assert asyncio.run(check(good)) is None
     assert "round" in asyncio.run(check(_ckpt(round_id=9, round_seed=seed, mask_config=names)))
     assert "seed" in asyncio.run(check(_ckpt(mask_config=names)))  # wrong round seed
+    # sum2 is a RESUMABLE phase since the whole-round journal (§9) — only
+    # non-window phases are rejected outright now
     assert "phase" in asyncio.run(
-        check(_ckpt(phase="sum2", round_seed=seed, mask_config=names))
+        check(_ckpt(phase="idle", round_seed=seed, mask_config=names))
     )
+    # a v1 (XNCKPT1) blob predates the journal: update-only resume
+    v1_sum2 = _ckpt(
+        phase="sum2", round_seed=seed, mask_config=names, version=1,
+        nb_models=0, seed_watermark=0,
+    )
+    assert "phase" in asyncio.run(check(v1_sum2))
     # watermark mismatch: checkpoint claims 2 models but the store has none
     stale = _ckpt(round_seed=seed, mask_config=names, nb_models=2, seed_watermark=2)
     assert "watermark" in asyncio.run(check(stale))
@@ -705,9 +713,14 @@ def test_kill_and_restore_resumes_update_phase_from_checkpoint():
             await asyncio.gather(*(drive(p) for p in participants))
             while fetcher.model() is None:
                 await asyncio.sleep(0.01)
-            # the checkpoint's lifetime is the update phase: once the round
-            # moved on it must be gone (a later-phase failure restarts the
-            # round instead of burning resume attempts on a dead resume)
+            # the journal's lifetime is the round: it retires after the
+            # model publishes (Unmask deletes it, Idle sweeps as backstop).
+            # The model becomes visible a beat before the delete lands, so
+            # poll with a bound instead of asserting instantly.
+            for _ in range(200):
+                if await store.coordinator.round_checkpoint() is None:
+                    break
+                await asyncio.sleep(0.01)
             assert await store.coordinator.round_checkpoint() is None
             return np.asarray(fetcher.model())
         finally:

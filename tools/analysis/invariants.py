@@ -44,6 +44,8 @@ NB_MODELS_SITES: dict[tuple[str, str], str] = {
         "wire batch credit from the synced acceptance vector",
     ("xaynet_tpu/parallel/aggregator.py", "ShardedAggregator.restore"):
         "checkpoint resume restores the persisted count",
+    ("xaynet_tpu/parallel/aggregator.py", "ShardedAggregator.restore_shards"):
+        "journal resume restores the persisted count (per-shard planes path)",
     ("xaynet_tpu/parallel/aggregator.py", "ShardedAggregator.reset"): "round reset",
     # the streaming pipeline: every credit sits under the pipeline lock,
     # paired with the in-flight decrement (counted_models() atomicity)

@@ -68,7 +68,15 @@ BYTES_UNIT = "bytes/fold"
 # than the best (fastest) prior round tolerates.
 ROUND_WALL_PREFIX = "round wall"
 ROUND_WALL_UNIT = "s/round"
-LOWER_IS_BETTER_UNITS = frozenset({BYTES_UNIT, ROUND_WALL_UNIT, "s/onboard"})
+# crash-recovery family (tools/soak.py --kill-matrix, DESIGN §9): the
+# restarted coordinator's boot-to-serving wall (``xaynet_recovery_seconds``)
+# per kill coordinate. LOWER is better — the gate fails when a restart
+# takes LONGER than the best (fastest) prior recovery tolerates.
+RECOVERY_PREFIX = "restart recovery wall"
+RECOVERY_UNIT = "s/recovery"
+LOWER_IS_BETTER_UNITS = frozenset(
+    {BYTES_UNIT, ROUND_WALL_UNIT, "s/onboard", RECOVERY_UNIT}
+)
 # multi-tenant interleaved fold (bench.py:multi_tenant, DESIGN §19): two
 # tenants' concurrent folds through the paged pool + tenant scheduler,
 # in 25M-equivalent updates/s (tenant B's updates scaled by its length
@@ -98,6 +106,7 @@ DEFAULT_FAMILIES = (
     (ROUND_WALL_PREFIX, ROUND_WALL_UNIT),
     (INGRESS_PREFIX, HEADLINE_UNIT),
     (ONBOARD_PREFIX, ONBOARD_UNIT),
+    (RECOVERY_PREFIX, RECOVERY_UNIT),
 )
 
 

@@ -417,6 +417,335 @@ def _metric_value(port: int, family: str, labels: dict) -> float | None:
     return None
 
 
+def _metric_sum(port: int, family: str) -> float:
+    """Sum of every sample of ``family`` across all label sets (e.g. the
+    total leased pool pages over every arena x tenant)."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(family + "{") and line.split(" ")[0] != family:
+            continue
+        try:
+            total += float(line.rsplit(" ", 1)[1])
+        except ValueError:
+            continue
+    return total
+
+
+# --- SIGKILL-matrix chaos soak (docs/DESIGN.md §9) --------------------------
+
+# the full matrix: one seeded kill coordinate per phase family plus the
+# publish window. <site>:<n> dies on the n-th visit to the site — "update:2"
+# is mid-window (2 of 3 updates journaled), "unmask:publish:1" lands AFTER
+# the model save but BEFORE the journal retires (the idempotent-republish
+# window, the nastiest restart point).
+KILL_MATRIX = ("sum:1", "update:2", "sum2:1", "unmask:publish:1")
+RECOVERY_METRIC = "restart recovery wall"
+RECOVERY_UNIT = "s/recovery"
+
+
+def _kill_config(port: int, model_len: int, state_dir: str) -> str:
+    """A checkpoint-enabled coordinator config whose durable state (file
+    coordinator + model archive + round journal) all lives under
+    ``state_dir`` — the restart boots on the SAME tree the kill orphaned.
+
+    ``checkpoint_every_batches = 1`` with ``batch_size = 1`` puts a journal
+    write BEFORE every update acknowledgement, so any accepted message
+    survives any kill point. Overlap is pinned off: the matrix measures the
+    journal, not the journal x speculation interplay (tests cover that)."""
+    base = CONFIG.format(
+        port=port,
+        model_len=model_len,
+        model_dir=state_dir,
+        agg_device="true",
+        agg_wire_ingest="false",
+        agg_batch=1,
+        agg_kernel="auto",
+        update_min=3,
+        update_max=3,
+        update_quorum_line="",
+        stall_grace=5.0,
+        edge_enabled_line="",
+    )
+    # the template's [storage] table already exists — inject the coordinator
+    # backend into it (tomllib rejects a duplicate [storage] section)
+    base = base.replace(
+        'backend = "filesystem"', 'backend = "filesystem"\ncoordinator = "file"'
+    )
+    return base + (
+        "\n[restore]\nenable = true\n"
+        "\n[resilience]\n"
+        "checkpoint_enabled = true\n"
+        "checkpoint_every_batches = 1\n"
+        "checkpoint_every_s = 1.0\n"
+        "max_resume_attempts = 3\n"
+        "\n[overlap]\nenabled = false\n"
+    )
+
+
+def _drive_crash_round(
+    url: str, model_len: int, expected: bytes | None, label: str,
+    timeout_s: float = 300.0,
+) -> bytes:
+    """Drive ONE deterministic PET round, tolerating a coordinator death
+    and restart mid-round: every fetch retries through the dead-socket
+    window, and ``Participant.tick`` already swallows transport errors into
+    a PENDING transition (the resilient client bridges short gaps on its
+    own). Returns the published global model bytes, byte-compared against
+    ``expected`` when given."""
+    from fractions import Fraction
+
+    import numpy as np
+
+    from xaynet_tpu.sdk.client import HttpClient
+    from xaynet_tpu.sdk.participant import Participant
+    from xaynet_tpu.sdk.simulation import keys_for_task
+
+    def fetch_params():
+        return asyncio.run(HttpClient(url, keep_alive=False).get_round_params())
+
+    def fetch_model() -> bytes:
+        model = asyncio.run(HttpClient(url, keep_alive=False).get_model())
+        return np.asarray(model, dtype=np.float64).tobytes()
+
+    deadline = time.time() + timeout_s
+    params = None
+    while params is None:
+        if time.time() > deadline:
+            raise RuntimeError(f"{label}: no round parameters before timeout")
+        try:
+            params = fetch_params()
+        except Exception:
+            time.sleep(0.2)
+    seed = params.seed.as_bytes()
+    summer = keys_for_task(seed, params.sum, params.update, "sum")
+    upd, start = [], 0
+    while len(upd) < 3:
+        k = keys_for_task(seed, params.sum, params.update, "update", start=start)
+        start += 100000
+        if all(k.public != u.public for u in upd) and k.public != summer.public:
+            upd.append(k)
+    parts = [Participant(url, keys=summer, scalar=Fraction(1, 3))]
+    for i, k in enumerate(upd):
+        p = Participant(url, keys=k, scalar=Fraction(1, 3))
+        p.set_model(np.full(model_len, 0.25 * (i + 1), dtype=np.float32))
+        parts.append(p)
+    try:
+        closed = False
+        while time.time() < deadline:
+            for p in parts:
+                p.tick()
+            try:
+                if fetch_params().seed.as_bytes() != seed:
+                    closed = True
+                    break
+            except Exception:
+                # coordinator dead or restarting: keep the participants'
+                # resend state warm and poll again
+                time.sleep(0.2)
+        if not closed:
+            raise RuntimeError(f"{label}: round did not complete")
+        model_bytes = None
+        while model_bytes is None:
+            if time.time() > deadline + 30:
+                raise RuntimeError(f"{label}: model not fetchable after round close")
+            try:
+                model_bytes = fetch_model()
+            except Exception:
+                time.sleep(0.2)
+        if expected is not None and model_bytes != expected:
+            raise RuntimeError(f"{label}: model NOT byte-identical to the unkilled control")
+        return model_bytes
+    finally:
+        for p in parts:
+            p.close()
+
+
+def run_kill_matrix_soak(args) -> None:
+    """--kill-matrix: SIGKILL the coordinator at seeded (phase, message)
+    coordinates, restart it on the same durable tree, and drive the
+    surviving participants to completion. Per coordinate the harness
+    asserts (docs/DESIGN.md §9):
+
+    - the restarted coordinator RESUMED the killed phase from the round
+      journal (``xaynet_resume_total{phase,outcome="resumed"}`` >= 1);
+    - the published global model is byte-identical to an unkilled control;
+    - zero pool pages stay leased after the round (no leak across a kill);
+    - the restart-to-serving wall (``xaynet_recovery_seconds``) is
+      recorded — with ``--append-history`` it lands in BENCH_HISTORY.jsonl
+      as the lower-is-better "restart recovery wall" bench-gate family.
+    """
+    import signal
+    import socket
+    import threading
+
+    coords = [
+        c.strip()
+        for c in (args.kill_points or ",".join(KILL_MATRIX)).split(",")
+        if c.strip()
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XAYNET_KILL_POINT", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    def wait_listening(port: int, proc) -> None:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    return
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError("coordinator exited during startup")
+                time.sleep(0.25)
+        raise RuntimeError("coordinator did not start listening in 90s")
+
+    def stop(proc) -> None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    url = f"http://127.0.0.1:{args.port}"
+    t0 = time.perf_counter()
+    results = []
+    with tempfile.TemporaryDirectory() as tmp:
+        def boot(state_dir: str, tag: str, extra_env: dict | None = None):
+            cfg = os.path.join(state_dir, "coordinator.toml")
+            if not os.path.exists(cfg):
+                with open(cfg, "w") as f:
+                    f.write(_kill_config(args.port, args.model_len, state_dir))
+            log = open(os.path.join(state_dir, f"{tag}.log"), "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "xaynet_tpu.server.runner", "-c", cfg],
+                env=dict(env, **(extra_env or {})),
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+            return proc, log
+
+        # --- unkilled control: the byte-identity reference ----------------
+        control_dir = os.path.join(tmp, "control")
+        os.makedirs(control_dir)
+        proc, log = boot(control_dir, "control")
+        try:
+            wait_listening(args.port, proc)
+            control = _drive_crash_round(url, args.model_len, None, "control")
+        finally:
+            stop(proc)
+            log.close()
+        print(f"control: model {len(control)} bytes", file=sys.stderr)
+
+        # --- the matrix ---------------------------------------------------
+        for coord in coords:
+            phase = coord.split(":", 1)[0]
+            state_dir = os.path.join(tmp, coord.replace(":", "_"))
+            os.makedirs(state_dir)
+            proc, log = boot(state_dir, "killed", {"XAYNET_KILL_POINT": coord})
+            box: dict = {}
+
+            def drive() -> None:
+                try:
+                    box["model"] = _drive_crash_round(
+                        url, args.model_len, control, f"kill {coord}"
+                    )
+                except BaseException as err:
+                    box["error"] = err
+
+            th = threading.Thread(target=drive, daemon=True)
+            try:
+                wait_listening(args.port, proc)
+                th.start()
+                # the seeded kill MUST fire: anything else (clean exit,
+                # crash-on-boot, survived round) fails the matrix
+                rc = proc.wait(timeout=240)
+                if rc != -signal.SIGKILL:
+                    raise RuntimeError(f"{coord}: coordinator exited {rc}, expected SIGKILL")
+            finally:
+                log.close()
+            print(f"{coord}: killed (pid {proc.pid})", file=sys.stderr)
+            t_restart = time.perf_counter()
+            proc, log = boot(state_dir, "restarted")
+            try:
+                wait_listening(args.port, proc)
+                restart_wall = time.perf_counter() - t_restart
+                th.join(timeout=300)
+                if th.is_alive():
+                    raise RuntimeError(f"{coord}: round did not complete after restart")
+                if "error" in box:
+                    raise box["error"]
+                resumed = _metric_value(
+                    args.port, "xaynet_resume_total",
+                    {"phase": phase, "outcome": "resumed"},
+                )
+                if not resumed:
+                    raise RuntimeError(
+                        f"{coord}: no xaynet_resume_total{{phase={phase!r},"
+                        f'outcome="resumed"}} sample after restart'
+                    )
+                recovery_s = _metric_value(args.port, "xaynet_recovery_seconds", {})
+                leaked = _metric_sum(args.port, "xaynet_pool_pages")
+                if leaked:
+                    raise RuntimeError(f"{coord}: {leaked:g} pool pages leaked")
+            finally:
+                stop(proc)
+                log.close()
+            print(
+                f"{coord}: resumed={resumed:g} recovery={recovery_s}s "
+                f"restart_wall={restart_wall:.2f}s",
+                file=sys.stderr,
+            )
+            results.append(
+                {
+                    "kill_point": coord,
+                    "phase": phase,
+                    "resumed": resumed,
+                    "recovery_s": recovery_s,
+                    "restart_to_serving_s": round(restart_wall, 3),
+                    "byte_identical": True,
+                    "pool_pages_leaked": leaked,
+                }
+            )
+    if args.append_history:
+        history = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_HISTORY.jsonl",
+        )
+        ts = time.time()
+        with open(history, "a") as f:
+            for rec in results:
+                f.write(
+                    json.dumps(
+                        {
+                            "ts": ts,
+                            "cpus": os.cpu_count(),
+                            "metric": f"{RECOVERY_METRIC} ({rec['kill_point']})",
+                            "value": rec["recovery_s"],
+                            "unit": RECOVERY_UNIT,
+                            "restart_to_serving_s": rec["restart_to_serving_s"],
+                            "model_len": args.model_len,
+                        }
+                    )
+                    + "\n"
+                )
+    print(
+        json.dumps(
+            {
+                "kill_matrix": results,
+                "model_len": args.model_len,
+                "byte_identical": True,
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+        )
+    )
+
+
 def run_tenant_churn_soak(args) -> None:
     """--tenant-churn: the elastic-lifecycle chaos soak (docs/DESIGN.md §23).
 
@@ -1019,6 +1348,31 @@ def main() -> None:
         "the drained tenant leaks zero pool pages (docs/DESIGN.md §23)",
     )
     ap.add_argument(
+        "--kill-matrix",
+        action="store_true",
+        help="SIGKILL-matrix chaos soak: kill the coordinator at seeded "
+        "(phase, message-index) coordinates, restart it on the same durable "
+        "tree and drive the surviving participants to completion — the "
+        "global model must be byte-identical to an unkilled control, the "
+        "killed phase must RESUME from the round journal, and zero pool "
+        "pages may leak (docs/DESIGN.md §9)",
+    )
+    ap.add_argument(
+        "--kill-points",
+        default=None,
+        metavar="SITE:N,...",
+        help="with --kill-matrix: comma-separated kill coordinates "
+        "(default: the full matrix sum:1,update:2,sum2:1,unmask:publish:1); "
+        "CI smoke runs a one-per-phase-family subset",
+    )
+    ap.add_argument(
+        "--append-history",
+        action="store_true",
+        help="with --kill-matrix: append one 'restart recovery wall' record "
+        "per kill coordinate to BENCH_HISTORY.jsonl (the lower-is-better "
+        "bench-gate family)",
+    )
+    ap.add_argument(
         "--faults",
         type=int,
         default=None,
@@ -1037,6 +1391,21 @@ def main() -> None:
     args = ap.parse_args()
     if args.wire_ingest and not args.device_kernel:
         ap.error("--wire-ingest requires --device-kernel")
+    if args.kill_matrix:
+        if (
+            args.tenants is not None
+            or args.tenant_churn
+            or args.edges is not None
+            or args.dropout is not None
+            or args.stragglers is not None
+            or args.faults is not None
+        ):
+            ap.error("--kill-matrix is a separate soak (it owns its own "
+                     "process lifecycle and durable tree)")
+        run_kill_matrix_soak(args)
+        return
+    if args.kill_points or args.append_history:
+        ap.error("--kill-points/--append-history require --kill-matrix")
     if args.tenant_churn:
         if (
             args.tenants is not None

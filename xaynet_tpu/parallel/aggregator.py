@@ -1150,6 +1150,63 @@ class ShardedAggregator:
         self.acc = jax.device_put(jnp.asarray(planar), self._acc_sharding)
         self.nb_models = nb_models
 
+    def snapshot_shards(self) -> Optional[list[tuple[int, int, np.ndarray]]]:
+        """Packed per-shard planes ``[(lo, hi, uint32[L, hi-lo])]`` of the
+        PADDED model axis — the journal form that lets a device round
+        checkpoint without reassembling the global accumulator (each plane
+        is one device/shard slice, fetched independently). Returns None when
+        no per-shard decomposition exists; the caller falls back to the
+        gathered wire snapshot."""
+        plan = self._live_plan
+        if plan is not None:
+            return [
+                (lo, hi, np.asarray(acc))  # lint: guarded-ok: drain barrier read
+                for (lo, hi), acc in zip(plan.slices, plan.accs)
+            ]
+        acc = self._acc
+        if not isinstance(acc, jax.Array):
+            return None
+        planes: dict[int, tuple[int, int, np.ndarray]] = {}
+        for s in acc.addressable_shards:
+            col = s.index[1]
+            lo = col.start if col.start is not None else 0
+            hi = col.stop if col.stop is not None else self.padded_length
+            if lo not in planes:  # replicated shardings repeat slices
+                planes[lo] = (lo, hi, np.asarray(s.data))
+        return [planes[lo] for lo in sorted(planes)]
+
+    def restore_shards(self, planes: list[tuple[int, int, np.ndarray]], nb_models: int) -> None:
+        """Restore the planar accumulator from journal planes, shard-exact
+        when the current mesh decomposition matches the journaled one (one
+        ``device_put`` per plane, no host-side global assembly), host-side
+        concat + scatter otherwise (mesh shape changed across the restart)."""
+        shape = (self.n_limbs, self.padded_length)
+        target = None
+        try:
+            index_map = self._acc_sharding.addressable_devices_indices_map(shape)
+            by_lo = {lo: np.ascontiguousarray(p, dtype=np.uint32) for lo, _hi, p in planes}
+            arrays = []
+            for dev, idx in index_map.items():
+                col = idx[1]
+                lo = col.start if col.start is not None else 0
+                hi = col.stop if col.stop is not None else self.padded_length
+                plane = by_lo[lo]  # KeyError -> decomposition mismatch -> fallback
+                if plane.shape != (self.n_limbs, hi - lo):
+                    raise ValueError(f"plane [{lo},{hi}) shape {plane.shape}")
+                arrays.append(jax.device_put(plane, dev))
+            target = jax.make_array_from_single_device_arrays(
+                shape, self._acc_sharding, arrays
+            )
+        except (KeyError, ValueError, TypeError) as exc:
+            logger.info("shard-exact restore unavailable (%s); reassembling on host", exc)
+        if target is None:
+            planar = np.zeros(shape, dtype=np.uint32)
+            for lo, hi, plane in planes:
+                planar[:, lo:hi] = plane
+            target = jax.device_put(jnp.asarray(planar), self._acc_sharding)
+        self.acc = target  # setter drops any stale plan; streaming re-leases
+        self.nb_models = nb_models
+
     def reset(self) -> None:
         self.acc = jax.device_put(
             jnp.zeros((self.n_limbs, self.padded_length), dtype=jnp.uint32), self._acc_sharding

@@ -6,14 +6,19 @@ checkpoints, and deterministic fault injection.
 - ``breaker``    — :class:`CircuitBreaker` with half-open probing;
 - ``store``      — :class:`ResilientStore`, the decorator wrapping every
   ``CoordinatorStorage``/``ModelStorage``/``TrustAnchor`` call;
-- ``checkpoint`` — :class:`RoundCheckpoint` + the update-phase
-  :class:`CheckpointManager` and resume validation;
+- ``checkpoint`` — :class:`RoundCheckpoint` (the phase-tagged round
+  journal) + the update-phase :class:`CheckpointManager` and resume
+  validation;
+- ``chaos``      — the ``XAYNET_KILL_POINT`` SIGKILL hook the kill-matrix
+  harness drives;
 - ``faults``     — seeded :class:`FaultPlan` driving reproducible chaos
   through storage, ingest and the streaming fold pipeline.
 """
 
 from .breaker import BreakerOpen as BreakerOpen, CircuitBreaker as CircuitBreaker
+from .chaos import maybe_kill as maybe_kill
 from .checkpoint import (
+    AggSnapshot as AggSnapshot,
     CheckpointManager as CheckpointManager,
     RoundCheckpoint as RoundCheckpoint,
 )

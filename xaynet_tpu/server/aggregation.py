@@ -28,7 +28,30 @@ from ..core.mask.config import MaskConfigPair
 from ..core.mask.masking import Aggregation, AggregationError, UnmaskingError
 from ..core.mask.object import LazyWireMaskVect, MaskObject, MaskUnit, MaskVect
 from ..ops import limbs as limb_ops
+from ..resilience.checkpoint import AggSnapshot
 from ..telemetry import profiling
+
+
+def build_staged_aggregator(shared) -> "StagedAggregator":
+    """The ONE way a phase builds the round's aggregator from settings —
+    shared by the update phase's normal entry and the journal-resume
+    factories re-entering sum2/unmask (docs/DESIGN.md §9), so a resumed
+    round folds and unmasks with exactly the configuration it crashed
+    under."""
+    settings = shared.settings
+    return StagedAggregator(
+        config=shared.state.round_params.mask_config,
+        object_size=shared.state.round_params.model_length,
+        device=settings.aggregation.device,
+        batch_size=settings.aggregation.batch_size,
+        kernel=settings.aggregation.kernel,
+        dispatch_ahead=settings.aggregation.dispatch_ahead,
+        staging_buffers=settings.aggregation.staging_buffers,
+        shard_parallel=settings.aggregation.shard_parallel,
+        shard_threads=settings.aggregation.shard_threads,
+        packed_staging=settings.aggregation.packed_staging,
+        tenant=shared.tenant,
+    )
 
 
 class DeviceAggregation(Aggregation):
@@ -532,6 +555,54 @@ class StagedAggregator:
             np.array(self._host.object.unit.data),
             self._host.nb_models,
         )
+
+    def snapshot_journal(self) -> AggSnapshot:
+        """Exact host copy of the aggregate for a journal entry.
+
+        Drains first, like :meth:`snapshot_state` — then, on the device
+        path, reads the accumulator shard by shard (packed per-shard
+        planar planes) instead of reassembling the mesh array into one
+        global wire buffer: each shard's plane crosses to the host once,
+        and no device-side concat/relayout runs at all.
+        """
+        self.drain()
+        if self._device is not None:
+            planes = self._device.snapshot_shards()
+            if planes is not None:
+                return AggSnapshot(
+                    nb_models=self._device.nb_models,
+                    unit=np.array(self._unit_acc),
+                    planes=planes,
+                )
+            return AggSnapshot(
+                nb_models=self._device.nb_models,
+                unit=np.array(self._unit_acc),
+                vect=self._device.snapshot(),
+            )
+        return AggSnapshot(
+            nb_models=self._host.nb_models,
+            unit=np.array(self._host.object.unit.data),
+            vect=np.array(self._host.object.vect.data),
+        )
+
+    def restore_journal(self, ckpt) -> None:
+        """Restore a journal entry (``RoundCheckpoint``) into an EMPTY
+        aggregator. Per-shard planes restore shard-by-shard on the device
+        path (``ShardedAggregator.restore_shards`` — no host concat when
+        the plane geometry matches the mesh); everything else goes through
+        the wire-layout :meth:`restore_state`. An empty entry (``nb_models
+        == 0``: the sealed-sum-dict entry written at the Sum→Update
+        transition) restores to the zero accumulator the constructor
+        already built."""
+        if ckpt.nb_models == 0:
+            return
+        if self._device is not None and ckpt.planes:
+            if self._count or self.nb_models:
+                raise RuntimeError("restore_journal requires an empty aggregator")
+            self._device.restore_shards(ckpt.planes, ckpt.nb_models)
+            self._unit_acc = np.ascontiguousarray(ckpt.unit, dtype=np.uint32)
+            return
+        self.restore_state(ckpt.wire_vect(), ckpt.unit, ckpt.nb_models)
 
     def restore_state(self, vect: np.ndarray, unit: np.ndarray, nb_models: int) -> None:
         """Restore a checkpoint snapshot into an EMPTY aggregator (resume)."""

@@ -141,6 +141,11 @@ class Shared:
     # (edge retry after a lost acknowledgement) is rejected as stale
     # instead of folded twice (docs/DESIGN.md §11).
     edge_watermarks: dict = field(default_factory=dict)
+    # graceful-shutdown flush (docs/DESIGN.md §9): the phase whose journal
+    # cadence can lag live state (Update) installs its ``save_now`` here so
+    # the runner's SIGTERM/SIGINT path can persist a final journal entry
+    # before exiting; per-event-journaling phases leave it None
+    flush_hook: Optional[object] = None
 
     def set_round_id(self, round_id: int) -> None:
         self.state.round_id = round_id
@@ -149,6 +154,28 @@ class Shared:
     @property
     def round_id(self) -> int:
         return self.state.round_id
+
+
+def reduce_count_window(params, offset: int):
+    """Shrink a phase's count window by ``offset`` already-journaled
+    arrivals (a resumed phase re-opens for the REMAINDER only; the restored
+    participants will not resend). A fully-satisfied window drains straight
+    through: min/max/quorum clamp at 0."""
+    import dataclasses
+
+    if not offset:
+        return params
+    count = dataclasses.replace(
+        params.count,
+        min=max(params.count.min - offset, 0),
+        max=max(params.count.max - offset, 0),
+        quorum=(
+            None
+            if params.count.quorum is None
+            else max(params.count.quorum - offset, 0)
+        ),
+    )
+    return dataclasses.replace(params, count=count)
 
 
 class _Counter:

@@ -116,22 +116,16 @@ class Failure(PhaseState):
                 await asyncio.sleep(delay)
 
     async def _try_resume(self):
-        """Re-enter Update from a valid checkpoint instead of restarting.
+        """Re-enter the journaled phase instead of restarting the round.
 
         Returns the resumed phase or None. Every code path is fail-soft: a
-        broken checkpoint read/validation degrades to the Idle restart the
+        broken journal read/validation degrades to the Idle restart the
         pre-resilience coordinator always did.
         """
         res = self.shared.settings.resilience
         if not res.checkpoint_enabled:
             return None
-        if self.failed_phase is not None and self.failed_phase != PhaseName.UPDATE:
-            # the checkpoint can only resume the update phase; a later
-            # phase's failure restarts the round (its participants would
-            # never resend, so a resume just times out). Update deletes the
-            # checkpoint when it completes — this guard covers the window
-            # where that deletion itself failed.
-            return None
+        failed = self.failed_phase.value if self.failed_phase is not None else "unknown"
         attempts = self.shared.resume_attempts  # lint: tenant-ok: budget lives on this tenant's own Shared
         if attempts >= res.max_resume_attempts:
             logger.warning(
@@ -140,31 +134,50 @@ class Failure(PhaseState):
                 attempts,
             )
             ckpt_mod.RESUMES.labels(outcome="budget_exhausted").inc()
+            ckpt_mod.RESUME_TOTAL.labels(phase=failed, outcome="budget_exhausted").inc()
             return None
         ckpt = await ckpt_mod.load(self.shared.store)
         if ckpt is None:
+            return None
+        if self.failed_phase is not None and self.failed_phase.value != ckpt.phase:
+            # a journal entry for another phase cannot help THIS failure:
+            # participants of the failed phase would never resend into a
+            # re-entered earlier phase, so the resume just times out (e.g.
+            # sum2 failed before its base entry landed, journal still says
+            # "update") — restart the round instead of burning budget
+            logger.warning(
+                "round %d: journal phase %r != failed phase %r; restarting round",
+                self.shared.round_id,
+                ckpt.phase,
+                failed,
+            )
+            ckpt_mod.RESUMES.labels(outcome="invalid").inc()
+            ckpt_mod.RESUME_TOTAL.labels(phase=ckpt.phase, outcome="invalid").inc()
             return None
         try:
             reason = await ckpt_mod.validate(ckpt, self.shared.state, self.shared.store)
         except Exception as err:
             reason = f"validation failed: {err}"
         if reason is not None:
-            logger.warning(
-                "round %d: checkpoint not resumable (%s); restarting round",
+            logger.warning(  # lint: taint-ok: validation reason carries counts/names only, never key bytes
+                "round %d: journal entry not resumable (%s); restarting round",
                 self.shared.round_id,
                 reason,
             )
             ckpt_mod.RESUMES.labels(outcome="invalid").inc()
+            ckpt_mod.RESUME_TOTAL.labels(phase=ckpt.phase, outcome="invalid").inc()
             return None
         self.shared.resume_attempts = attempts + 1  # lint: tenant-ok: budget lives on this tenant's own Shared
         ckpt_mod.RESUMES.labels(outcome="resumed").inc()
+        ckpt_mod.RESUME_TOTAL.labels(phase=ckpt.phase, outcome="resumed").inc()
         logger.info(
-            "round %d: resuming update phase from checkpoint (%d models, attempt %d/%d)",
+            "round %d: resuming %s phase from journal (%d models, attempt %d/%d)",
             self.shared.round_id,
+            ckpt.phase,
             ckpt.nb_models,
             attempts + 1,
             res.max_resume_attempts,
         )
-        from .update import UpdatePhase
+        from .resume import resume_phase
 
-        return UpdatePhase(self.shared, resume_from=ckpt)
+        return resume_phase(self.shared, ckpt)

@@ -37,6 +37,8 @@ class Idle(PhaseState):
         # cannot outlive the dictionaries it is consistent with
         await self.shared.store.coordinator.delete_round_checkpoint()
         self.shared.resume_attempts = 0  # lint: tenant-ok: round reset within this tenant's own Shared
+        # a stale graceful-flush hook would journal a dead phase's state
+        self.shared.flush_hook = None
         self._reconcile_pool()
         # per-edge envelope watermarks are round-scoped: window sequences
         # restart at 0 with every round's fresh window state on the edges

@@ -25,6 +25,7 @@ import numpy as np
 
 from ...core.mask.masking import Aggregation, UnmaskingError
 from ...core.mask.object import MaskObject
+from ...resilience.chaos import maybe_kill
 from ...telemetry import profiling
 from ...telemetry.registry import get_registry
 from ..events import ModelUpdate, PhaseName
@@ -71,6 +72,10 @@ class Unmask(PhaseState):
                 "unmask", len(self.model_agg), lambda: self.model_agg.unmask_array(mask)
             )
         await self._save_global_model()
+        # chaos hook (kill-matrix harness): the publish window — the model
+        # is persisted but the journal not yet retired; a restart must
+        # republish idempotently (ModelStorage contract), never corrupt
+        maybe_kill("unmask:publish")
         await self._publish_proof()
         # round-end page release (docs/DESIGN.md §19): the accumulator's
         # pool pages go back the moment the unmasked model is decoded and
@@ -79,6 +84,11 @@ class Unmask(PhaseState):
         release = getattr(self.model_agg, "release_pool", None)
         if release is not None:
             release()
+        if self.shared.settings.resilience.checkpoint_enabled:
+            # retire the round journal: the model is published and the
+            # pool pages are back — nothing left for a resume to redo
+            # (Idle's delete is the backstop for disabled-journal configs)
+            await self.shared.store.coordinator.delete_round_checkpoint()
 
     def broadcast(self) -> None:
         assert self.global_model is not None

@@ -25,13 +25,13 @@ restored updates, so an already-satisfied round drains straight through.
 from __future__ import annotations
 
 import asyncio
-import dataclasses
 import logging
 
 from ...core.mask.masking import AggregationError
-from ...resilience.checkpoint import CheckpointManager, RoundCheckpoint
+from ...resilience.chaos import maybe_kill
+from ...resilience.checkpoint import CheckpointManager, RoundCheckpoint, entry, write_entry
 from ...telemetry.registry import get_registry
-from ..aggregation import StagedAggregator
+from ..aggregation import StagedAggregator, build_staged_aggregator
 from ..events import DictionaryUpdate, PhaseName
 from ..requests import (
     EnvelopeReplay,
@@ -40,7 +40,7 @@ from ..requests import (
     StateMachineRequest,
     UpdateRequest,
 )
-from .base import PhaseError, PhaseState
+from .base import PhaseError, PhaseState, reduce_count_window
 
 logger = logging.getLogger("xaynet.coordinator")
 
@@ -64,33 +64,21 @@ class UpdatePhase(PhaseState):
     def __init__(self, shared, resume_from: RoundCheckpoint | None = None):
         super().__init__(shared)
         settings = shared.settings
-        self.aggregator = StagedAggregator(
-            config=shared.state.round_params.mask_config,
-            object_size=shared.state.round_params.model_length,
-            device=settings.aggregation.device,
-            batch_size=settings.aggregation.batch_size,
-            kernel=settings.aggregation.kernel,
-            dispatch_ahead=settings.aggregation.dispatch_ahead,
-            staging_buffers=settings.aggregation.staging_buffers,
-            shard_parallel=settings.aggregation.shard_parallel,
-            shard_threads=settings.aggregation.shard_threads,
-            packed_staging=settings.aggregation.packed_staging,
-            tenant=shared.tenant,
-        )
+        self.aggregator: StagedAggregator = build_staged_aggregator(shared)
         self._seed_dict = None
+        self._resume_from = resume_from
         self._resumed_models = 0
         if resume_from is not None:
-            self.aggregator.restore_state(
-                resume_from.vect, resume_from.unit, resume_from.nb_models
-            )
+            if resume_from.nb_models:
+                self.aggregator.restore_journal(resume_from)
             self._resumed_models = resume_from.nb_models
             # the restored updates count as arrivals for the liveness
             # controller: the post-resume window is offset by them, and
             # reporting only the remainder would poison the shrink clamp
             # with a tiny "observed load" (base.PhaseState.arrivals_offset)
             self.arrivals_offset = resume_from.nb_models
-            logger.info(
-                "round %d: update phase RESUMED from checkpoint (%d models restored)",
+            logger.info(  # lint: taint-ok: restored-model COUNT only, no journal payload
+                "round %d: update phase RESUMED from journal (%d models restored)",
                 shared.round_id,
                 resume_from.nb_models,
             )
@@ -108,26 +96,26 @@ class UpdatePhase(PhaseState):
 
     async def process(self) -> None:
         params = self.shared.settings.pet.update
-        if self._resumed_models:
+        if self._resume_from is not None:
             # the restored updates already satisfied part of the window; a
             # fully-satisfied resume drains straight through to sum2 (the
             # participants who submitted them will not resend)
-            count = dataclasses.replace(
-                params.count,
-                min=max(params.count.min - self._resumed_models, 0),
-                max=max(params.count.max - self._resumed_models, 0),
-                quorum=(
-                    None
-                    if params.count.quorum is None
-                    else max(params.count.quorum - self._resumed_models, 0)
-                ),
-            )
-            params = dataclasses.replace(params, count=count)
+            params = reduce_count_window(params, self._resumed_models)
             # sum participants contacting a restarted coordinator need the
             # sum dictionary re-broadcast to build their seed dicts
             sum_dict = await self.shared.store.coordinator.sum_dict()
             if sum_dict:
                 self.shared.events.broadcast_sum_dict(DictionaryUpdate.new(sum_dict))
+        elif self._ckpt is not None:
+            # seal the Sum -> Update transition: a crash before the first
+            # accepted update must resume into Update with the frozen sum
+            # dictionary, not restart the round from Idle
+            sum_dict = await self.shared.store.coordinator.sum_dict() or {}
+            await write_entry(self.shared, entry(self.shared, "update", sum_dict=sum_dict))
+        if self._ckpt is not None:
+            # graceful-signal flush: the journal cadence may lag the live
+            # aggregate; a SIGTERM mid-phase forces one final save (runner)
+            self.shared.flush_hook = self._ckpt.save_now
         await self.process_requests(params)
         if self.shared.settings.overlap.feature("sum2_drain"):
             # phase overlap (docs/DESIGN.md §22): SUBMIT the staged
@@ -144,13 +132,11 @@ class UpdatePhase(PhaseState):
         self._seed_dict = await self.shared.store.coordinator.seed_dict()
         if not self._seed_dict:
             raise PhaseError("NoSeedDict", "seed dictionary missing after update phase")
-        if self._ckpt is not None:
-            # the checkpoint's useful lifetime IS the update phase: once the
-            # round moves to sum2, re-entering Update from it cannot help
-            # (sum2 masks would never be resent) — delete it so a later
-            # phase's failure restarts the round immediately instead of
-            # burning resume attempts on a deterministic timeout
-            await self.shared.store.coordinator.delete_round_checkpoint()
+        # the journal entry is NOT deleted here: the sum2 phase rewrites it
+        # as a sum2-tagged entry (aggregate + votes) before acknowledging
+        # its first vote, and the unmask phase retires it only after the
+        # global model is published — the round is resumable end to end
+        self.shared.flush_hook = None
 
     def broadcast(self) -> None:
         self.shared.events.broadcast_seed_dict(DictionaryUpdate.new(self._seed_dict))
@@ -186,6 +172,10 @@ class UpdatePhase(PhaseState):
             await asyncio.get_running_loop().run_in_executor(None, self.aggregator.flush)
             if self._ckpt is not None:
                 await self._ckpt.maybe_save()
+        # chaos hook (kill-matrix harness): dies BEFORE the ack leaves, so
+        # with checkpoint_every_batches = 1 the journal already carries the
+        # update the client will retry idempotently after restart
+        maybe_kill("update")
 
     async def handle_partial(self, req: PartialAggregate, remaining: int) -> None:
         """Fold one edge envelope ATOMICALLY (docs/DESIGN.md §11).
@@ -292,6 +282,7 @@ class UpdatePhase(PhaseState):
         )
         if self._ckpt is not None:
             await self._ckpt.maybe_save()
+        maybe_kill("update")
 
     async def coalesced_batch_start(self, members) -> None:
         """Batch prevalidation: when device wire ingest is on, the whole

@@ -123,6 +123,9 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         # round pipelines over the shared mesh/pool/scheduler (§19)
         await serve_tenants(settings)
         return
+    import time as _time
+
+    boot_t0 = _time.monotonic()
     init_logging(settings)
     store = store if store is not None else init_store(settings)
     if settings.storage.backend == "s3":
@@ -206,6 +209,12 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
             tls.verify_mode = ssl.CERT_REQUIRED
             tls.load_verify_locations(settings.api.tls_client_auth)
     await rest.start(host or "127.0.0.1", int(port or 8081), tls)
+    # restart-to-serving wall (docs/DESIGN.md §9): process entry to the API
+    # accepting requests, store restore + journal resume included — THE
+    # recovery-time number the kill-matrix bench gate tracks
+    from ..resilience.checkpoint import RECOVERY_SECONDS
+
+    RECOVERY_SECONDS.set(_time.monotonic() - boot_t0)
 
     stop = asyncio.get_running_loop().create_future()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -222,7 +231,19 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
     except asyncio.CancelledError:
         pass
     finally:
+        # graceful-signal flush (docs/DESIGN.md §9): capture the running
+        # phase's journal hook BEFORE cancelling — a SIGTERM between the
+        # update phase's save cadence points must not drop accepted updates
+        phase = machine.phase
+        flush = getattr(phase.shared, "flush_hook", None) if phase is not None else None
         machine_task.cancel()
+        await asyncio.gather(machine_task, return_exceptions=True)
+        if flush is not None:
+            try:
+                await flush()
+                logger.info("graceful shutdown: final journal entry flushed")
+            except Exception as err:
+                logger.warning("graceful shutdown: journal flush failed: %s", err)
         # a cancelled machine never reaches the Shutdown phase, so close the
         # request channel here: queued/in-flight requests are rejected and
         # the pipeline's final coalescer flush fails fast instead of
@@ -235,6 +256,12 @@ async def serve(settings: Settings, store: Optional[Store] = None) -> None:
         # queued tail — without this the InfluxHttp dispatcher dies with
         # whatever was still batching
         metrics.close()
+        # forensic tail: the flight ring (recent spans + counter deltas)
+        # lands on disk with every orderly exit, so a post-mortem has the
+        # same bundle a crash dump would carry
+        flight_recorder.flight_dump(
+            "shutdown", "coordinator stopping (signal or machine exit)"
+        )
         # ... and the in-flight round's trace window (Chrome export)
         trace.get_tracer().end_round()
         logger.info("coordinator stopped")
@@ -358,6 +385,9 @@ async def serve_tenants(settings: Settings) -> None:
     )
     from .rest import TenantRoutes
 
+    import time as _time
+
+    boot_t0 = _time.monotonic()
     init_logging(settings)
     ten = settings.tenancy
     configure_pool(ten.page_kib, ten.slab_pages, ten.host_pages, ten.device_pages)
@@ -427,6 +457,12 @@ async def serve_tenants(settings: Settings) -> None:
             tls.verify_mode = ssl.CERT_REQUIRED
             tls.load_verify_locations(settings.api.tls_client_auth)
     await rest.start(host or "127.0.0.1", int(port or 8081), tls)
+    # restart-to-serving wall: EVERY tenant's store restore + journal
+    # resume ran before the listener came up (each tenant resumes
+    # independently from its scoped journal)
+    from ..resilience.checkpoint import RECOVERY_SECONDS
+
+    RECOVERY_SECONDS.set(_time.monotonic() - boot_t0)
     logger.info(
         "multi-tenant coordinator up: %d tenants (%s), default=%s",
         len(registry),
@@ -468,6 +504,15 @@ async def serve_tenants(settings: Settings) -> None:
         from ..tenancy import install_manager as _uninstall
 
         _uninstall(None)
+        # graceful-signal flush, per tenant: capture each running phase's
+        # journal hook BEFORE cancelling its machine task
+        flushes = []
+        for ctx in registry.contexts():
+            phase = ctx.machine.phase
+            hook = getattr(phase.shared, "flush_hook", None) if phase is not None else None
+            if hook is not None:
+                flushes.append((ctx.tenant, hook))
+        tasks = [c.task for c in registry.contexts() if c.task is not None]
         for ctx in registry.contexts():
             if ctx.task is not None:
                 ctx.task.cancel()
@@ -476,11 +521,22 @@ async def serve_tenants(settings: Settings) -> None:
             # strictly per channel, one tenant's shutdown never strands
             # another tenant's requests
             ctx.request_tx.close()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        for tenant, hook in flushes:
+            try:
+                await hook()
+                logger.info("tenant %s: final journal entry flushed", tenant)
+            except Exception as err:
+                logger.warning("tenant %s: journal flush failed: %s", tenant, err)
         await rest.stop()
         for ctx in registry.contexts():
             if ctx.pipeline is not None:
                 await ctx.pipeline.stop()
             ctx.metrics.close()
+        flight_recorder.flight_dump(
+            "shutdown", "multi-tenant coordinator stopping"
+        )
         trace.get_tracer().end_round()
         logger.info("multi-tenant coordinator stopped")
 

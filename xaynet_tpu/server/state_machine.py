@@ -109,35 +109,44 @@ class StateMachineInitializer:
 
     async def _try_resume_round(self, state: CoordinatorState):
         """Resume path for a coordinator killed MID-ROUND: when a valid
-        update-phase checkpoint exists for the restored round, the machine
-        starts in Update with the aggregate restored instead of at Idle —
-        previously accepted masked updates survive the restart
-        (docs/DESIGN.md §9). Returns a phase factory or None."""
+        journal entry exists for the restored round, the machine starts in
+        the journaled phase (sum, update, sum2 or unmask) with the round
+        state restored instead of at Idle — previously accepted messages
+        survive the restart (docs/DESIGN.md §9). ``reseed=True``: the
+        process died, so the store's round dictionaries are replayed from
+        the journal (idempotent on durable backends) and
+        accepted-but-unjournaled orphans pruned so their un-acked clients
+        can retry. Returns a phase factory or None."""
         if not self.settings.resilience.checkpoint_enabled:
             return None
         ckpt = await ckpt_mod.load(self.store)
         if ckpt is None:
             return None
         try:
-            reason = await ckpt_mod.validate(ckpt, state, self.store)
+            reason = await ckpt_mod.validate(ckpt, state, self.store, reseed=True)
         except Exception as err:
             reason = f"validation failed: {err}"
         if reason is not None:
-            logger.warning("mid-round checkpoint not resumable (%s); starting at Idle", reason)
+            logger.warning(  # lint: taint-ok: reason carries counts/names only, never key bytes
+                "round journal not resumable (%s); starting at Idle", reason
+            )
             ckpt_mod.RESUMES.labels(outcome="invalid").inc()
+            ckpt_mod.RESUME_TOTAL.labels(phase=ckpt.phase, outcome="invalid").inc()
             return None
         ckpt_mod.RESUMES.labels(outcome="resumed").inc()
+        ckpt_mod.RESUME_TOTAL.labels(phase=ckpt.phase, outcome="resumed").inc()
         logger.info(
-            "resuming round %d update phase from checkpoint (%d models restored)",
+            "resuming round %d %s phase from journal (%d models restored)",
             state.round_id,
+            ckpt.phase,
             ckpt.nb_models,
         )
 
         def factory(shared: Shared) -> PhaseState:
-            from .phases.update import UpdatePhase
+            from .phases.resume import resume_phase
 
             shared.resume_attempts += 1  # lint: tenant-ok: budget lives on this tenant's own Shared
-            return UpdatePhase(shared, resume_from=ckpt)
+            return resume_phase(shared, ckpt)
 
         return factory
 

@@ -117,6 +117,14 @@ class InMemoryCoordinatorStorage(CoordinatorStorage):
     async def latest_global_model_id(self) -> Optional[str]:
         return self._latest_global_model_id
 
+    async def prune_update_participants(self, keep_pks) -> bool:
+        keep = set(keep_pks)
+        for inner in self._seed_dict.values():
+            for pk in [p for p in inner if p not in keep]:
+                del inner[pk]
+        self._update_submitted = {pk for pk in self._update_submitted if pk in keep}
+        return True
+
     async def is_ready(self) -> None:
         return None
 
@@ -127,7 +135,10 @@ class InMemoryModelStorage(ModelStorage):
 
     async def set_global_model(self, round_id: int, round_seed: bytes, model_data: bytes) -> str:
         model_id = self.create_global_model_id(round_id, round_seed)
-        if model_id in self._models:
+        existing = self._models.get(model_id)
+        if existing is not None:
+            if existing == bytes(model_data):
+                return model_id  # publish-window resume: idempotent republish
             raise StorageError(f"global model {model_id} already exists")
         self._models[model_id] = bytes(model_data)
         return model_id
@@ -160,6 +171,9 @@ class FilesystemModelStorage(ModelStorage):
         model_id = self.create_global_model_id(round_id, round_seed)
         path = self._path(model_id)
         if os.path.exists(path):
+            with open(path, "rb") as f:
+                if f.read() == bytes(model_data):
+                    return model_id  # publish-window resume: idempotent republish
             raise StorageError(f"global model {model_id} already exists")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -211,8 +225,10 @@ class FileCoordinatorStorage(InMemoryCoordinatorStorage):
     without an external store, the *durable* subset (coordinator state and
     the latest-global-model pointer — exactly what restore reads,
     reference: initializer.rs:162-271) persists to a JSON file. Round
-    dictionaries are round-volatile by design: after a crash the round
-    restarts, which is the protocol's own recovery semantics.
+    dictionaries live in memory only — but the round JOURNAL (the binary
+    ``.ckpt`` sibling) carries its own copy of them, and a boot restore
+    replays them back through ``restore_round_dicts``, so a crash
+    anywhere in the round resumes instead of restarting it.
     """
 
     def __init__(self, path: str):

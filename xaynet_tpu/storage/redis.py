@@ -184,8 +184,11 @@ end
 return 0
 """
 
-# KEYS[1]=sum_dict, KEYS[2]=update_participants,
-# ARGV[1]=update_pk, ARGV[2..]=alternating sum_pk, seed
+# KEYS[1]=sum_dict, KEYS[2]=update_participants, KEYS[3]=seed-dict key
+# prefix (the tenant prefix + "seed_dict:" — built Lua-side so the per-sum
+# hashes land under the SAME prefixed namespace seed_dict() reads and the
+# prefix-scoped delete scans), ARGV[1]=update_pk, ARGV[2..]=alternating
+# sum_pk, seed
 ADD_LOCAL_SEED_DICT = b"""
 local n_entries = (#ARGV - 1) / 2
 if n_entries ~= redis.call("HLEN", KEYS[1]) then
@@ -200,12 +203,12 @@ if redis.call("SISMEMBER", KEYS[2], ARGV[1]) == 1 then
   return -3
 end
 for i = 2, #ARGV, 2 do
-  if redis.call("HEXISTS", "seed_dict:" .. ARGV[i], ARGV[1]) == 1 then
+  if redis.call("HEXISTS", KEYS[3] .. ARGV[i], ARGV[1]) == 1 then
     return -4
   end
 end
 for i = 2, #ARGV, 2 do
-  redis.call("HSET", "seed_dict:" .. ARGV[i], ARGV[1], ARGV[i + 1])
+  redis.call("HSET", KEYS[3] .. ARGV[i], ARGV[1], ARGV[i + 1])
 end
 redis.call("SADD", KEYS[2], ARGV[1])
 return 0
@@ -275,7 +278,9 @@ class RedisCoordinatorStorage(CoordinatorStorage):
             seed_bytes = seed.as_bytes() if isinstance(seed, EncryptedMaskSeed) else bytes(seed)
             argv += [sum_pk, seed_bytes]
         code = await self.client.command(
-            b"EVAL", ADD_LOCAL_SEED_DICT, b"2", self._k(_K_SUM_DICT), self._k(_K_UPDATE_SET), *argv,
+            b"EVAL", ADD_LOCAL_SEED_DICT, b"3",
+            self._k(_K_SUM_DICT), self._k(_K_UPDATE_SET), self._k(b"seed_dict:"),
+            *argv,
             replay_safe=False,
         )
         return {
@@ -297,6 +302,23 @@ class RedisCoordinatorStorage(CoordinatorStorage):
                 flat[i]: EncryptedMaskSeed(flat[i + 1]) for i in range(0, len(flat), 2)
             }
         return out if any(out.values()) else None
+
+    async def prune_update_participants(self, keep_pks) -> bool:
+        # journal resume (docs/DESIGN.md §9): redis round state survives a
+        # coordinator crash, so an update accepted between the last journal
+        # write and the kill is still here — but its client never saw the
+        # ack and will retry; dropping the orphan seeds + membership makes
+        # that retry succeed instead of bouncing off ALREADY_SUBMITTED
+        keep = set(keep_pks)
+        members = await self.client.command(b"SMEMBERS", self._k(_K_UPDATE_SET)) or []
+        orphans = [pk for pk in members if pk not in keep]
+        if not orphans:
+            return True
+        sums = await self.client.command(b"HKEYS", self._k(_K_SUM_DICT)) or []
+        for sum_pk in sums:
+            await self.client.command(b"HDEL", self._k(b"seed_dict:") + sum_pk, *orphans)
+        await self.client.command(b"SREM", self._k(_K_UPDATE_SET), *orphans)
+        return True
 
     async def incr_mask_score(self, pk: bytes, mask: MaskObject) -> Optional[MaskScoreIncrError]:
         code = await self.client.command(

@@ -124,6 +124,40 @@ class CoordinatorStorage(ABC):
     async def is_ready(self) -> None:
         """Raises ``StorageError`` when the backend is unreachable."""
 
+    # --- journal resume (resilience) --------------------------------------
+
+    async def restore_round_dicts(self, sum_dict, seed_dicts, mask_votes) -> None:
+        """Replay journaled round dictionaries through the protocol
+        primitives — idempotent on EVERY backend: entries the store still
+        holds answer with their conditional-insert protocol verdict, which
+        is exactly the outcome a replay wants ignored. ``seed_dicts`` is
+        the journal's ``{update_pk: {sum_pk: seed bytes}}`` replay form;
+        ``mask_votes`` is ``[(sum_pk, serialized mask bytes)]``. Replay
+        order matters: sum membership gates both seed-dict inserts and
+        mask votes."""
+        from ..core.mask.seed import EncryptedMaskSeed
+        from ..core.mask.serialization import parse_mask_object
+
+        for pk, ephm in sum_dict.items():
+            await self.add_sum_participant(bytes(pk), bytes(ephm))
+        for update_pk, local in seed_dicts.items():
+            await self.add_local_seed_dict(
+                bytes(update_pk),
+                {bytes(spk): EncryptedMaskSeed(bytes(seed)) for spk, seed in local.items()},
+            )
+        for pk, blob in mask_votes:
+            mask, _ = parse_mask_object(bytes(blob))
+            await self.incr_mask_score(bytes(pk), mask)
+
+    async def prune_update_participants(self, keep_pks) -> bool:
+        """Drop update participants the store holds but the journal never
+        recorded (accepted-but-unjournaled: the coordinator died between
+        the seed-dict insert and the journal write, so the client never
+        saw the ack and WILL retry — the prune makes that retry succeed).
+        Returns False when the backend cannot prune; the caller's
+        seed-watermark check then rejects the resume instead."""
+        return False
+
     # --- mid-round checkpoint (resilience) --------------------------------
     # Concrete defaults: the checkpoint is round-volatile state with the
     # same lifetime as the dictionaries, so an in-process fallback is
@@ -155,7 +189,11 @@ class ModelStorage(ABC):
     async def set_global_model(
         self, round_id: int, round_seed: bytes, model_data: bytes
     ) -> str:
-        """Stores the model; refuses to overwrite an existing id."""
+        """Stores the model; refuses to overwrite an existing id with
+        DIFFERENT bytes. Re-storing identical bytes returns the id —
+        a publish-window resume (the coordinator died after persisting
+        the model but before retiring the journal entry) republishes
+        the exact same model and must be an idempotent success."""
 
     @abstractmethod
     async def global_model(self, model_id: str) -> Optional[bytes]: ...
